@@ -1,0 +1,25 @@
+"""dml_tpu — a TPU-native distributed ML inference framework.
+
+A ground-up rebuild of the capabilities of
+shahzadjutt123/Distributed-Machine-Learning ("awesomedml"):
+
+- SWIM-style gossip failure detection over a configurable ring
+  (reference: membershipList.py, worker.py:1181-1199)
+- leader election with a hot-standby coordinator
+  (reference: election.py, worker.py:887-919)
+- a replicated, versioned distributed file store
+  (reference: file_service.py, leader.py)
+- a cost-model-driven fair-share batch inference scheduler with
+  preemption and failure recovery (reference: worker.py:255-495)
+- C1-C5 query-rate / latency metrics and an interactive CLI
+  (reference: worker.py:1629-2034)
+
+The compute path is idiomatic JAX/XLA: Flax model definitions,
+jit-compiled bfloat16 batched forward passes on TPU, fixed shapes,
+`jax.sharding.Mesh` + pjit for multi-chip parallelism, and Pallas
+kernels for fused host-side-free preprocessing. The control plane is
+a lightweight asyncio UDP protocol over DCN; the bulk data plane is
+TCP streams (replacing the reference's scp-over-SSH).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Cluster control plane: transport, membership, election, store, scheduling."""

@@ -1,0 +1,237 @@
+"""SWIM-style membership list with suspicion, cleanup, and repair hooks.
+
+Replaces the reference's MemberShipList (membershipList.py:1-154) with a
+pure-logic core: no I/O, injectable clock, explicit hook callbacks —
+so the merge/suspicion/cleanup semantics are unit-testable (the
+reference has zero tests for this, SURVEY §4).
+
+Semantics preserved from the reference:
+- entry = unique_name -> (timestamp, status); merge keeps the newest
+  timestamp (membershipList.py:103-130)
+- suspicion after the failure detector reports >N missed ACKs
+  (worker.py:1090-1121 -> update_node_status, membershipList.py:132-139)
+- suspects are removed after `cleanup_time` seconds; removal fires
+  hooks: leader-death -> election (membershipList.py:39-43), node-death
+  -> job requeue (membershipList.py:46), >=k cleaned -> re-replication
+  (membershipList.py:49-52), ping-target repair (membershipList.py:54-59)
+- a suspect that ACKs again before cleanup is restored and counted as
+  a false positive (membershipList.py:23-24, 113-118)
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..config import ClusterSpec, NodeId
+
+ALIVE = 1
+SUSPECT = 0
+
+
+@dataclass
+class MembershipHooks:
+    """Callbacks fired by cleanup — wired by the node composition layer."""
+
+    on_leader_failed: Optional[Callable[[str], None]] = None
+    on_node_failed: Optional[Callable[[str], None]] = None
+    on_replication_needed: Optional[Callable[[List[str]], None]] = None
+    on_topology_change: Optional[Callable[[], None]] = None
+
+
+@dataclass
+class MembershipList:
+    spec: ClusterSpec
+    me: NodeId
+    hooks: MembershipHooks = field(default_factory=MembershipHooks)
+    clock: Callable[[], float] = time.time
+
+    def __post_init__(self):
+        self._members: Dict[str, Tuple[float, int]] = {
+            self.me.unique_name: (self.clock(), ALIVE)
+        }
+        self._suspect_since: Dict[str, float] = {}
+        self.leader: Optional[str] = None
+        self.false_positives = 0
+        self.indirect_failures = 0
+        self.cleaned_since_replication: List[str] = []
+        self._ping_targets: List[NodeId] = []
+        self.recompute_ping_targets()
+
+    # ---- views ----
+
+    def snapshot(self) -> Dict[str, Tuple[float, int]]:
+        """Gossip payload + cleanup pass (reference .get(),
+        membershipList.py:97-101, runs _cleanup on every call)."""
+        self.cleanup()
+        return dict(self._members)
+
+    def alive_nodes(self) -> List[NodeId]:
+        out = []
+        for uname, (_, status) in self._members.items():
+            if status == ALIVE:
+                node = self.spec.node_by_unique_name(uname)
+                if node is not None:
+                    out.append(node)
+        return out
+
+    def is_alive(self, unique_name: str) -> bool:
+        ent = self._members.get(unique_name)
+        return ent is not None and ent[1] == ALIVE
+
+    @property
+    def ping_targets(self) -> List[NodeId]:
+        return list(self._ping_targets)
+
+    # ---- mutation ----
+
+    def heartbeat_self(self) -> None:
+        self._members[self.me.unique_name] = (self.clock(), ALIVE)
+
+    def merge(self, gossip: Dict[str, Tuple[float, int]]) -> None:
+        """Newest-timestamp merge (reference update(),
+        membershipList.py:103-130). A remote ALIVE entry newer than our
+        SUSPECT entry un-suspects the node (false-positive accounting,
+        membershipList.py:113-118)."""
+        changed = False
+        for uname, entry in gossip.items():
+            ts, status = float(entry[0]), int(entry[1])
+            if uname == self.me.unique_name:
+                continue
+            if self.spec.node_by_unique_name(uname) is None:
+                continue  # unknown node: ignore (static universe, like reference)
+            cur = self._members.get(uname)
+            if cur is None:
+                self._members[uname] = (ts, status)
+                changed = True
+                if status == SUSPECT:
+                    self._suspect_since[uname] = self.clock()
+                    self.indirect_failures += 1
+                continue
+            if ts > cur[0]:
+                if cur[1] == SUSPECT and status == ALIVE:
+                    self.false_positives += 1
+                    self._suspect_since.pop(uname, None)
+                if cur[1] == ALIVE and status == SUSPECT:
+                    self._suspect_since[uname] = self.clock()
+                    self.indirect_failures += 1
+                if cur[1] != status:
+                    changed = True
+                self._members[uname] = (ts, status)
+        if changed:
+            self.recompute_ping_targets()
+            if self.hooks.on_topology_change:
+                self.hooks.on_topology_change()
+
+    def suspect(self, unique_name: str) -> None:
+        """Failure detector reports missed ACKs (reference
+        update_node_status, membershipList.py:132-139)."""
+        if unique_name == self.me.unique_name:
+            return
+        cur = self._members.get(unique_name)
+        if cur is None or cur[1] == SUSPECT:
+            return
+        self._members[unique_name] = (self.clock(), SUSPECT)
+        self._suspect_since[unique_name] = self.clock()
+        self.recompute_ping_targets()
+        if self.hooks.on_topology_change:
+            self.hooks.on_topology_change()
+
+    def mark_alive(self, unique_name: str) -> None:
+        """Direct evidence of life (an ACK from the node itself)."""
+        cur = self._members.get(unique_name)
+        if cur is not None and cur[1] == SUSPECT:
+            self.false_positives += 1
+        if cur is None or cur[1] == SUSPECT:
+            self.recompute_ping_targets()
+        self._suspect_since.pop(unique_name, None)
+        self._members[unique_name] = (self.clock(), ALIVE)
+
+    def remove(self, unique_name: str) -> None:
+        """Voluntary leave (reference CLI option 4)."""
+        self._members.pop(unique_name, None)
+        self._suspect_since.pop(unique_name, None)
+        self.recompute_ping_targets()
+
+    def reset(self) -> None:
+        """Leave the cluster: forget everyone but self."""
+        self._members = {self.me.unique_name: (self.clock(), ALIVE)}
+        self._suspect_since.clear()
+        self.leader = None
+        self.recompute_ping_targets()
+
+    # ---- cleanup + hooks (reference _cleanup, membershipList.py:26-59) ----
+
+    def cleanup(self) -> List[str]:
+        now = self.clock()
+        expired = [
+            u
+            for u, since in self._suspect_since.items()
+            if now - since >= self.spec.timing.cleanup_time
+        ]
+        for uname in expired:
+            self._members.pop(uname, None)
+            self._suspect_since.pop(uname, None)
+            self.cleaned_since_replication.append(uname)
+            if uname == self.leader:
+                self.leader = None
+                if self.hooks.on_leader_failed:
+                    self.hooks.on_leader_failed(uname)
+            if self.hooks.on_node_failed:
+                self.hooks.on_node_failed(uname)
+        if expired:
+            self.recompute_ping_targets()
+            if self.hooks.on_topology_change:
+                self.hooks.on_topology_change()
+            # re-replicate once >= ring_k nodes have been cleaned
+            # (reference membershipList.py:49-52 waits for >= M)
+            if len(self.cleaned_since_replication) >= self.spec.ring_k:
+                batch = list(self.cleaned_since_replication)
+                self.cleaned_since_replication.clear()
+                if self.hooks.on_replication_needed:
+                    self.hooks.on_replication_needed(batch)
+        return expired
+
+    def flush_replication_backlog(self) -> None:
+        """Force the pending-cleanup batch out (used when the caller
+        wants prompt re-replication rather than waiting for >=k)."""
+        if self.cleaned_since_replication and self.hooks.on_replication_needed:
+            batch = list(self.cleaned_since_replication)
+            self.cleaned_since_replication.clear()
+            self.hooks.on_replication_needed(batch)
+
+    # ---- ping-target repair (reference topology_change +
+    #      _find_replacement_node, membershipList.py:61-95) ----
+
+    def recompute_ping_targets(self) -> None:
+        """Ping the next k *live* ring successors, walking past
+        suspects and not-yet-joined nodes — the reference does this
+        with a recursive replacement search (_find_replacement_node);
+        computing from the sorted ring is equivalent and simpler."""
+        ring = sorted(self.spec.nodes, key=lambda n: (n.rank, n.host, n.port))
+        if self.me not in ring or len(ring) <= 1:
+            self._ping_targets = []
+            return
+        i = ring.index(self.me)
+        k = min(self.spec.ring_k, len(ring) - 1)
+        targets: List[NodeId] = []
+        j = 1
+        while len(targets) < k and j < len(ring):
+            cand = ring[(i + j) % len(ring)]
+            ent = self._members.get(cand.unique_name)
+            if ent is not None and ent[1] == ALIVE:
+                targets.append(cand)
+            j += 1
+        self._ping_targets = targets
+
+    # ---- display (reference print(), membershipList.py:141-154) ----
+
+    def format(self) -> str:
+        lines = []
+        for uname, (ts, status) in sorted(self._members.items()):
+            node = self.spec.node_by_unique_name(uname)
+            tag = "ALIVE " if status == ALIVE else "SUSPECT"
+            mark = " *leader*" if uname == self.leader else ""
+            lines.append(f"{node or uname:>20}  {tag}  ts={ts:.3f}{mark}")
+        return "\n".join(lines)

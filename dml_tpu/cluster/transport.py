@@ -1,0 +1,123 @@
+"""Asyncio UDP transport for the control plane.
+
+Replaces the reference's AwesomeProtocol/UdpTransport (protocol.py:13-81,
+transport.py:26-34). Same responsibilities, same testing seams:
+
+- inbound datagrams are decoded and queued; consumers `await recv()`
+- `send()` supports deterministic synthetic packet loss for fault
+  injection (reference protocol.py:10, 25-29: 3% drop via a
+  pre-shuffled 100-slot bitmap) and bytes/bps accounting
+  (reference protocol.py:72-74)
+
+Unlike the reference we decode frames at the transport boundary and
+hand typed `Message`s to the dispatcher, and loss injection is seeded
+so multi-node simulations are reproducible.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Optional, Tuple
+
+from .wire import Message
+
+
+class LossInjector:
+    """Deterministic packet-drop schedule (reference protocol.py:25-29).
+
+    A pre-shuffled 100-slot bitmap with `pct` drop slots, cycled on
+    every send — the reference's exact scheme, but seedable.
+    """
+
+    def __init__(self, pct: float, seed: int = 0):
+        self.pct = pct
+        n_drop = int(round(pct))
+        slots = [True] * n_drop + [False] * (100 - n_drop)
+        random.Random(seed).shuffle(slots)
+        self._slots = slots
+        self._i = 0
+
+    def should_drop(self) -> bool:
+        if not self._slots or self.pct <= 0:
+            return False
+        drop = self._slots[self._i]
+        self._i = (self._i + 1) % len(self._slots)
+        return drop
+
+
+class UdpTransport(asyncio.DatagramProtocol):
+    """Bind a UDP socket; queue inbound Messages; count outbound bytes."""
+
+    def __init__(self, testing: bool = False, drop_pct: float = 0.0, seed: int = 0):
+        self.testing = testing
+        self._loss = LossInjector(drop_pct if testing else 0.0, seed)
+        self._queue: asyncio.Queue[Tuple[Message, Tuple[str, int]]] = asyncio.Queue()
+        self._transport: Optional[asyncio.DatagramTransport] = None
+        # accounting (reference protocol.py:72-74; CLI option 9)
+        self.bytes_sent = 0
+        self.packets_sent = 0
+        self.packets_dropped = 0
+        self.first_send_time: Optional[float] = None
+
+    # -- DatagramProtocol callbacks --
+
+    def connection_made(self, transport) -> None:  # pragma: no cover - asyncio
+        self._transport = transport
+
+    def datagram_received(self, data: bytes, addr: Tuple[str, int]) -> None:
+        msg = Message.unpack(data)
+        if msg is not None:
+            self._queue.put_nowait((msg, addr))
+
+    def error_received(self, exc) -> None:  # pragma: no cover - asyncio
+        pass
+
+    # -- public API --
+
+    @classmethod
+    async def bind(
+        cls,
+        host: str,
+        port: int,
+        testing: bool = False,
+        drop_pct: float = 0.0,
+        seed: int = 0,
+    ) -> "UdpTransport":
+        loop = asyncio.get_running_loop()
+        proto = cls(testing=testing, drop_pct=drop_pct, seed=seed)
+        await loop.create_datagram_endpoint(
+            lambda: proto, local_addr=(host, port), reuse_port=True
+        )
+        return proto
+
+    def send(self, msg: Message, addr: Tuple[str, int]) -> None:
+        """Fire-and-forget datagram (at-most-once; reliability comes
+        from the periodic re-ping/re-send loops, like the reference)."""
+        if self._transport is None:
+            raise RuntimeError("transport not bound")
+        if self._loss.should_drop():
+            self.packets_dropped += 1
+            return
+        frame = msg.pack()
+        if self.first_send_time is None:
+            self.first_send_time = time.monotonic()
+        self.bytes_sent += len(frame)
+        self.packets_sent += 1
+        self._transport.sendto(frame, addr)
+
+    async def recv(self) -> Tuple[Message, Tuple[str, int]]:
+        return await self._queue.get()
+
+    def bps(self) -> float:
+        """Observed send bandwidth (reference CLI option 9, worker.py:1724)."""
+        if self.first_send_time is None:
+            return 0.0
+        dt = time.monotonic() - self.first_send_time
+        return self.bytes_sent / dt if dt > 0 else 0.0
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None

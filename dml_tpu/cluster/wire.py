@@ -1,0 +1,122 @@
+"""Wire format for the UDP control plane.
+
+Replaces the reference's fixed 33 KB struct frame
+(`struct.pack("i255s6si32768s")`, packets.py:70-92) — which sends a
+~33 KB datagram even for an empty ping and is the reason its measured
+bandwidth numbers are what they are — with a compact, variable-length
+frame: a 10-byte header + UTF-8 JSON payload. Message taxonomy mirrors
+the reference's 50-value PacketType enum (packets.py:9-60), organized
+by subsystem (types that existed only for dead code paths are folded
+into their live equivalents).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import struct
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+_MAGIC = 0xD31  # 12-bit magic, "Dml"
+_HEADER = struct.Struct("!HHHI")  # magic, version|type, sender_len, payload_len
+_VERSION = 1
+MAX_DATAGRAM = 60_000  # stay under typical 64 KB UDP limit
+
+
+class MsgType(enum.IntEnum):
+    """Control-plane message taxonomy (reference packets.py:9-60)."""
+
+    # membership / failure detection (L4)
+    PING = 1
+    ACK = 2
+    INTRODUCE = 3
+    INTRODUCE_ACK = 4
+    FETCH_INTRODUCER = 5
+    FETCH_INTRODUCER_ACK = 6
+    UPDATE_INTRODUCER = 7
+    UPDATE_INTRODUCER_ACK = 8
+    # election (L5)
+    ELECTION = 10
+    COORDINATE = 11
+    COORDINATE_ACK = 12
+    # replicated store (L6)
+    ALL_LOCAL_FILES = 20
+    ALL_LOCAL_FILES_RELAY = 21
+    PUT_REQUEST = 22
+    PUT_REQUEST_ACK = 23
+    PUT_REQUEST_SUCCESS = 24
+    PUT_REQUEST_FAIL = 25
+    DOWNLOAD_FILE = 26
+    DOWNLOAD_FILE_SUCCESS = 27
+    DOWNLOAD_FILE_FAIL = 28
+    GET_FILE_REQUEST = 29
+    GET_FILE_REQUEST_ACK = 30
+    GET_FILE_REQUEST_FAIL = 31
+    DELETE_FILE_REQUEST = 32
+    DELETE_FILE_REQUEST_ACK = 33
+    DELETE_FILE_REQUEST_SUCCESS = 34
+    DELETE_FILE_REQUEST_FAIL = 35
+    DELETE_FILE = 36
+    DELETE_FILE_ACK = 37
+    DELETE_FILE_NAK = 38
+    REPLICATE_FILE = 39
+    REPLICATE_FILE_SUCCESS = 40
+    REPLICATE_FILE_FAIL = 41
+    LIST_FILE_REQUEST = 42
+    LIST_FILE_REQUEST_ACK = 43
+    GET_ALL_MATCHING_FILES = 44
+    GET_ALL_MATCHING_FILES_ACK = 45
+    # ML job pipeline (L7)
+    SUBMIT_JOB_REQUEST = 60
+    SUBMIT_JOB_REQUEST_ACK = 61
+    SUBMIT_JOB_REQUEST_SUCCESS = 62
+    SUBMIT_JOB_RELAY = 63
+    WORKER_TASK_REQUEST = 64
+    WORKER_TASK_REQUEST_ACK = 65
+    WORKER_TASK_ACK_RELAY = 66
+    SET_BATCH_SIZE = 67  # C3 (reference worker.py:1028-1037)
+    GET_C2_COMMAND = 68
+    GET_C2_COMMAND_ACK = 69
+
+
+@dataclass(frozen=True)
+class Message:
+    """One control-plane message (reference packets.py Packet)."""
+
+    sender: str  # unique_name "host:port" of the sending node
+    type: MsgType
+    data: Dict[str, Any]
+
+    def pack(self) -> bytes:
+        sender_b = self.sender.encode("utf-8")
+        payload = json.dumps(self.data, separators=(",", ":")).encode("utf-8")
+        head = _HEADER.pack(
+            (_MAGIC << 4) | _VERSION, int(self.type), len(sender_b), len(payload)
+        )
+        frame = head + sender_b + payload
+        if len(frame) > MAX_DATAGRAM:
+            raise ValueError(f"frame too large: {len(frame)} bytes")
+        return frame
+
+    @staticmethod
+    def unpack(raw: bytes) -> Optional["Message"]:
+        """Tolerant unpack: returns None on any malformed input
+        (reference packets.py:83-92 behaves the same)."""
+        try:
+            if len(raw) < _HEADER.size:
+                return None
+            magic_ver, mtype, slen, plen = _HEADER.unpack_from(raw)
+            if magic_ver >> 4 != _MAGIC or (magic_ver & 0xF) != _VERSION:
+                return None
+            off = _HEADER.size
+            if len(raw) != off + slen + plen:
+                return None
+            sender = raw[off : off + slen].decode("utf-8")
+            payload = raw[off + slen :]
+            data = json.loads(payload) if plen else {}
+            if not isinstance(data, dict):
+                return None
+            return Message(sender=sender, type=MsgType(mtype), data=data)
+        except Exception:
+            return None

@@ -1,0 +1,212 @@
+"""Cluster specification, node identity, and timing configuration.
+
+Replaces the reference's hand-edited static tables (config.py:4-89,
+nodes.py:1-35) with a declarative, serializable spec: no hardcoded
+hostnames, no credential files (the reference reads SSH passwords from
+password.txt, config.py:29-37 — our data plane is credential-free TCP),
+and the ring topology is computed from the node list instead of written
+out by hand (reference GLOBAL_RING_TOPOLOGY, config.py:67-89).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True, order=True)
+class NodeId:
+    """Immutable node identity (reference: nodes.py Node).
+
+    Ordering is (host, port) lexicographic; election rank uses
+    `rank` when provided so operators can pin coordinator preference
+    (the reference hardcoded H1 leader / H2 standby; we elect by
+    highest rank with (host, port) as tiebreak).
+    """
+
+    host: str
+    port: int
+    name: str = ""
+    rank: int = 0
+
+    @property
+    def unique_name(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def __str__(self) -> str:  # pragma: no cover - repr sugar
+        return self.name or self.unique_name
+
+
+@dataclass(frozen=True)
+class Timing:
+    """Failure-detector timing constants (reference config.py:4-10).
+
+    Reference deployed values: ping every 12 s, ACK timeout 10 s,
+    suspect cleanup 30 s, M=3 ring successors. Defaults here are the
+    README's tuned example (README.md:68-78) scaled for tests; real
+    deployments load their own.
+    """
+
+    ping_interval: float = 2.5
+    ack_timeout: float = 2.0
+    cleanup_time: float = 10.0
+    missed_acks_to_suspect: int = 3
+    leader_rpc_timeout: float = 20.0  # reference worker.py:1123-1135
+
+
+@dataclass(frozen=True)
+class StoreConfig:
+    """Replicated-store knobs (reference leader.py:60, file_service.py:8-11)."""
+
+    replication_factor: int = 4
+    max_versions: int = 5
+    root: str = "~/.dml_tpu/store"
+    download_dir: str = "~/.dml_tpu/downloads"
+    cleanup_on_startup: bool = False
+
+    def store_path(self) -> str:
+        return os.path.expanduser(self.root)
+
+    def download_path(self) -> str:
+        return os.path.expanduser(self.download_dir)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """TPU device-mesh specification for the compute path.
+
+    Axis sizes of -1 mean "fill with remaining devices". The inference
+    engine shards batches over `dp`; model parallelism (when enabled)
+    shards weights over `tp`; sequence parallelism (ring attention)
+    uses `sp`.
+    """
+
+    dp: int = -1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {"dp": self.dp, "tp": self.tp, "sp": self.sp}
+        fixed = 1
+        free = None
+        for ax, s in sizes.items():
+            if s == -1:
+                if free is not None:
+                    raise ValueError("only one mesh axis may be -1")
+                free = ax
+            else:
+                fixed *= s
+        if free is not None:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes[free] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} != {n_devices} devices")
+        return sizes
+
+
+@dataclass
+class ClusterSpec:
+    """The whole-cluster config: node table + ring + timing + store.
+
+    The reference's equivalent is the hand-maintained H1..H10 table and
+    GLOBAL_RING_TOPOLOGY dict (config.py:54-89), duplicated into
+    `introduce process/config.py`. Here there is one spec, serializable
+    to JSON, shared by every role including the introducer.
+    """
+
+    nodes: List[NodeId] = field(default_factory=list)
+    introducer: Optional[NodeId] = None
+    ring_k: int = 3  # number of ping successors (reference M=3, config.py:4)
+    timing: Timing = field(default_factory=Timing)
+    store: StoreConfig = field(default_factory=StoreConfig)
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+    testing: bool = False
+    packet_drop_pct: float = 0.0  # loss-injection seam (reference protocol.py:10)
+
+    # ---- lookups (reference Config.get_node*, config.py:116-144) ----
+
+    def node_by_unique_name(self, unique_name: str) -> Optional[NodeId]:
+        for n in self.nodes:
+            if n.unique_name == unique_name:
+                return n
+        return None
+
+    def node_by_name(self, name: str) -> Optional[NodeId]:
+        for n in self.nodes:
+            if n.name == name:
+                return n
+        return None
+
+    def ring_successors(self, node: NodeId) -> List[NodeId]:
+        """The k ring successors this node pings.
+
+        Reference hand-writes this per node (config.py:67-89); we
+        compute it: sort nodes by (rank, host, port), each node pings
+        the next k in ring order.
+        """
+        ring = sorted(self.nodes, key=lambda n: (n.rank, n.host, n.port))
+        if node not in ring:
+            return []
+        i = ring.index(node)
+        k = min(self.ring_k, len(ring) - 1)
+        return [ring[(i + j) % len(ring)] for j in range(1, k + 1)]
+
+    def election_winner(self, alive: List[NodeId]) -> Optional[NodeId]:
+        """Real bully winner: highest (rank, host, port) among the
+        alive set. The reference *intended* this but hardcoded H2
+        (election.py:24-32)."""
+        if not alive:
+            return None
+        return max(alive, key=lambda n: (n.rank, n.host, n.port))
+
+    # ---- serialization ----
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ClusterSpec":
+        raw = json.loads(text)
+        raw["nodes"] = [NodeId(**n) for n in raw.get("nodes", [])]
+        if raw.get("introducer"):
+            raw["introducer"] = NodeId(**raw["introducer"])
+        if raw.get("timing"):
+            raw["timing"] = Timing(**raw["timing"])
+        if raw.get("store"):
+            raw["store"] = StoreConfig(**raw["store"])
+        if raw.get("mesh"):
+            raw["mesh"] = MeshSpec(**raw["mesh"])
+        return cls(**raw)
+
+    @classmethod
+    def from_file(cls, path: str) -> "ClusterSpec":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    @classmethod
+    def localhost(
+        cls,
+        n: int,
+        base_port: int = 8001,
+        introducer_port: int = 8888,
+        **kw,
+    ) -> "ClusterSpec":
+        """A local multi-process cluster on 127.0.0.1 ports — the
+        pattern the reference used for testing (config.py:41-50,
+        README.md:16-25), formalized as a first-class constructor."""
+        nodes = [
+            NodeId("127.0.0.1", base_port + i, name=f"H{i + 1}", rank=n - i)
+            for i in range(n)
+        ]
+        intro = NodeId("127.0.0.1", introducer_port, name="DNS")
+        return cls(nodes=nodes, introducer=intro, **kw)

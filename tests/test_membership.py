@@ -1,0 +1,123 @@
+from dml_tpu.cluster.membership import ALIVE, SUSPECT, MembershipHooks, MembershipList
+from dml_tpu.config import ClusterSpec
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make(n=5, ring_k=3):
+    clock = FakeClock()
+    spec = ClusterSpec.localhost(n, ring_k=ring_k)
+    lists = [
+        MembershipList(spec=spec, me=node, clock=clock) for node in spec.nodes
+    ]
+    return spec, lists, clock
+
+
+def test_merge_newest_timestamp_wins():
+    spec, (a, b, *_), clock = make()
+    clock.advance(1)
+    b.heartbeat_self()
+    a.merge(b.snapshot())
+    assert a.is_alive(b.me.unique_name)
+    # stale gossip does not resurrect
+    old = {b.me.unique_name: (clock.t - 100, SUSPECT)}
+    a.merge(old)
+    assert a.is_alive(b.me.unique_name)
+
+
+def test_suspect_then_cleanup_fires_hooks():
+    spec, (a, b, *_), clock = make()
+    failed, topo = [], []
+    a.hooks = MembershipHooks(
+        on_node_failed=failed.append, on_topology_change=lambda: topo.append(1)
+    )
+    a.merge(b.snapshot())
+    a.suspect(b.me.unique_name)
+    assert not a.is_alive(b.me.unique_name)
+    assert a.cleanup() == []  # not yet expired
+    clock.advance(spec.timing.cleanup_time + 1)
+    assert a.cleanup() == [b.me.unique_name]
+    assert failed == [b.me.unique_name]
+    assert topo  # topology repair fired
+
+
+def test_leader_death_triggers_election_hook():
+    spec, (a, b, *_), clock = make()
+    elected = []
+    a.hooks = MembershipHooks(on_leader_failed=elected.append)
+    a.merge(b.snapshot())
+    a.leader = b.me.unique_name
+    a.suspect(b.me.unique_name)
+    clock.advance(spec.timing.cleanup_time + 1)
+    a.cleanup()
+    assert elected == [b.me.unique_name]
+    assert a.leader is None
+
+
+def test_false_positive_accounting():
+    spec, (a, b, *_), clock = make()
+    a.merge(b.snapshot())
+    a.suspect(b.me.unique_name)
+    clock.advance(1)
+    a.mark_alive(b.me.unique_name)
+    assert a.false_positives == 1
+    assert a.is_alive(b.me.unique_name)
+    # newer ALIVE gossip over a SUSPECT entry also counts
+    a.suspect(b.me.unique_name)
+    clock.advance(1)
+    b.heartbeat_self()
+    a.merge(b.snapshot())
+    assert a.false_positives == 2
+
+
+def test_replication_hook_after_k_cleanups():
+    spec, lists, clock = make(5, ring_k=2)
+    a = lists[0]
+    batches = []
+    a.hooks = MembershipHooks(on_replication_needed=batches.append)
+    for other in lists[1:3]:
+        a.merge(other.snapshot())
+    for other in lists[1:3]:
+        a.suspect(other.me.unique_name)
+    clock.advance(spec.timing.cleanup_time + 1)
+    cleaned = a.cleanup()
+    assert len(cleaned) == 2
+    assert batches and sorted(batches[0]) == sorted(cleaned)
+
+
+def test_ping_target_repair_walks_past_suspects():
+    spec, lists, clock = make(5, ring_k=2)
+    a = lists[0]
+    for other in lists[1:]:
+        a.merge(other.snapshot())
+    ring = sorted(spec.nodes, key=lambda n: (n.rank, n.host, n.port))
+    i = ring.index(a.me)
+    expected = [ring[(i + 1) % 5], ring[(i + 2) % 5]]
+    assert a.ping_targets == expected
+    # first successor dies -> replaced by the next live one
+    a.suspect(expected[0].unique_name)
+    assert a.ping_targets == [ring[(i + 2) % 5], ring[(i + 3) % 5]]
+
+
+def test_leave_and_rejoin():
+    spec, (a, b, *_), clock = make()
+    a.merge(b.snapshot())
+    a.reset()
+    assert a.alive_nodes() == [a.me]
+    a.merge(b.snapshot())
+    assert a.is_alive(b.me.unique_name)
+
+
+def test_unknown_nodes_ignored():
+    spec, (a, *_), clock = make()
+    a.merge({"rogue:9999": (clock.t + 100, ALIVE)})
+    assert not a.is_alive("rogue:9999")

@@ -1,0 +1,39 @@
+import asyncio
+
+import pytest
+
+from dml_tpu.cluster.transport import LossInjector, UdpTransport
+from dml_tpu.cluster.wire import Message, MsgType
+
+
+def test_loss_injector_deterministic():
+    li = LossInjector(3.0, seed=42)
+    drops = [li.should_drop() for _ in range(100)]
+    assert sum(drops) == 3
+    li2 = LossInjector(3.0, seed=42)
+    assert [li2.should_drop() for _ in range(100)] == drops
+    assert not any(LossInjector(0.0).should_drop() for _ in range(50))
+
+
+@pytest.mark.asyncio
+async def test_udp_send_recv():
+    a = await UdpTransport.bind("127.0.0.1", 0)
+    b = await UdpTransport.bind("127.0.0.1", 0)
+    b_port = b._transport.get_extra_info("sockname")[1]
+    msg = Message("127.0.0.1:1", MsgType.PING, {"x": 1})
+    a.send(msg, ("127.0.0.1", b_port))
+    got, addr = await asyncio.wait_for(b.recv(), 2)
+    assert got == msg
+    assert a.bytes_sent > 0 and a.packets_sent == 1
+    assert a.bps() >= 0
+    a.close()
+    b.close()
+
+
+@pytest.mark.asyncio
+async def test_drop_injection_counts():
+    a = await UdpTransport.bind("127.0.0.1", 0, testing=True, drop_pct=100.0)
+    msg = Message("x:1", MsgType.PING, {})
+    a.send(msg, ("127.0.0.1", 9))
+    assert a.packets_dropped == 1 and a.packets_sent == 0
+    a.close()

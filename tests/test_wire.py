@@ -1,0 +1,31 @@
+from dml_tpu.cluster.wire import Message, MsgType
+
+
+def test_pack_unpack_roundtrip():
+    m = Message("127.0.0.1:8001", MsgType.PING, {"gossip": {"a:1": [1.5, 1]}})
+    m2 = Message.unpack(m.pack())
+    assert m2 == m
+
+
+def test_empty_payload_is_small():
+    m = Message("127.0.0.1:8001", MsgType.PING, {})
+    frame = m.pack()
+    # the reference sends ~33 KB for an empty ping (packets.py:70-92);
+    # ours is a few dozen bytes
+    assert len(frame) < 64
+    assert Message.unpack(frame) == m
+
+
+def test_unpack_garbage_returns_none():
+    assert Message.unpack(b"") is None
+    assert Message.unpack(b"garbage") is None
+    assert Message.unpack(b"\x00" * 100) is None
+    good = Message("a:1", MsgType.ACK, {}).pack()
+    assert Message.unpack(good[:-1]) is None  # truncated
+    assert Message.unpack(good + b"x") is None  # trailing junk
+
+
+def test_all_msg_types_roundtrip():
+    for t in MsgType:
+        m = Message("h:1", t, {"k": 1})
+        assert Message.unpack(m.pack()).type is t
