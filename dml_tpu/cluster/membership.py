@@ -140,13 +140,18 @@ class MembershipList:
 
     def mark_alive(self, unique_name: str) -> None:
         """Direct evidence of life (an ACK from the node itself)."""
+        if self.spec.node_by_unique_name(unique_name) is None:
+            return  # forged/stray sender outside the cluster spec
         cur = self._members.get(unique_name)
+        changed = cur is None or cur[1] == SUSPECT
         if cur is not None and cur[1] == SUSPECT:
             self.false_positives += 1
-        if cur is None or cur[1] == SUSPECT:
-            self.recompute_ping_targets()
         self._suspect_since.pop(unique_name, None)
         self._members[unique_name] = (self.clock(), ALIVE)
+        if changed:
+            self.recompute_ping_targets()
+            if self.hooks.on_topology_change:
+                self.hooks.on_topology_change()
 
     def remove(self, unique_name: str) -> None:
         """Voluntary leave (reference CLI option 4)."""
@@ -208,8 +213,8 @@ class MembershipList:
         """Ping the next k *live* ring successors, walking past
         suspects and not-yet-joined nodes — the reference does this
         with a recursive replacement search (_find_replacement_node);
-        computing from the sorted ring is equivalent and simpler."""
-        ring = sorted(self.spec.nodes, key=lambda n: (n.rank, n.host, n.port))
+        computing from the canonical ring is equivalent and simpler."""
+        ring = self.spec.ring()
         if self.me not in ring or len(ring) <= 1:
             self._ping_targets = []
             return
@@ -233,5 +238,5 @@ class MembershipList:
             node = self.spec.node_by_unique_name(uname)
             tag = "ALIVE " if status == ALIVE else "SUSPECT"
             mark = " *leader*" if uname == self.leader else ""
-            lines.append(f"{node or uname:>20}  {tag}  ts={ts:.3f}{mark}")
+            lines.append(f"{str(node or uname):>20}  {tag}  ts={ts:.3f}{mark}")
         return "\n".join(lines)

@@ -1,0 +1,201 @@
+"""Bulk data plane: asyncio TCP streams between nodes.
+
+Replaces the reference's scp-over-SSH pulls (file_service.py:52-91,
+credentials from password.txt, config.py:29-37). Same pull-based
+topology — the node that needs bytes dials the node that has them —
+but over a credential-free TCP stream protocol on each node's data
+port:
+
+    request:  one JSON line {"op": ..., ...}\n
+    response: one JSON line {"ok": bool, "size": N, ...}\n + raw bytes
+
+Ops:
+- fetch_store: pull a (name, version) — or every version — of a file
+  from the remote node's LocalStore (replication + GET path; reference
+  replicate_file pulls `filename*`, file_service.py:52-61)
+- fetch_token: pull a client-exposed local file (PUT path). The client
+  registers the path first and the token travels via the leader —
+  unlike scp, arbitrary remote paths are not readable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import secrets
+from typing import Dict, List, Optional, Tuple
+
+from .local_store import LocalStore
+
+_CHUNK = 1 << 16
+
+
+class DataPlane:
+    def __init__(self, store: LocalStore, host: str = "127.0.0.1", port: int = 0):
+        self.store = store
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._exposed: Dict[str, str] = {}  # token -> local path
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ---- client-side path exposure (PUT source) ----
+
+    def expose(self, path: str) -> str:
+        token = secrets.token_hex(16)
+        self._exposed[token] = os.path.abspath(os.path.expanduser(path))
+        return token
+
+    def unexpose(self, token: str) -> None:
+        self._exposed.pop(token, None)
+
+    # ---- server ----
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            line = await reader.readline()
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError:
+                await self._reply(writer, {"ok": False, "error": "bad request"})
+                return
+            op = req.get("op")
+            if op == "fetch_store":
+                await self._serve_store(writer, req)
+            elif op == "fetch_token":
+                await self._serve_token(writer, req)
+            else:
+                await self._reply(writer, {"ok": False, "error": f"unknown op {op!r}"})
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _reply(self, writer, header: dict, payload: bytes = b"") -> None:
+        writer.write(json.dumps(header).encode() + b"\n")
+        for i in range(0, len(payload), _CHUNK):
+            writer.write(payload[i : i + _CHUNK])
+            await writer.drain()
+        await writer.drain()
+
+    async def _serve_store(self, writer, req: dict) -> None:
+        name = req.get("file", "")
+        if req.get("all_versions"):
+            versions = self.store.versions(name)
+            if not versions:
+                await self._reply(writer, {"ok": False, "error": "not found"})
+                return
+            blobs = []
+            for v in versions:
+                data, _ = self.store.get_bytes(name, v)
+                blobs.append((v, data))
+            header = {
+                "ok": True,
+                "versions": [[v, len(d)] for v, d in blobs],
+                "size": sum(len(d) for _, d in blobs),
+            }
+            await self._reply(writer, header, b"".join(d for _, d in blobs))
+            return
+        try:
+            data, v = self.store.get_bytes(name, req.get("version"))
+        except FileNotFoundError:
+            await self._reply(writer, {"ok": False, "error": "not found"})
+            return
+        await self._reply(writer, {"ok": True, "version": v, "size": len(data)}, data)
+
+    async def _serve_token(self, writer, req: dict) -> None:
+        path = self._exposed.get(req.get("token", ""))
+        if path is None or not os.path.isfile(path):
+            await self._reply(writer, {"ok": False, "error": "unknown token"})
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        await self._reply(writer, {"ok": True, "size": len(data)}, data)
+
+    # ---- client ----
+
+    @staticmethod
+    async def _rpc(addr: Tuple[str, int], req: dict, timeout: float = 30.0):
+        reader, writer = await asyncio.wait_for(asyncio.open_connection(*addr), timeout)
+        try:
+            writer.write(json.dumps(req).encode() + b"\n")
+            await writer.drain()
+            header = json.loads(await asyncio.wait_for(reader.readline(), timeout))
+            if not header.get("ok"):
+                return header, b""
+            payload = await asyncio.wait_for(
+                reader.readexactly(header.get("size", 0)), timeout
+            )
+            return header, payload
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def fetch_from_store(
+        self,
+        addr: Tuple[str, int],
+        name: str,
+        version: Optional[int] = None,
+        timeout: float = 30.0,
+    ) -> Tuple[bytes, int]:
+        """Pull one version (latest if None) from a remote node."""
+        header, payload = await self._rpc(
+            addr, {"op": "fetch_store", "file": name, "version": version}, timeout
+        )
+        if not header.get("ok"):
+            raise FileNotFoundError(f"{name} at {addr}: {header.get('error')}")
+        return payload, int(header["version"])
+
+    async def replicate_from(
+        self, addr: Tuple[str, int], name: str, timeout: float = 60.0
+    ) -> List[int]:
+        """Pull ALL versions of `name` from a live replica into the
+        local store (reference replicate_file, file_service.py:52-61)."""
+        header, payload = await self._rpc(
+            addr, {"op": "fetch_store", "file": name, "all_versions": True}, timeout
+        )
+        if not header.get("ok"):
+            raise FileNotFoundError(f"{name} at {addr}: {header.get('error')}")
+        got: List[int] = []
+        off = 0
+        for v, size in header["versions"]:
+            self.store.put_bytes(name, payload[off : off + size], version=int(v))
+            off += size
+            got.append(int(v))
+        return got
+
+    async def fetch_token_to_store(
+        self,
+        addr: Tuple[str, int],
+        token: str,
+        name: str,
+        version: int,
+        timeout: float = 60.0,
+    ) -> int:
+        """PUT path: pull the client's exposed file into the local store
+        at an explicit version (the leader assigns the version so all
+        replicas agree; the reference lets each replica pick its own
+        next version, which can skew)."""
+        header, payload = await self._rpc(addr, {"op": "fetch_token", "token": token}, timeout)
+        if not header.get("ok"):
+            raise FileNotFoundError(f"token at {addr}: {header.get('error')}")
+        return self.store.put_bytes(name, payload, version=version)

@@ -1,0 +1,150 @@
+"""Per-node versioned local file store.
+
+Replaces the reference's FileService (file_service.py:1-124): same
+on-disk contract — store root holding `name_versionN` files, newest
+`max_versions` kept, inventory reloadable after restart
+(file_service.py:23-33) — but transfers are handled by the TCP data
+plane, not asyncssh/scp.
+
+File names are sanitized into a flat namespace the way the reference's
+CLI usage implies (SDFS names are logical keys, not paths).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import re
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+_VERSION_RE = re.compile(r"^(?P<name>.+)_version(?P<v>\d+)$")
+
+
+def _safe(name: str) -> str:
+    """Logical SDFS name -> safe flat filename."""
+    if not name or name in (".", ".."):
+        raise ValueError(f"invalid sdfs name {name!r}")
+    return name.replace("/", "__")
+
+
+class LocalStore:
+    def __init__(self, root: str, max_versions: int = 5, cleanup_on_startup: bool = False):
+        self.root = os.path.abspath(os.path.expanduser(root))
+        self.max_versions = max_versions
+        if cleanup_on_startup and os.path.isdir(self.root):
+            shutil.rmtree(self.root)
+        os.makedirs(self.root, exist_ok=True)
+        # name -> sorted list of versions (reference
+        # load_files_from_directory, file_service.py:23-33)
+        self._files: Dict[str, List[int]] = {}
+        self.reload()
+
+    # ---- inventory ----
+
+    def reload(self) -> None:
+        self._files.clear()
+        for fn in os.listdir(self.root):
+            m = _VERSION_RE.match(fn)
+            if m:
+                self._files.setdefault(m.group("name"), []).append(int(m.group("v")))
+        for vs in self._files.values():
+            vs.sort()
+
+    def inventory(self) -> Dict[str, List[int]]:
+        return {k: list(v) for k, v in sorted(self._files.items())}
+
+    def has(self, name: str, version: Optional[int] = None) -> bool:
+        vs = self._files.get(_safe(name))
+        if not vs:
+            return False
+        return version is None or version in vs
+
+    def versions(self, name: str) -> List[int]:
+        return list(self._files.get(_safe(name), []))
+
+    def matching(self, pattern: str) -> List[str]:
+        return sorted(n for n in self._files if fnmatch.fnmatch(n, _safe(pattern)))
+
+    # ---- storage ----
+
+    def _path(self, name: str, version: int) -> str:
+        return os.path.join(self.root, f"{name}_version{version}")
+
+    def next_version(self, name: str) -> int:
+        vs = self._files.get(_safe(name))
+        return (vs[-1] + 1) if vs else 1
+
+    def put_bytes(self, name: str, data: bytes, version: Optional[int] = None) -> int:
+        """Store one version; prune to max_versions (reference
+        file_service.py:80-84 keeps the 5 newest)."""
+        name = _safe(name)
+        v = version if version is not None else self.next_version(name)
+        tmp = self._path(name, v) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._path(name, v))
+        vs = self._files.setdefault(name, [])
+        if v not in vs:
+            vs.append(v)
+            vs.sort()
+        self._prune(name)
+        return v
+
+    def put_file(self, name: str, src_path: str, version: Optional[int] = None) -> int:
+        with open(src_path, "rb") as f:
+            return self.put_bytes(name, f.read(), version)
+
+    def get_bytes(self, name: str, version: Optional[int] = None) -> Tuple[bytes, int]:
+        """Latest (or specific) version's content."""
+        name = _safe(name)
+        vs = self._files.get(name)
+        if not vs:
+            raise FileNotFoundError(name)
+        v = vs[-1] if version is None else version
+        if v not in vs:
+            raise FileNotFoundError(f"{name} version {v}")
+        with open(self._path(name, v), "rb") as f:
+            return f.read(), v
+
+    def get_path(self, name: str, version: Optional[int] = None) -> str:
+        name = _safe(name)
+        vs = self._files.get(name)
+        if not vs:
+            raise FileNotFoundError(name)
+        v = vs[-1] if version is None else version
+        if v not in vs:
+            raise FileNotFoundError(f"{name} version {v}")
+        return self._path(name, v)
+
+    def last_versions(self, name: str, count: int) -> List[Tuple[int, bytes]]:
+        """The `get-versions` verb: newest `count` versions, newest
+        first (reference worker.py:1834-1878)."""
+        name = _safe(name)
+        out = []
+        for v in reversed(self._files.get(name, [])[-count:]):
+            with open(self._path(name, v), "rb") as f:
+                out.append((v, f.read()))
+        return out
+
+    def delete(self, name: str) -> bool:
+        """Remove all versions (reference file_service.py:100-111)."""
+        name = _safe(name)
+        vs = self._files.pop(name, None)
+        if not vs:
+            return False
+        for v in vs:
+            try:
+                os.remove(self._path(name, v))
+            except FileNotFoundError:
+                pass
+        return True
+
+    def _prune(self, name: str) -> None:
+        vs = self._files.get(name, [])
+        while len(vs) > self.max_versions:
+            v = vs.pop(0)
+            try:
+                os.remove(self._path(name, v))
+            except FileNotFoundError:
+                pass

@@ -27,14 +27,22 @@ from .wire import Message
 class LossInjector:
     """Deterministic packet-drop schedule (reference protocol.py:25-29).
 
-    A pre-shuffled 100-slot bitmap with `pct` drop slots, cycled on
-    every send — the reference's exact scheme, but seedable.
+    A pre-shuffled slot bitmap with a `pct` fraction of drop slots,
+    cycled on every send — the reference's exact scheme (100 slots,
+    protocol.py:25-29) but seedable and at 0.01% resolution so
+    sub-1% rates don't silently round to zero.
     """
 
+    SLOTS = 10_000
+
     def __init__(self, pct: float, seed: int = 0):
+        if pct < 0 or pct > 100:
+            raise ValueError(f"drop pct {pct} out of range")
         self.pct = pct
-        n_drop = int(round(pct))
-        slots = [True] * n_drop + [False] * (100 - n_drop)
+        n_drop = int(round(pct * self.SLOTS / 100))
+        if pct > 0 and n_drop == 0:
+            raise ValueError(f"drop pct {pct} below {100 / self.SLOTS}% resolution")
+        slots = [True] * n_drop + [False] * (self.SLOTS - n_drop)
         random.Random(seed).shuffle(slots)
         self._slots = slots
         self._i = 0
@@ -84,11 +92,15 @@ class UdpTransport(asyncio.DatagramProtocol):
         testing: bool = False,
         drop_pct: float = 0.0,
         seed: int = 0,
+        reuse_port: bool = False,
     ) -> "UdpTransport":
+        # reuse_port defaults OFF: with it on, a port collision (e.g. a
+        # leftover process) silently splits inbound traffic between the
+        # two sockets instead of failing loudly with EADDRINUSE.
         loop = asyncio.get_running_loop()
         proto = cls(testing=testing, drop_pct=drop_pct, seed=seed)
         await loop.create_datagram_endpoint(
-            lambda: proto, local_addr=(host, port), reuse_port=True
+            lambda: proto, local_addr=(host, port), reuse_port=reuse_port or None
         )
         return proto
 

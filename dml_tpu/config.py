@@ -134,12 +134,20 @@ class ClusterSpec:
     packet_drop_pct: float = 0.0  # loss-injection seam (reference protocol.py:10)
 
     # ---- lookups (reference Config.get_node*, config.py:116-144) ----
+    # The node universe is static (like the reference's H1..H10 table),
+    # so lookup tables and the ring order are computed once.
+
+    def __post_init__(self):
+        self._by_unique = {n.unique_name: n for n in self.nodes}
+        self._ring = sorted(self.nodes, key=lambda n: (n.rank, n.host, n.port))
 
     def node_by_unique_name(self, unique_name: str) -> Optional[NodeId]:
-        for n in self.nodes:
-            if n.unique_name == unique_name:
-                return n
-        return None
+        return self._by_unique.get(unique_name)
+
+    def ring(self) -> List[NodeId]:
+        """The canonical ring order — the single definition consumed by
+        both `ring_successors` and membership ping-target repair."""
+        return self._ring
 
     def node_by_name(self, name: str) -> Optional[NodeId]:
         for n in self.nodes:
@@ -151,10 +159,9 @@ class ClusterSpec:
         """The k ring successors this node pings.
 
         Reference hand-writes this per node (config.py:67-89); we
-        compute it: sort nodes by (rank, host, port), each node pings
-        the next k in ring order.
+        compute it: each node pings the next k in `ring()` order.
         """
-        ring = sorted(self.nodes, key=lambda n: (n.rank, n.host, n.port))
+        ring = self.ring()
         if node not in ring:
             return []
         i = ring.index(node)

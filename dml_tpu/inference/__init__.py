@@ -1,0 +1,3 @@
+"""TPU inference engine: jit-compiled batched forward passes."""
+
+from .engine import InferenceEngine, InferenceResult  # noqa: F401

@@ -1,0 +1,226 @@
+"""The TPU inference engine.
+
+Replaces the reference's model executors (models.py:23-106): there, each
+batch forks a ProcessPoolExecutor worker that runs per-image CPU Keras
+`model.predict` calls (models.py:84-91) — process isolation because TF
+blocks the event loop, per-image loops because that's how the code
+grew. On TPU both constraints invert:
+
+- the forward pass is a single jitted XLA program over the *whole
+  batch* (MXU wants large batched matmuls, not 1-image convs)
+- batches are padded to a fixed shape so one compilation serves every
+  request — the reference emits ragged tail batches (worker.py:229-237)
+  which on TPU would trigger recompiles
+- JAX dispatch is async: the host enqueues the program and returns;
+  only the final host read blocks, and that runs in a thread via
+  `asyncio.to_thread`, so the control-plane event loop never stalls
+  (the reference needed a whole process pool for this)
+- model switch = pointing at a different resident params tree in HBM;
+  both models stay resident (~130 MB total, trivial next to 16 GB HBM),
+  so the scheduler's "preemption" costs nothing on the worker — the
+  reference kills the running task instead (worker.py:944-953)
+
+Engine methods are also the measurement source for the scheduler's
+analytical cost model (reference hardcodes CPU measurements,
+worker.py:57-89; we measure on the real device at warmup).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.labels import decode_predictions
+from ..models.params_io import init_variables
+from ..models.preprocess import load_images, normalize_on_device
+from ..models.registry import ModelSpec, get_model
+
+
+@dataclass
+class InferenceResult:
+    """Per-batch result (reference writes output_<job>_<batch>_<host>.json
+    with top-5 labels per file, models.py:109-126)."""
+
+    model: str
+    files: List[str]
+    top5: List[List[tuple]]  # per image: [(wnid, label, score) x5]
+    load_time: float  # host decode+resize seconds
+    infer_time: float  # device seconds (incl. padding waste)
+    batch_padded_to: int
+
+    def to_json_dict(self) -> Dict[str, Any]:
+        return {
+            f: [
+                {"wnid": w, "label": l, "score": s}
+                for (w, l, s) in t
+            ]
+            for f, t in zip(self.files, self.top5)
+        }
+
+
+@dataclass
+class _LoadedModel:
+    spec: ModelSpec
+    variables: Any
+    forward: Any  # jitted fn(variables, uint8 batch) -> probs f32
+    batch_size: int
+    num_classes: int
+    load_time: float = 0.0
+    first_query: float = 0.0
+    per_query: float = 0.0
+
+
+class InferenceEngine:
+    """Holds every registered model resident on device; serves batches.
+
+    One engine per worker process. `dtype` is the on-device compute
+    precision (bfloat16 by default: MXU-native).
+    """
+
+    def __init__(self, dtype=jnp.bfloat16, device: Optional[jax.Device] = None):
+        self.dtype = dtype
+        self.device = device or jax.devices()[0]
+        self._models: Dict[str, _LoadedModel] = {}
+
+    # ---- loading ----
+
+    def load_model(
+        self,
+        name: str,
+        variables: Any = None,
+        batch_size: Optional[int] = None,
+        seed: int = 0,
+        warmup: bool = True,
+    ) -> _LoadedModel:
+        """Build + place params in HBM + compile the batched forward.
+
+        `variables` may come from a checkpoint (params_io) distributed
+        through the replicated store; default is deterministic init.
+        """
+        spec = get_model(name)
+        key = spec.name
+        if key in self._models:
+            cached = self._models[key]
+            if variables is None and batch_size in (None, cached.batch_size):
+                return cached
+            # explicit new weights or batch size: rebuild, don't silently
+            # serve the stale entry
+            del self._models[key]
+        t0 = time.monotonic()
+        if variables is None:
+            variables = init_variables(spec, seed=seed, dtype=self.dtype)
+        variables = jax.device_put(variables, self.device)
+        model = spec.build(dtype=self.dtype)
+
+        def fwd(vs, batch_u8):
+            x = normalize_on_device(batch_u8, spec.preprocess, self.dtype)
+            return model.apply(vs, x, train=False)
+
+        forward = jax.jit(fwd)
+        pred = variables["params"]["predictions"]["bias"]
+        lm = _LoadedModel(
+            spec=spec,
+            variables=variables,
+            forward=forward,
+            batch_size=batch_size or spec.cost.default_batch_size,
+            num_classes=int(pred.shape[-1]),
+        )
+        lm.load_time = time.monotonic() - t0
+        self._models[key] = lm
+        if warmup:
+            self._warmup(lm)
+        return lm
+
+    def _warmup(self, lm: _LoadedModel) -> None:
+        """Compile at the configured batch size and measure the cost
+        model's constants on the real device."""
+        dummy = jnp.zeros((lm.batch_size, *lm.spec.input_size, 3), jnp.uint8)
+        dummy = jax.device_put(dummy, self.device)
+        t0 = time.monotonic()
+        jax.block_until_ready(lm.forward(lm.variables, dummy))
+        lm.first_query = time.monotonic() - t0
+        t0 = time.monotonic()
+        jax.block_until_ready(lm.forward(lm.variables, dummy))
+        steady_batch = time.monotonic() - t0
+        lm.per_query = steady_batch / lm.batch_size
+
+    def set_batch_size(self, name: str, batch_size: int) -> None:
+        """C3 verb (reference SET_BATCH_SIZE, worker.py:1028-1037).
+        Triggers one recompile at the new shape on next use."""
+        lm = self._require(name)
+        lm.batch_size = batch_size
+        self._warmup(lm)
+
+    def cost_constants(self, name: str) -> Dict[str, float]:
+        lm = self._require(name)
+        return {
+            "load_time": lm.load_time,
+            "first_query": lm.first_query,
+            "per_query": lm.per_query,
+            "batch_size": lm.batch_size,
+        }
+
+    def _require(self, name: str) -> _LoadedModel:
+        key = get_model(name).name
+        if key not in self._models:
+            raise KeyError(f"model {key} not loaded")
+        return self._models[key]
+
+    # ---- serving ----
+
+    def infer_arrays(self, name: str, images_u8: np.ndarray) -> np.ndarray:
+        """uint8 (N,H,W,3) -> float32 probs (N,1000). Pads N up to the
+        compiled batch size (static shapes; one XLA program)."""
+        lm = self._require(name)
+        n = images_u8.shape[0]
+        if n == 0:
+            return np.zeros((0, lm.num_classes), np.float32)
+        bs = lm.batch_size
+        out: List[np.ndarray] = []
+        for start in range(0, n, bs):
+            chunk = images_u8[start : start + bs]
+            pad = bs - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad, *chunk.shape[1:]), np.uint8)]
+                )
+            probs = lm.forward(lm.variables, jax.device_put(chunk, self.device))
+            out.append(np.asarray(probs[: bs - pad if pad else bs]))
+        return np.concatenate(out)[:n]
+
+    def infer_files(self, name: str, files: Sequence[str], top: int = 5) -> InferenceResult:
+        """The reference's perform_inference(model, files) equivalent
+        (models.py:74-91): decode on host, forward on TPU, top-k."""
+        lm = self._require(name)
+        t0 = time.monotonic()
+        imgs = load_images(files, lm.spec.input_size)
+        load_time = time.monotonic() - t0
+        t0 = time.monotonic()
+        probs = self.infer_arrays(name, imgs)
+        infer_time = time.monotonic() - t0
+        return InferenceResult(
+            model=lm.spec.name,
+            files=[str(f) for f in files],
+            top5=decode_predictions(probs, top=top),
+            load_time=load_time,
+            infer_time=infer_time,
+            batch_padded_to=lm.batch_size,
+        )
+
+    async def infer_files_async(
+        self, name: str, files: Sequence[str], top: int = 5
+    ) -> InferenceResult:
+        """Non-blocking wrapper for the worker's event loop: host decode
+        and the blocking device sync run in a thread (the reference used
+        a ProcessPoolExecutor for the same reason, models.py:84-91)."""
+        return await asyncio.to_thread(self.infer_files, name, files, top)
+
+    @property
+    def loaded_models(self) -> List[str]:
+        return sorted(self._models)

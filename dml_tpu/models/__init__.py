@@ -1,0 +1,11 @@
+"""Model zoo: Flax definitions of the reference's model families.
+
+The reference serves pretrained Keras ResNet50 and InceptionV3 on CPU
+(models.py:23-71). Here the same architectures are defined in Flax with
+Keras-compatible layer names so imagenet weights convert 1:1 when a
+weights file is available (`params_io.from_keras_model`), and the
+forward pass is jit-compiled for TPU: NHWC, bfloat16 compute, fixed
+batch shapes.
+"""
+
+from .registry import MODEL_REGISTRY, ModelSpec, get_model  # noqa: F401

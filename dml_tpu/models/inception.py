@@ -1,0 +1,120 @@
+"""InceptionV3 in Flax (Keras-graph-compatible).
+
+Replaces the reference's CPU Keras InceptionV3 executor (reference
+models.py:23-46). The graph follows keras.applications.inception_v3
+module-for-module — stem, mixed0..mixed10, global-average-pool head —
+with conv/BN layers named by creation order (`conv2d_{i}`,
+`batch_normalization_{i}`) so `params_io.from_keras_model` can map
+imagenet weights positionally. Keras conventions kept: convs have no
+bias, BN has no scale (gamma), BN epsilon 1e-3.
+
+TPU notes: NHWC, static 299x299 input, `dtype=bfloat16` for MXU
+compute with float32 params and a float32 classifier head.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        counter = [0]
+
+        def cbn(y, filters, h, w, strides=1, padding="SAME"):
+            i = counter[0]
+            counter[0] += 1
+            y = nn.Conv(
+                filters, (h, w), strides=strides, padding=padding,
+                use_bias=False, dtype=self.dtype, name=f"conv2d_{i}",
+            )(y)
+            y = nn.BatchNorm(
+                use_running_average=not train, epsilon=1e-3, momentum=0.99,
+                use_scale=False, dtype=self.dtype,
+                name=f"batch_normalization_{i}",
+            )(y)
+            return nn.relu(y)
+
+        def maxpool(y, size=3, stride=2, padding="VALID"):
+            return nn.max_pool(y, (size, size), strides=(stride, stride), padding=padding)
+
+        def avgpool3(y):
+            # count_include_pad=False: TF/Keras SAME-padded average
+            # pooling divides by the number of *valid* cells at borders
+            return nn.avg_pool(
+                y, (3, 3), strides=(1, 1), padding="SAME", count_include_pad=False
+            )
+
+        # ---- stem ----
+        x = cbn(x, 32, 3, 3, strides=2, padding="VALID")
+        x = cbn(x, 32, 3, 3, padding="VALID")
+        x = cbn(x, 64, 3, 3)
+        x = maxpool(x)
+        x = cbn(x, 80, 1, 1, padding="VALID")
+        x = cbn(x, 192, 3, 3, padding="VALID")
+        x = maxpool(x)
+
+        # ---- mixed 0, 1, 2 (35x35) ----
+        for pool_filters in (32, 64, 64):
+            b1 = cbn(x, 64, 1, 1)
+            b5 = cbn(x, 48, 1, 1)
+            b5 = cbn(b5, 64, 5, 5)
+            b3d = cbn(x, 64, 1, 1)
+            b3d = cbn(b3d, 96, 3, 3)
+            b3d = cbn(b3d, 96, 3, 3)
+            bp = cbn(avgpool3(x), pool_filters, 1, 1)
+            x = jnp.concatenate([b1, b5, b3d, bp], axis=-1)
+
+        # ---- mixed 3 (reduce to 17x17) ----
+        b3 = cbn(x, 384, 3, 3, strides=2, padding="VALID")
+        b3d = cbn(x, 64, 1, 1)
+        b3d = cbn(b3d, 96, 3, 3)
+        b3d = cbn(b3d, 96, 3, 3, strides=2, padding="VALID")
+        x = jnp.concatenate([b3, b3d, maxpool(x)], axis=-1)
+
+        # ---- mixed 4..7 (17x17, factorized 7x7) ----
+        for c7 in (128, 160, 160, 192):
+            b1 = cbn(x, 192, 1, 1)
+            b7 = cbn(x, c7, 1, 1)
+            b7 = cbn(b7, c7, 1, 7)
+            b7 = cbn(b7, 192, 7, 1)
+            b7d = cbn(x, c7, 1, 1)
+            b7d = cbn(b7d, c7, 7, 1)
+            b7d = cbn(b7d, c7, 1, 7)
+            b7d = cbn(b7d, c7, 7, 1)
+            b7d = cbn(b7d, 192, 1, 7)
+            bp = cbn(avgpool3(x), 192, 1, 1)
+            x = jnp.concatenate([b1, b7, b7d, bp], axis=-1)
+
+        # ---- mixed 8 (reduce to 8x8) ----
+        b3 = cbn(x, 192, 1, 1)
+        b3 = cbn(b3, 320, 3, 3, strides=2, padding="VALID")
+        b7x3 = cbn(x, 192, 1, 1)
+        b7x3 = cbn(b7x3, 192, 1, 7)
+        b7x3 = cbn(b7x3, 192, 7, 1)
+        b7x3 = cbn(b7x3, 192, 3, 3, strides=2, padding="VALID")
+        x = jnp.concatenate([b3, b7x3, maxpool(x)], axis=-1)
+
+        # ---- mixed 9, 10 (8x8, expanded filter banks) ----
+        for _ in range(2):
+            b1 = cbn(x, 320, 1, 1)
+            b3 = cbn(x, 384, 1, 1)
+            b3 = jnp.concatenate([cbn(b3, 384, 1, 3), cbn(b3, 384, 3, 1)], axis=-1)
+            b3d = cbn(x, 448, 1, 1)
+            b3d = cbn(b3d, 384, 3, 3)
+            b3d = jnp.concatenate([cbn(b3d, 384, 1, 3), cbn(b3d, 384, 3, 1)], axis=-1)
+            bp = cbn(avgpool3(x), 192, 1, 1)
+            x = jnp.concatenate([b1, b3, b3d, bp], axis=-1)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = x.astype(jnp.float32)
+        x = nn.Dense(self.num_classes, name="predictions")(x)
+        return nn.softmax(x, axis=-1)

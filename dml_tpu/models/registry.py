@@ -1,0 +1,98 @@
+"""Model registry: pluggable model families.
+
+The reference hardwires exactly two models behind string dispatch
+(models.py:74-91 branches on "InceptionV3"/"ResNet50"; scheduler state
+is twinned per model, worker.py:57-89). Here a model is a registry
+entry — adding a family (e.g. EfficientNet) is one `register()` call
+and the scheduler/engine pick it up untouched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class CostDefaults:
+    """Seed values for the scheduler's analytical cost model (reference
+    ModelParameters, models.py:128-139; constants worker.py:57-89).
+    These are *priors* — the engine re-measures on the actual TPU and
+    the scheduler uses the measured values (the reference hardcodes its
+    CPU measurements)."""
+
+    load_time: float
+    first_query: float
+    per_query: float
+    download_time: float = 0.05
+    default_batch_size: int = 32
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    builder: Callable[..., Any]  # (num_classes, dtype) -> nn.Module
+    input_size: Tuple[int, int]
+    preprocess: str  # normalize_on_device mode
+    cost: CostDefaults
+    aliases: Tuple[str, ...] = ()
+
+    def build(self, dtype=jnp.bfloat16, num_classes: int = 1000):
+        return self.builder(num_classes=num_classes, dtype=dtype)
+
+
+MODEL_REGISTRY: Dict[str, ModelSpec] = {}
+
+
+def register(spec: ModelSpec) -> ModelSpec:
+    MODEL_REGISTRY[spec.name.lower()] = spec
+    for a in spec.aliases:
+        MODEL_REGISTRY[a.lower()] = spec
+    return spec
+
+
+def get_model(name: str) -> ModelSpec:
+    try:
+        return MODEL_REGISTRY[name.lower()]
+    except KeyError:
+        raise KeyError(
+            f"unknown model {name!r}; registered: {sorted(set(s.name for s in MODEL_REGISTRY.values()))}"
+        ) from None
+
+
+def _register_builtin() -> None:
+    from .inception import InceptionV3
+    from .resnet import ResNet50
+
+    register(
+        ModelSpec(
+            name="ResNet50",
+            builder=lambda num_classes=1000, dtype=jnp.bfloat16: ResNet50(
+                num_classes=num_classes, dtype=dtype
+            ),
+            input_size=(224, 224),
+            preprocess="caffe",
+            # reference CPU priors: load 3.5s / first 1s / per-image 0.25s
+            # (worker.py:74); TPU re-measures far smaller values
+            cost=CostDefaults(load_time=3.5, first_query=1.0, per_query=0.25),
+            aliases=("resnet", "resnet-50"),
+        )
+    )
+    register(
+        ModelSpec(
+            name="InceptionV3",
+            builder=lambda num_classes=1000, dtype=jnp.bfloat16: InceptionV3(
+                num_classes=num_classes, dtype=dtype
+            ),
+            input_size=(299, 299),
+            preprocess="tf",
+            # reference CPU priors: 5.6s / 2s / 0.325s (worker.py:61)
+            cost=CostDefaults(load_time=5.6, first_query=2.0, per_query=0.325),
+            aliases=("inception", "inception-v3"),
+        )
+    )
+
+
+_register_builtin()

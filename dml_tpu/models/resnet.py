@@ -1,0 +1,112 @@
+"""ResNet-v1 family in Flax (Keras-graph-compatible ResNet50).
+
+Replaces the reference's CPU Keras ResNet50 executor (reference
+models.py:48-71). Architecture and layer naming follow
+keras.applications.resnet.ResNet50 exactly — 7x7/2 stem with explicit
+3-pixel zero padding, bottleneck blocks with the stride on the first
+1x1 conv (the Caffe variant), BN epsilon 1.001e-5 — so that
+`params_io.from_keras_model` can map imagenet weights name-for-name.
+
+Compute notes for TPU: NHWC layout (XLA's native conv layout on TPU),
+`dtype` selects the activation/compute precision (bfloat16 for the MXU
+path; params stay float32), and all shapes are static so one jit
+compilation serves every batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+BN_EPS = 1.001e-5
+
+
+def _bottleneck(mdl, x, filters, stride, conv_shortcut, prefix, train):
+    """Keras `block1`-style bottleneck: 1x1 (stride) -> 3x3 -> 1x1*4.
+
+    A plain function, not a submodule: layers created here attach
+    directly to the parent ResNet module, keeping the params tree FLAT
+    with Keras-identical names (`conv2_block1_1_conv`, ...) so
+    `params_io.from_keras_model` maps weights name-for-name.
+    """
+    conv = partial(nn.Conv, use_bias=True, dtype=mdl.dtype)
+    bn = partial(
+        nn.BatchNorm,
+        use_running_average=not train,
+        epsilon=BN_EPS,
+        momentum=0.99,
+        dtype=mdl.dtype,
+    )
+    p = prefix
+    if conv_shortcut:
+        sc = conv(4 * filters, (1, 1), strides=stride, name=f"{p}_0_conv")(x)
+        sc = bn(name=f"{p}_0_bn")(sc)
+    else:
+        sc = x
+    y = conv(filters, (1, 1), strides=stride, name=f"{p}_1_conv")(x)
+    y = bn(name=f"{p}_1_bn")(y)
+    y = nn.relu(y)
+    y = conv(filters, (3, 3), padding="SAME", name=f"{p}_2_conv")(y)
+    y = bn(name=f"{p}_2_bn")(y)
+    y = nn.relu(y)
+    y = conv(4 * filters, (1, 1), name=f"{p}_3_conv")(y)
+    y = bn(name=f"{p}_3_bn")(y)
+    return nn.relu(sc + y)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1 with bottleneck blocks (50/101/152 by `depths`)."""
+
+    depths: Sequence[int] = (3, 4, 6, 3)  # ResNet50
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        # stem: ZeroPadding2D((3,3)) + valid 7x7/2 (keras conv1_pad/conv1_conv)
+        x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+        x = nn.Conv(
+            64, (7, 7), strides=2, padding="VALID", use_bias=True,
+            dtype=self.dtype, name="conv1_conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, epsilon=BN_EPS, momentum=0.99,
+            dtype=self.dtype, name="conv1_bn",
+        )(x)
+        x = nn.relu(x)
+        # pool1_pad + 3x3/2 valid maxpool
+        x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=-jnp.inf)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        filters = 64
+        for stage, blocks in enumerate(self.depths, start=2):
+            for b in range(1, blocks + 1):
+                stride = 1 if (stage == 2 or b > 1) else 2
+                x = _bottleneck(
+                    self, x, filters, stride,
+                    conv_shortcut=(b == 1),
+                    prefix=f"conv{stage}_block{b}",
+                    train=train,
+                )
+            filters *= 2
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = x.astype(jnp.float32)  # classifier head in f32 for stable softmax
+        x = nn.Dense(self.num_classes, name="predictions")(x)
+        return nn.softmax(x, axis=-1)
+
+
+def ResNet50(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(depths=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+
+
+def ResNet101(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(depths=(3, 4, 23, 3), num_classes=num_classes, dtype=dtype)
+
+
+def ResNet152(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(depths=(3, 8, 36, 3), num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,58 @@
+"""A tiny CNN registered as a test model so engine/scheduler tests
+don't pay ResNet-scale XLA compiles on this 1-core CPU machine."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dml_tpu.models.registry import MODEL_REGISTRY, CostDefaults, ModelSpec, register
+
+
+class TinyNet(nn.Module):
+    num_classes: int = 1000
+    dtype: object = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(8, (3, 3), strides=2, name="c1", dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(16, (3, 3), strides=2, name="c2", dtype=self.dtype)(x))
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        x = nn.Dense(self.num_classes, name="predictions")(x)
+        return nn.softmax(x, axis=-1)
+
+
+def ensure_tinynet() -> ModelSpec:
+    if "tinynet" in MODEL_REGISTRY:
+        return MODEL_REGISTRY["tinynet"]
+    return register(
+        ModelSpec(
+            name="TinyNet",
+            builder=lambda num_classes=1000, dtype=jnp.float32: TinyNet(
+                num_classes=num_classes, dtype=dtype
+            ),
+            input_size=(32, 32),
+            preprocess="unit",
+            cost=CostDefaults(
+                load_time=0.1, first_query=0.1, per_query=0.01, default_batch_size=4
+            ),
+        )
+    )
+
+
+def ensure_tinynet2() -> ModelSpec:
+    """A second tiny model for dual-model fair-share scheduler tests."""
+    if "tinynet2" in MODEL_REGISTRY:
+        return MODEL_REGISTRY["tinynet2"]
+    return register(
+        ModelSpec(
+            name="TinyNet2",
+            builder=lambda num_classes=1000, dtype=jnp.float32: TinyNet(
+                num_classes=num_classes, dtype=dtype
+            ),
+            input_size=(24, 24),
+            preprocess="unit",
+            cost=CostDefaults(
+                load_time=0.2, first_query=0.2, per_query=0.02, default_batch_size=4
+            ),
+        )
+    )

@@ -8,16 +8,39 @@ reserved for bench.py).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment sets JAX_PLATFORMS=axon (the real
+# TPU tunnel) — tests must never compete for the single real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize gate (already ran)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Persistent compilation cache: this machine has a single CPU core, so
+# XLA graph compiles dominate test time; cache them across sessions.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dml_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 import asyncio
 import inspect
+
+# The axon sitecustomize registers the TPU backend in *every*
+# interpreter and its get_backend hook force-initializes it (dialing
+# the tunnel) even under JAX_PLATFORMS=cpu. Deregister the factory
+# before any backend init so tests are hermetic CPU.
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    # sitecustomize imported jax at interpreter start, so the config
+    # captured JAX_PLATFORMS=axon before our env override — fix it.
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -1,0 +1,67 @@
+import asyncio
+
+import numpy as np
+import pytest
+
+from dml_tpu.inference import InferenceEngine
+
+from _tinynet import ensure_tinynet
+
+
+@pytest.fixture(scope="module")
+def engine():
+    ensure_tinynet()
+    eng = InferenceEngine()
+    eng.load_model("TinyNet", batch_size=4)
+    return eng
+
+
+def test_load_and_cost_constants(engine):
+    c = engine.cost_constants("TinyNet")
+    assert c["batch_size"] == 4
+    assert c["per_query"] > 0 and c["first_query"] > 0
+    assert engine.loaded_models == ["TinyNet"]
+
+
+def test_infer_arrays_pads_and_chunks(engine):
+    imgs = np.random.default_rng(0).integers(0, 255, (5, 32, 32, 3), np.uint8)
+    probs = engine.infer_arrays("TinyNet", imgs)
+    assert probs.shape == (5, 1000)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+    # padded results must equal unpadded results image-for-image
+    probs1 = engine.infer_arrays("TinyNet", imgs[:1])
+    np.testing.assert_allclose(probs[:1], probs1, rtol=2e-4, atol=1e-6)
+    assert engine.infer_arrays("TinyNet", imgs[:0]).shape == (0, 1000)
+
+
+def test_infer_files_and_async(engine, tmp_path):
+    from PIL import Image
+
+    files = []
+    rng = np.random.default_rng(1)
+    for i in range(3):
+        p = tmp_path / f"img{i}.jpeg"
+        Image.fromarray(rng.integers(0, 255, (40, 40, 3), np.uint8)).save(p)
+        files.append(str(p))
+    res = engine.infer_files("TinyNet", files)
+    assert res.files == files
+    assert len(res.top5) == 3 and len(res.top5[0]) == 5
+    d = res.to_json_dict()
+    assert set(d) == set(files)
+    assert {"wnid", "label", "score"} == set(d[files[0]][0])
+
+    res2 = asyncio.run(engine.infer_files_async("TinyNet", files))
+    assert res2.files == files
+
+
+def test_set_batch_size(engine):
+    engine.set_batch_size("TinyNet", 2)
+    assert engine.cost_constants("TinyNet")["batch_size"] == 2
+    imgs = np.zeros((3, 32, 32, 3), np.uint8)
+    assert engine.infer_arrays("TinyNet", imgs).shape == (3, 1000)
+    engine.set_batch_size("TinyNet", 4)
+
+
+def test_unloaded_model_raises(engine):
+    with pytest.raises(KeyError):
+        engine.cost_constants("InceptionV3")

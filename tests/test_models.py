@@ -1,0 +1,82 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dml_tpu.models import get_model
+from dml_tpu.models.labels import class_index, decode_predictions
+from dml_tpu.models.preprocess import decode_image, load_images, normalize_on_device
+
+# Small spatial inputs keep CPU compile+compute fast; parameter shapes
+# and graph structure are identical to deployment sizes (224/299).
+SMALL = {"ResNet50": (64, 64), "InceptionV3": (75, 75)}
+
+
+@pytest.mark.parametrize("name", ["ResNet50", "InceptionV3"])
+def test_forward_shape_and_probs(name):
+    spec = get_model(name)
+    model = spec.build(dtype=jnp.float32)
+    x = jnp.zeros((2, *SMALL[name], 3), jnp.float32)
+    variables = jax.jit(lambda: model.init(jax.random.PRNGKey(0), x, train=False))()
+    y = jax.jit(lambda v, a: model.apply(v, a, train=False))(variables, x)
+    assert y.shape == (2, 1000)
+    np.testing.assert_allclose(np.sum(np.asarray(y), axis=-1), 1.0, rtol=1e-4)
+
+
+def test_registry_aliases_and_unknown():
+    assert get_model("resnet").name == "ResNet50"
+    assert get_model("inception-v3").name == "InceptionV3"
+    with pytest.raises(KeyError):
+        get_model("nope")
+
+
+def test_deterministic_init():
+    from dml_tpu.models.params_io import init_variables
+
+    spec = get_model("ResNet50")
+    v1 = init_variables(spec, seed=7, dtype=jnp.float32, image_size=(64, 64))
+    v2 = init_variables(spec, seed=7, dtype=jnp.float32, image_size=(64, 64))
+    assert jax.tree_util.tree_all(
+        jax.tree_util.tree_map(lambda a, b: bool(jnp.all(a == b)), v1, v2)
+    )
+    # param shapes are independent of the init image size
+    v3 = init_variables(spec, seed=7, dtype=jnp.float32, image_size=(96, 96))
+    assert jax.tree_util.tree_structure(v1) == jax.tree_util.tree_structure(v3)
+
+
+def test_normalize_modes():
+    x = jnp.full((1, 4, 4, 3), 255, jnp.uint8)
+    tf_out = normalize_on_device(x, "tf")
+    np.testing.assert_allclose(np.asarray(tf_out), 1.0, atol=1e-6)
+    caffe = np.asarray(normalize_on_device(x, "caffe"))
+    # channel 0 after BGR flip is B: 255 - 103.939
+    np.testing.assert_allclose(caffe[..., 0], 255 - 103.939, rtol=1e-5)
+    unit = np.asarray(normalize_on_device(x, "unit"))
+    np.testing.assert_allclose(unit, 1.0, atol=1e-6)
+    with pytest.raises(ValueError):
+        normalize_on_device(x, "bogus")
+
+
+def test_decode_and_load_images(tmp_path):
+    from PIL import Image
+
+    img = Image.fromarray(np.random.default_rng(0).integers(0, 255, (64, 48, 3), np.uint8))
+    p = tmp_path / "a.jpeg"
+    img.save(p)
+    arr = load_images([str(p), str(p)], (224, 224))
+    assert arr.shape == (2, 224, 224, 3) and arr.dtype == np.uint8
+    with open(p, "rb") as f:
+        one = decode_image(f.read(), (299, 299))
+    assert one.shape == (299, 299, 3)
+
+
+def test_decode_predictions_format():
+    probs = np.zeros((1, 1000), np.float32)
+    probs[0, 42] = 0.9
+    probs[0, 7] = 0.1
+    top = decode_predictions(probs, top=5)
+    assert len(top[0]) == 5
+    assert top[0][0][2] == pytest.approx(0.9)
+    table = class_index()
+    assert len(table) == 1000
+    assert top[0][0][1] == table[42][1]

@@ -1,0 +1,162 @@
+import asyncio
+
+import pytest
+
+from dml_tpu.cluster.store import DataPlane, LocalStore, StoreMetadata
+
+
+# ---------------- LocalStore ----------------
+
+def test_versioning_and_prune(tmp_path):
+    s = LocalStore(str(tmp_path / "store"), max_versions=3)
+    for i in range(5):
+        v = s.put_bytes("a.txt", f"v{i}".encode())
+        assert v == i + 1
+    assert s.versions("a.txt") == [3, 4, 5]  # pruned to newest 3
+    data, v = s.get_bytes("a.txt")
+    assert (data, v) == (b"v4", 5)
+    data, _ = s.get_bytes("a.txt", version=3)
+    assert data == b"v2"
+    with pytest.raises(FileNotFoundError):
+        s.get_bytes("a.txt", version=1)
+
+
+def test_reload_from_disk(tmp_path):
+    root = str(tmp_path / "store")
+    s = LocalStore(root)
+    s.put_bytes("x.jpeg", b"img")
+    s.put_bytes("x.jpeg", b"img2")
+    s2 = LocalStore(root)  # restart (reference file_service.py:23-33)
+    assert s2.versions("x.jpeg") == [1, 2]
+    assert s2.get_bytes("x.jpeg")[0] == b"img2"
+    s3 = LocalStore(root, cleanup_on_startup=True)
+    assert s3.inventory() == {}
+
+
+def test_matching_delete_last_versions(tmp_path):
+    s = LocalStore(str(tmp_path))
+    for n in ("out_1_0.json", "out_1_1.json", "img.jpeg"):
+        s.put_bytes(n, b"data")
+    assert s.matching("out_1_*.json") == ["out_1_0.json", "out_1_1.json"]
+    assert s.delete("img.jpeg") is True
+    assert s.delete("img.jpeg") is False
+    assert not s.has("img.jpeg")
+    s.put_bytes("v.txt", b"1")
+    s.put_bytes("v.txt", b"2")
+    s.put_bytes("v.txt", b"3")
+    assert s.last_versions("v.txt", 2) == [(3, b"3"), (2, b"2")]
+
+
+def test_name_sanitization(tmp_path):
+    s = LocalStore(str(tmp_path))
+    s.put_bytes("dir/file.txt", b"x")
+    assert s.has("dir/file.txt")
+    with pytest.raises(ValueError):
+        s.put_bytes("", b"x")
+
+
+# ---------------- StoreMetadata ----------------
+
+def test_placement_deterministic_and_distinct():
+    md = StoreMetadata(replication_factor=4)
+    live = [f"n{i}:1" for i in range(10)]
+    p1 = md.place("file.jpeg", live)
+    p2 = md.place("file.jpeg", live)
+    assert p1 == p2 and len(set(p1)) == 4
+    # existing replicas preferred
+    md.record_replica("n3:1", "file.jpeg", 1)
+    assert md.place("file.jpeg", live)[0] == "n3:1"
+    # fewer live nodes than k
+    assert len(md.place("f2", live[:2])) == 2
+    assert md.place("f3", []) == []
+
+
+def test_inventory_merge_and_queries():
+    md = StoreMetadata()
+    md.set_node_inventory("a:1", {"x.jpeg": [1, 2], "y.jpeg": [1]})
+    md.set_node_inventory("b:1", {"x.jpeg": [1, 2, 3]})
+    assert md.replicas_of("x.jpeg") == ["a:1", "b:1"]
+    assert md.latest_version("x.jpeg") == 3
+    assert md.all_files() == ["x.jpeg", "y.jpeg"]
+    assert md.matching("*.jpeg") == ["x.jpeg", "y.jpeg"]
+    assert md.matching("y*") == ["y.jpeg"]
+    md.remove_file("x.jpeg")
+    assert md.all_files() == ["y.jpeg"]
+
+
+def test_request_tracking_and_repair():
+    md = StoreMetadata()
+    rid = md.new_request("put", "f", "client:1", ["a:1", "b:1"], version=2)
+    st = md.get_request(rid)
+    assert st.pending_nodes == ["a:1", "b:1"] and not st.completed
+    st.set_status("a:1", "ok")
+    assert not st.completed
+    assert md.requests_involving("b:1") == [(rid, st)]
+    st.set_status("b:1", "ok")
+    assert st.completed
+    md.finish_request(rid)
+    assert md.get_request(rid) is None
+
+
+def test_replication_plan():
+    md = StoreMetadata(replication_factor=3)
+    live = ["a:1", "b:1", "c:1", "d:1"]
+    for n in ("a:1", "b:1", "c:1"):
+        md.record_replica(n, "f.jpeg", 1)
+    # fully replicated -> no plan
+    assert md.replication_plan(live) == []
+    # b and c die -> plan copies from a to 2 new nodes
+    md.drop_node("b:1")
+    md.drop_node("c:1")
+    plan = md.replication_plan(["a:1", "d:1"])
+    assert plan == [("f.jpeg", "a:1", ["d:1"])]
+    # total loss -> nothing to copy from
+    md.drop_node("a:1")
+    assert md.replication_plan(["d:1"]) == []
+
+
+# ---------------- DataPlane ----------------
+
+@pytest.mark.asyncio
+async def test_data_plane_put_get_replicate(tmp_path):
+    a = DataPlane(LocalStore(str(tmp_path / "a")), "127.0.0.1")
+    b = DataPlane(LocalStore(str(tmp_path / "b")), "127.0.0.1")
+    client = DataPlane(LocalStore(str(tmp_path / "c")), "127.0.0.1")
+    for dp in (a, b, client):
+        await dp.start()
+    try:
+        # PUT: client exposes a local file, replica pulls by token
+        src = tmp_path / "local.jpeg"
+        src.write_bytes(b"JPEGDATA" * 100)
+        token = client.expose(str(src))
+        v = await a.fetch_token_to_store(
+            ("127.0.0.1", client.port), token, "img.jpeg", version=1
+        )
+        assert v == 1 and a.store.get_bytes("img.jpeg")[0] == src.read_bytes()
+
+        # GET: pull latest from a into raw bytes
+        data, v = await b.fetch_from_store(("127.0.0.1", a.port), "img.jpeg")
+        assert data == src.read_bytes() and v == 1
+
+        # REPLICATE: all versions
+        a.store.put_bytes("img.jpeg", b"v2data", version=2)
+        got = await b.replicate_from(("127.0.0.1", a.port), "img.jpeg")
+        assert got == [1, 2]
+        assert b.store.get_bytes("img.jpeg", 2)[0] == b"v2data"
+
+        # missing file / bad token
+        with pytest.raises(FileNotFoundError):
+            await b.fetch_from_store(("127.0.0.1", a.port), "nope")
+        with pytest.raises(FileNotFoundError):
+            await a.fetch_token_to_store(
+                ("127.0.0.1", client.port), "badtoken", "x", version=1
+            )
+        # token revoked after unexpose
+        client.unexpose(token)
+        with pytest.raises(FileNotFoundError):
+            await a.fetch_token_to_store(
+                ("127.0.0.1", client.port), token, "img2.jpeg", version=1
+            )
+    finally:
+        for dp in (a, b, client):
+            await dp.stop()

@@ -7,12 +7,22 @@ from dml_tpu.cluster.wire import Message, MsgType
 
 
 def test_loss_injector_deterministic():
+    n = LossInjector.SLOTS
     li = LossInjector(3.0, seed=42)
-    drops = [li.should_drop() for _ in range(100)]
-    assert sum(drops) == 3
+    drops = [li.should_drop() for _ in range(n)]
+    assert sum(drops) == int(n * 0.03)
     li2 = LossInjector(3.0, seed=42)
-    assert [li2.should_drop() for _ in range(100)] == drops
+    assert [li2.should_drop() for _ in range(n)] == drops
     assert not any(LossInjector(0.0).should_drop() for _ in range(50))
+    # sub-1% rates are honored, not silently rounded to zero
+    li_half = LossInjector(0.5, seed=1)
+    assert sum(li_half.should_drop() for _ in range(n)) == int(n * 0.005)
+    import pytest
+
+    with pytest.raises(ValueError):
+        LossInjector(0.001)  # below resolution: loud, not silent no-op
+    with pytest.raises(ValueError):
+        LossInjector(101)
 
 
 @pytest.mark.asyncio
