@@ -52,6 +52,11 @@ class MembershipList:
             self.me.unique_name: (self.clock(), ALIVE)
         }
         self._suspect_since: Dict[str, float] = {}
+        # tombstones: uname -> last gossip timestamp at cleanup time.
+        # Without these, a lagging peer's stale gossip re-adds a cleaned
+        # node (merge sees "unknown entry") and the failure hooks
+        # re-fire — restarting resolved elections and repairs.
+        self._tombstones: Dict[str, float] = {}
         self.leader: Optional[str] = None
         self.false_positives = 0
         self.indirect_failures = 0
@@ -103,6 +108,10 @@ class MembershipList:
                 continue  # unknown node: ignore (static universe, like reference)
             cur = self._members.get(uname)
             if cur is None:
+                dead_ts = self._tombstones.get(uname)
+                if dead_ts is not None and ts <= dead_ts:
+                    continue  # stale gossip about a node we already cleaned
+                self._tombstones.pop(uname, None)  # genuinely rejoined
                 self._members[uname] = (ts, status)
                 changed = True
                 if status == SUSPECT:
@@ -146,6 +155,7 @@ class MembershipList:
         changed = cur is None or cur[1] == SUSPECT
         if cur is not None and cur[1] == SUSPECT:
             self.false_positives += 1
+        self._tombstones.pop(unique_name, None)  # direct evidence beats a tombstone
         self._suspect_since.pop(unique_name, None)
         self._members[unique_name] = (self.clock(), ALIVE)
         if changed:
@@ -163,6 +173,7 @@ class MembershipList:
         """Leave the cluster: forget everyone but self."""
         self._members = {self.me.unique_name: (self.clock(), ALIVE)}
         self._suspect_since.clear()
+        self._tombstones.clear()
         self.leader = None
         self.recompute_ping_targets()
 
@@ -176,7 +187,9 @@ class MembershipList:
             if now - since >= self.spec.timing.cleanup_time
         ]
         for uname in expired:
-            self._members.pop(uname, None)
+            ent = self._members.pop(uname, None)
+            if ent is not None:
+                self._tombstones[uname] = ent[0]
             self._suspect_since.pop(uname, None)
             self.cleaned_since_replication.append(uname)
             if uname == self.leader:

@@ -1,0 +1,481 @@
+"""The node runtime: composition layer tying every subsystem together.
+
+Replaces the reference's 2,000-line `Worker` god-class (worker.py) with
+a small core that owns the transport, membership, election, and the
+background loops, and delegates subsystem message handling to pluggable
+services (store, jobs) via a handler registry.
+
+Core responsibilities (reference call stacks, SURVEY §3):
+- packet dispatch loop          (reference _run_handler, worker.py:539)
+- failure-detection ping loop   (reference run_failure_detection,
+                                 worker.py:1181-1199)
+- join/bootstrap via introducer (reference worker.py:551-614, 1137-1148)
+- election driving + COORDINATE (reference worker.py:621-649, 1161-1179)
+
+Key design fixes over the reference (SURVEY §7 quirks):
+- request/response correlation uses per-request ids and futures, not
+  single-slot Events (reference worker.py:43-44 is race-prone)
+- the election winner is computed, not hardcoded to H2
+- suspects/cleanup/topology repair live in the pure-logic
+  MembershipList; this file only does I/O
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ..config import ClusterSpec, NodeId
+from .election import Election
+from .membership import MembershipHooks, MembershipList
+from .transport import UdpTransport
+from .wire import Message, MsgType
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[Message, Tuple[str, int]], Awaitable[None]]
+
+
+class Node:
+    """One cluster node: transport + membership + election + services."""
+
+    def __init__(self, spec: ClusterSpec, me: NodeId, seed: int = 0):
+        self.spec = spec
+        self.me = me
+        self.seed = seed
+        self.transport: Optional[UdpTransport] = None
+        self.membership = MembershipList(
+            spec,
+            me,
+            hooks=MembershipHooks(
+                on_leader_failed=self._on_leader_failed,
+                on_node_failed=self._on_node_failed,
+                on_replication_needed=self._on_replication_needed,
+            ),
+        )
+        self.election = Election(spec, me)
+        self.joined = False
+        self._missed_acks: Dict[str, int] = {}
+        self._ack_waiters: Dict[str, asyncio.Event] = {}
+        self._handlers: Dict[MsgType, Handler] = {}
+        self._pending: Dict[str, asyncio.Future] = {}
+        self._rid_counter = itertools.count(1)
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = asyncio.Event()
+        self._left = False
+        # services hook these (wired by store/job services at attach)
+        self.on_node_failed_cbs: List[Callable[[str], None]] = []
+        self.on_coordinate_ack_cbs: List[Callable[[str, Dict], None]] = []
+        self.on_replication_needed_cbs: List[Callable[[List[str]], None]] = []
+        self.on_became_leader_cbs: List[Callable[[], None]] = []
+        self.on_new_leader_cbs: List[Callable[[str], None]] = []
+        # inventory provider: returns {file: [versions]} for join/COORDINATE_ACK
+        self.local_inventory: Callable[[], Dict[str, List[int]]] = lambda: {}
+        self._register_core_handlers()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self.transport = await UdpTransport.bind(
+            self.me.host,
+            self.me.port,
+            testing=self.spec.testing,
+            drop_pct=self.spec.packet_drop_pct,
+            seed=self.seed,
+        )
+        self._stopped.clear()
+        self._tasks = [
+            asyncio.create_task(self._dispatch_loop(), name=f"{self.me}-dispatch"),
+            asyncio.create_task(self._failure_detection_loop(), name=f"{self.me}-fd"),
+        ]
+        log.info("%s up on %s", self.me, self.me.unique_name)
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []
+        if self.transport is not None:
+            self.transport.close()
+            self.transport = None
+
+    async def run_forever(self) -> None:
+        await self._stopped.wait()
+
+    # ------------------------------------------------------------------
+    # messaging primitives
+    # ------------------------------------------------------------------
+
+    def register(self, mtype: MsgType, handler: Handler) -> None:
+        if mtype in self._handlers:
+            raise ValueError(f"handler already registered for {mtype!r}")
+        self._handlers[mtype] = handler
+
+    def send(self, to: NodeId, mtype: MsgType, data: Dict[str, Any]) -> None:
+        assert self.transport is not None, "node not started"
+        self.transport.send(Message(self.me.unique_name, mtype, data), to.addr)
+
+    def send_unique(self, unique_name: str, mtype: MsgType, data: Dict[str, Any]) -> None:
+        node = self.spec.node_by_unique_name(unique_name)
+        if node is not None:
+            self.send(node, mtype, data)
+
+    def new_rid(self) -> str:
+        return f"{self.me.unique_name}#{next(self._rid_counter)}"
+
+    async def request(
+        self,
+        to: NodeId,
+        mtype: MsgType,
+        data: Dict[str, Any],
+        timeout: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Send a message carrying a fresh `rid` and await the reply
+        that echoes it. Replaces the reference's single-slot
+        `_waiting_for_leader_event` (worker.py:43-44, 1123-1135) with
+        per-request futures so concurrent requests don't race.
+        """
+        rid = self.new_rid()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            self.send(to, mtype, {**data, "rid": rid})
+            t = timeout if timeout is not None else self.spec.timing.leader_rpc_timeout
+            return await asyncio.wait_for(fut, t)
+        finally:
+            self._pending.pop(rid, None)
+
+    def resolve_rid(self, msg: Message) -> bool:
+        """Route a reply carrying `rid` to its waiting future. Services
+        call this from their ACK handlers (or rely on the dispatcher's
+        fallback, which resolves any un-handled message with a rid)."""
+        rid = msg.data.get("rid")
+        fut = self._pending.get(rid) if rid else None
+        if fut is not None and not fut.done():
+            fut.set_result(msg.data)
+            return True
+        return False
+
+    @property
+    def leader_unique(self) -> Optional[str]:
+        return self.membership.leader
+
+    @property
+    def leader_node(self) -> Optional[NodeId]:
+        if self.membership.leader is None:
+            return None
+        return self.spec.node_by_unique_name(self.membership.leader)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.joined and self.membership.leader == self.me.unique_name
+
+    async def leader_request(
+        self, mtype: MsgType, data: Dict[str, Any], timeout: Optional[float] = None
+    ) -> Dict[str, Any]:
+        leader = self.leader_node
+        if leader is None:
+            raise RuntimeError("no leader known")
+        return await self.request(leader, mtype, data, timeout)
+
+    # ------------------------------------------------------------------
+    # dispatch loop (reference _run_handler, worker.py:539)
+    # ------------------------------------------------------------------
+
+    async def _dispatch_loop(self) -> None:
+        assert self.transport is not None
+        while True:
+            msg, addr = await self.transport.recv()
+            handler = self._handlers.get(msg.type)
+            try:
+                if handler is not None:
+                    await handler(msg, addr)
+                else:
+                    # default: a reply to an in-flight request
+                    self.resolve_rid(msg)
+            except Exception:  # keep the loop alive (reference does too)
+                log.exception("%s: handler for %s failed", self.me, msg.type.name)
+
+    # ------------------------------------------------------------------
+    # failure detection (reference run_failure_detection, worker.py:1181)
+    # ------------------------------------------------------------------
+
+    async def _failure_detection_loop(self) -> None:
+        while True:
+            try:
+                if self._left:
+                    pass  # voluntarily left: silent until rejoin()
+                elif not self.joined:
+                    await self._try_join()
+                else:
+                    self.membership.heartbeat_self()
+                    self.membership.cleanup()
+                    if self.election.in_progress:
+                        self._election_tick()
+                    await self._ping_round()
+            except Exception:
+                log.exception("%s: failure-detection tick failed", self.me)
+            await asyncio.sleep(self.spec.timing.ping_interval)
+
+    async def _ping_round(self) -> None:
+        targets = self.membership.ping_targets
+        gossip = self.membership.snapshot()
+        await asyncio.gather(
+            *(self._ping_one(t, gossip) for t in targets), return_exceptions=True
+        )
+
+    async def _ping_one(self, target: NodeId, gossip: Dict[str, Any]) -> None:
+        """One ping + ACK wait (reference check/_wait,
+        worker.py:1083-1159). >N consecutive misses => suspect."""
+        uname = target.unique_name
+        ev = asyncio.Event()
+        self._ack_waiters[uname] = ev
+        self.send(target, MsgType.PING, {"members": gossip, "leader": self.membership.leader})
+        try:
+            await asyncio.wait_for(ev.wait(), self.spec.timing.ack_timeout)
+            self._missed_acks[uname] = 0
+        except asyncio.TimeoutError:
+            self._missed_acks[uname] = self._missed_acks.get(uname, 0) + 1
+            if self._missed_acks[uname] > self.spec.timing.missed_acks_to_suspect:
+                log.info("%s: suspecting %s", self.me, uname)
+                self.membership.suspect(uname)
+                self._missed_acks[uname] = 0
+        finally:
+            if self._ack_waiters.get(uname) is ev:
+                del self._ack_waiters[uname]
+
+    # ------------------------------------------------------------------
+    # join/bootstrap (reference worker.py:551-614, 1137-1148)
+    # ------------------------------------------------------------------
+
+    async def _try_join(self) -> None:
+        if self.spec.introducer is None:
+            # no introducer: standalone/leader-of-one mode
+            self._become_leader()
+            return
+        try:
+            reply = await self.request(
+                self.spec.introducer,
+                MsgType.FETCH_INTRODUCER,
+                {},
+                timeout=self.spec.timing.ack_timeout,
+            )
+        except asyncio.TimeoutError:
+            log.debug("%s: introducer DNS unreachable, retrying", self.me)
+            return
+        introducer = reply.get("introducer", "")
+        if introducer == self.me.unique_name:
+            self._become_leader()
+            return
+        target = self.spec.node_by_unique_name(introducer)
+        if target is None:
+            return
+        try:
+            ack = await self.request(
+                target, MsgType.INTRODUCE, {}, timeout=self.spec.timing.ack_timeout
+            )
+        except asyncio.TimeoutError:
+            log.debug("%s: leader %s not answering INTRODUCE", self.me, introducer)
+            return
+        self.membership.merge(ack.get("members", {}))
+        self.membership.mark_alive(introducer)
+        self._set_leader(ack.get("leader") or introducer)
+        self.joined = True
+        log.info("%s joined; leader=%s", self.me, self.membership.leader)
+        # report local files so the leader's global table includes us
+        # (reference ALL_LOCAL_FILES, worker.py:592-593)
+        self.send(target, MsgType.ALL_LOCAL_FILES, {"files": self.local_inventory()})
+
+    def _become_leader(self) -> None:
+        self.joined = True
+        self._set_leader(self.me.unique_name)
+        log.info("%s is the leader", self.me)
+        for cb in self.on_became_leader_cbs:
+            cb()
+
+    def _set_leader(self, unique_name: Optional[str]) -> None:
+        prev = self.membership.leader
+        self.membership.leader = unique_name
+        if unique_name and unique_name != prev:
+            for cb in self.on_new_leader_cbs:
+                cb(unique_name)
+
+    # ------------------------------------------------------------------
+    # election driving (reference worker.py:621-649, 1161-1179)
+    # ------------------------------------------------------------------
+
+    def _on_leader_failed(self, dead_leader: str) -> None:
+        log.info("%s: leader %s died -> election", self.me, dead_leader)
+        self.election.start()
+
+    def _on_node_failed(self, uname: str) -> None:
+        self._missed_acks.pop(uname, None)
+        for cb in self.on_node_failed_cbs:
+            cb(uname)
+
+    def _on_replication_needed(self, cleaned: List[str]) -> None:
+        for cb in self.on_replication_needed_cbs:
+            cb(cleaned)
+
+    def _election_tick(self) -> None:
+        """Per-tick election gossip (reference send_election_messages,
+        worker.py:1161-1169) + winner self-check."""
+        for t in self.membership.ping_targets:
+            self.send(t, MsgType.ELECTION, {})
+        if self.election.i_win(self.membership.alive_nodes()):
+            self._announce_coordinator()
+
+    def _announce_coordinator(self) -> None:
+        """I won: multicast COORDINATE (reference worker.py:1171-1179),
+        become leader, update the introducer DNS."""
+        self.election.resolved(self.me.unique_name)
+        self._become_leader()
+        for node in self.membership.alive_nodes():
+            if node.unique_name != self.me.unique_name:
+                self.send(node, MsgType.COORDINATE, {})
+        if self.spec.introducer is not None:
+            # COORDINATE loss self-heals via election gossip, but this
+            # is the only copy of the new leader's identity the DNS will
+            # ever get — retry until ACKed or a packet drop would strand
+            # future joiners at the dead leader forever
+            self._tasks.append(
+                asyncio.create_task(
+                    self._update_introducer_until_acked(),
+                    name=f"{self.me}-update-introducer",
+                )
+            )
+
+    async def _update_introducer_until_acked(self, attempts: int = 20) -> None:
+        assert self.spec.introducer is not None
+        for _ in range(attempts):
+            try:
+                await self.request(
+                    self.spec.introducer,
+                    MsgType.UPDATE_INTRODUCER,
+                    {"introducer": self.me.unique_name},
+                    timeout=self.spec.timing.ack_timeout,
+                )
+                return
+            except asyncio.TimeoutError:
+                continue
+        log.warning("%s: introducer DNS never ACKed the leader update", self.me)
+
+    # ------------------------------------------------------------------
+    # core handlers
+    # ------------------------------------------------------------------
+
+    def _register_core_handlers(self) -> None:
+        self.register(MsgType.PING, self._h_ping)
+        self.register(MsgType.ACK, self._h_ack)
+        self.register(MsgType.INTRODUCE, self._h_introduce)
+        self.register(MsgType.ELECTION, self._h_election)
+        self.register(MsgType.COORDINATE, self._h_coordinate)
+        self.register(MsgType.COORDINATE_ACK, self._h_coordinate_ack)
+
+    async def _h_ping(self, msg: Message, addr) -> None:
+        """Merge piggybacked gossip, ACK with our own (reference PING
+        branch, worker.py:616-619)."""
+        if not self.joined:
+            return
+        self.membership.merge(msg.data.get("members", {}))
+        self.membership.mark_alive(msg.sender)
+        their_leader = msg.data.get("leader")
+        if their_leader and self.membership.leader is None and not self.election.in_progress:
+            self._set_leader(their_leader)
+        self.send_unique(
+            msg.sender,
+            MsgType.ACK,
+            {"members": self.membership.snapshot(), "leader": self.membership.leader},
+        )
+
+    async def _h_ack(self, msg: Message, addr) -> None:
+        """ACK: wake the waiter, merge gossip (reference
+        worker.py:551-570 -> _notify_waiting)."""
+        self.membership.merge(msg.data.get("members", {}))
+        self.membership.mark_alive(msg.sender)
+        ev = self._ack_waiters.get(msg.sender)
+        if ev is not None:
+            ev.set()
+
+    async def _h_introduce(self, msg: Message, addr) -> None:
+        """Leader-side join handler (reference INTRODUCE,
+        worker.py:616-619): admit the node, reply membership+leader."""
+        if not self.is_leader:
+            return  # only the leader introduces (joiner will retry)
+        self.membership.mark_alive(msg.sender)
+        self.send_unique(
+            msg.sender,
+            MsgType.INTRODUCE_ACK,
+            {
+                "rid": msg.data.get("rid"),
+                "members": self.membership.snapshot(),
+                "leader": self.me.unique_name,
+            },
+        )
+
+    async def _h_election(self, msg: Message, addr) -> None:
+        """Join an in-progress election (reference worker.py:621-629)."""
+        if not self.joined:
+            return
+        if self.election.on_election_message():
+            log.info("%s: joined election started by %s", self.me, msg.sender)
+
+    async def _h_coordinate(self, msg: Message, addr) -> None:
+        """Accept the new leader (reference worker.py:631-637); reply
+        COORDINATE_ACK carrying our file inventory so the new leader
+        can rebuild the global table (worker.py:639-649)."""
+        self.election.resolved(msg.sender)
+        self.membership.mark_alive(msg.sender)
+        self._set_leader(msg.sender)
+        self.send_unique(
+            msg.sender,
+            MsgType.COORDINATE_ACK,
+            {"files": self.local_inventory()},
+        )
+
+    async def _h_coordinate_ack(self, msg: Message, addr) -> None:
+        """New-leader side: a peer reported its inventory. The store
+        service extends this via on_coordinate_ack."""
+        self.membership.mark_alive(msg.sender)
+        for cb in self.on_coordinate_ack_cbs:
+            cb(msg.sender, msg.data.get("files", {}))
+
+    # ------------------------------------------------------------------
+    # ops / stats (reference CLI options 9/10)
+    # ------------------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        t = self.transport
+        return {
+            "me": self.me.unique_name,
+            "leader": self.membership.leader,
+            "joined": self.joined,
+            "alive": [n.unique_name for n in self.membership.alive_nodes()],
+            "false_positives": self.membership.false_positives,
+            "indirect_failures": self.membership.indirect_failures,
+            "bytes_sent": t.bytes_sent if t else 0,
+            "bps": t.bps() if t else 0.0,
+            "packets_dropped": t.packets_dropped if t else 0,
+        }
+
+    def leave(self) -> None:
+        """Voluntary leave (reference CLI option 4): stop ACKing and
+        forget the cluster; stays out until `rejoin()`."""
+        self.joined = False
+        self._left = True
+        self.membership.reset()
+
+    def rejoin(self) -> None:
+        """Reference CLI option 3: go back through the introducer."""
+        self._left = False
+        self.joined = False  # _try_join runs on the next tick

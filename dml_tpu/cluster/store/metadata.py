@@ -25,6 +25,7 @@ class RequestStatus:
     requester: str  # unique_name of the client
     replicas: Dict[str, str] = field(default_factory=dict)  # node -> pending|ok|fail
     version: int = 0
+    client_rid: str = ""  # the requester's rid, echoed in the final reply
 
     def set_status(self, node: str, status: str) -> None:
         if node in self.replicas:
@@ -32,7 +33,9 @@ class RequestStatus:
 
     @property
     def completed(self) -> bool:
-        return all(s == "ok" for s in self.replicas.values())
+        # an empty replica map is a failed request, not a vacuous success
+        # (every replica died mid-flight)
+        return bool(self.replicas) and all(s == "ok" for s in self.replicas.values())
 
     @property
     def failed(self) -> bool:
@@ -51,6 +54,10 @@ class StoreMetadata:
         # request id -> status (reference status_dict, leader.py:25-27)
         self.requests: Dict[str, RequestStatus] = {}
         self._req_counter = 0
+        # highest version ever assigned per file, including in-flight
+        # PUTs — so concurrent PUTs of one file can't collide on the
+        # same version number
+        self._version_high: Dict[str, int] = {}
 
     # ---- node inventories ----
 
@@ -73,6 +80,14 @@ class StoreMetadata:
     def remove_file(self, file: str) -> None:
         for inv in self.files.values():
             inv.pop(file, None)
+        self._version_high.pop(file, None)
+
+    def assign_version(self, file: str) -> int:
+        """Next version for a PUT: strictly above both the replicated
+        high-water mark and any in-flight assignment."""
+        v = max(self.latest_version(file), self._version_high.get(file, 0)) + 1
+        self._version_high[file] = v
+        return v
 
     # ---- queries ----
 

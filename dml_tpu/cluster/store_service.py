@@ -1,0 +1,593 @@
+"""Replicated-store service: SDFS verbs over the control plane.
+
+Replaces the reference's store request flows (worker.py:113-174,
+651-883, 1201-1354, 1461-1570) — client verbs, leader fan-out and ACK
+aggregation, replica-side executors, failure-time repair, and
+re-replication — wired into the Node runtime's handler registry.
+
+Flow shapes preserved from the reference (§3.3):
+- PUT: client -> leader PUT_REQUEST; leader places `replication_factor`
+  replicas (sha256 probe), fans DOWNLOAD_FILE to each; replicas pull
+  the bytes from the *client* and ACK the leader; when all ACK the
+  leader answers the client. The data plane is the credential-free TCP
+  DataPlane (the reference pulls over scp with passwords from
+  password.txt).
+- GET: client -> leader GET_FILE_REQUEST -> replica list; client pulls
+  from any live replica (reference get_file_locally, worker.py:1323).
+- DELETE: leader fans DELETE_FILE, aggregates ACKs.
+- re-replication: after failures the leader computes a repair plan and
+  sends REPLICATE_FILE to new holders, which pull every version from a
+  surviving replica (reference leader.py:147-181, worker.py:1308-1321).
+
+Differences (intent over accident, SURVEY §7):
+- the leader assigns the version number so replicas can't skew
+  (the reference lets each replica pick its own next version)
+- request/response correlation by rid futures, not single-slot events
+- the standby's file table stays warm via ALL_LOCAL_FILES_RELAY, and
+  COORDINATE_ACK reconciliation rebuilds it authoritatively on failover
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..config import ClusterSpec, NodeId, StoreConfig
+from .node import Node
+from .store.data_plane import DataPlane
+from .store.local_store import LocalStore
+from .store.metadata import StoreMetadata
+from .wire import Message, MsgType
+
+log = logging.getLogger(__name__)
+
+# the TCP data plane listens at udp_port + this offset on each node
+DATA_PORT_OFFSET = 10_000
+
+
+def data_addr(node: NodeId) -> Tuple[str, int]:
+    return (node.host, node.port + DATA_PORT_OFFSET)
+
+
+class StoreService:
+    """Attach SDFS behavior to a Node. One instance per node; it acts
+    as replica always, as metadata leader only while node.is_leader."""
+
+    def __init__(self, node: Node, cfg: Optional[StoreConfig] = None, root: Optional[str] = None):
+        self.node = node
+        self.cfg = cfg or node.spec.store
+        store_root = root or os.path.join(self.cfg.store_path(), node.me.unique_name.replace(":", "_"))
+        self.store = LocalStore(
+            store_root,
+            max_versions=self.cfg.max_versions,
+            cleanup_on_startup=self.cfg.cleanup_on_startup,
+        )
+        self.data_plane = DataPlane(self.store, host=node.me.host, port=data_addr(node.me)[1])
+        self.metadata = StoreMetadata(self.cfg.replication_factor)
+        self._register()
+        node.local_inventory = self.store.inventory
+        node.on_became_leader_cbs.append(self._on_became_leader)
+        node.on_coordinate_ack_cbs.append(self._on_coordinate_ack)
+        node.on_node_failed_cbs.append(self._on_node_failed)
+        node.on_replication_needed_cbs.append(self._on_replication_needed)
+
+    async def start(self) -> None:
+        await self.data_plane.start()
+
+    async def stop(self) -> None:
+        await self.data_plane.stop()
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    @property
+    def _me(self) -> str:
+        return self.node.me.unique_name
+
+    def _live_node_names(self) -> List[str]:
+        return [n.unique_name for n in self.node.membership.alive_nodes()]
+
+    def standby_node(self) -> Optional[NodeId]:
+        """The hot standby: the would-be election winner if the leader
+        died now (reference hardcodes H2; we compute it)."""
+        alive = [
+            n
+            for n in self.node.membership.alive_nodes()
+            if n.unique_name != self._me
+        ]
+        return self.node.spec.election_winner(alive)
+
+    def _relay_to_standby(self, mtype: MsgType, data: Dict[str, Any]) -> None:
+        sb = self.standby_node()
+        if sb is not None:
+            self.node.send(sb, mtype, data)
+
+    # ------------------------------------------------------------------
+    # client verbs (reference CLI file commands, worker.py:1810-1958)
+    # ------------------------------------------------------------------
+
+    async def put(self, local_path: str, sdfs_name: str, timeout: float = 60.0) -> Dict[str, Any]:
+        """`put <local> <sdfs>` — upload with `replication_factor`-way
+        replication (§3.3)."""
+        local_path = os.path.abspath(os.path.expanduser(local_path))
+        if not os.path.isfile(local_path):
+            raise FileNotFoundError(local_path)
+        token = self.data_plane.expose(local_path)
+        try:
+            reply = await self.node.leader_request(
+                MsgType.PUT_REQUEST,
+                {
+                    "file": sdfs_name,
+                    "token": token,
+                    "data_addr": list(data_addr(self.node.me)),
+                },
+                timeout=timeout,
+            )
+        finally:
+            self.data_plane.unexpose(token)
+        if not reply.get("ok"):
+            raise RuntimeError(f"put {sdfs_name} failed: {reply.get('error')}")
+        return reply
+
+    async def get(
+        self,
+        sdfs_name: str,
+        local_path: str,
+        version: Optional[int] = None,
+        timeout: float = 60.0,
+    ) -> int:
+        """`get <sdfs> <local>` — download one version (latest default)
+        from any live replica (reference get_file_locally,
+        worker.py:1323-1354). Returns the version fetched."""
+        reply = await self.node.leader_request(
+            MsgType.GET_FILE_REQUEST, {"file": sdfs_name}, timeout=timeout
+        )
+        if not reply.get("ok"):
+            raise FileNotFoundError(f"{sdfs_name}: {reply.get('error')}")
+        want = version if version is not None else int(reply["version"])
+        last_err: Optional[Exception] = None
+        for uname in reply.get("replicas", []):
+            node = self.node.spec.node_by_unique_name(uname)
+            if node is None:
+                continue
+            try:
+                data, got = await self.data_plane.fetch_from_store(
+                    data_addr(node), sdfs_name, want
+                )
+                local_path = os.path.abspath(os.path.expanduser(local_path))
+                os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+                with open(local_path, "wb") as f:
+                    f.write(data)
+                return got
+            except Exception as e:  # try the next replica
+                last_err = e
+        raise FileNotFoundError(f"{sdfs_name}: no replica served it ({last_err})")
+
+    async def get_versions(
+        self, sdfs_name: str, count: int, local_path: str, timeout: float = 60.0
+    ) -> List[int]:
+        """`get-versions <sdfs> <n> <local>` — latest n versions,
+        concatenated with version markers (reference worker.py:1833-1880
+        writes them into one output file)."""
+        reply = await self.node.leader_request(
+            MsgType.GET_FILE_REQUEST, {"file": sdfs_name}, timeout=timeout
+        )
+        if not reply.get("ok"):
+            raise FileNotFoundError(f"{sdfs_name}: {reply.get('error')}")
+        versions = sorted(int(v) for v in reply.get("versions", []))[-count:]
+        replicas = reply.get("replicas", [])
+        local_path = os.path.abspath(os.path.expanduser(local_path))
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        got: List[int] = []
+        with open(local_path, "wb") as f:
+            for v in versions:
+                for uname in replicas:
+                    node = self.node.spec.node_by_unique_name(uname)
+                    if node is None:
+                        continue
+                    try:
+                        data, _ = await self.data_plane.fetch_from_store(
+                            data_addr(node), sdfs_name, v
+                        )
+                        f.write(f"---- version {v} ----\n".encode())
+                        f.write(data)
+                        f.write(b"\n")
+                        got.append(v)
+                        break
+                    except Exception:
+                        continue
+        return got
+
+    async def delete(self, sdfs_name: str, timeout: float = 60.0) -> Dict[str, Any]:
+        reply = await self.node.leader_request(
+            MsgType.DELETE_FILE_REQUEST, {"file": sdfs_name}, timeout=timeout
+        )
+        if not reply.get("ok"):
+            raise RuntimeError(f"delete {sdfs_name} failed: {reply.get('error')}")
+        return reply
+
+    async def ls(self, sdfs_name: str) -> List[str]:
+        """`ls <sdfs>` — replica nodes currently holding the file."""
+        reply = await self.node.leader_request(
+            MsgType.LIST_FILE_REQUEST, {"file": sdfs_name}
+        )
+        return reply.get("replicas", [])
+
+    async def ls_all(self, pattern: str = "*") -> Dict[str, List[int]]:
+        """`ls-all <pattern>` — wildcard search over the global table
+        (reference get_all_matching_files, leader.py:104-111)."""
+        reply = await self.node.leader_request(
+            MsgType.GET_ALL_MATCHING_FILES, {"pattern": pattern}
+        )
+        return {f: [int(v) for v in vs] for f, vs in reply.get("files", {}).items()}
+
+    def local_files(self) -> Dict[str, List[int]]:
+        """`store` — files replicated on this node (reference CLI)."""
+        return self.store.inventory()
+
+    # ------------------------------------------------------------------
+    # handler registration
+    # ------------------------------------------------------------------
+
+    def _register(self) -> None:
+        n = self.node
+        # leader side
+        n.register(MsgType.PUT_REQUEST, self._h_put_request)
+        n.register(MsgType.GET_FILE_REQUEST, self._h_get_file_request)
+        n.register(MsgType.DELETE_FILE_REQUEST, self._h_delete_file_request)
+        n.register(MsgType.LIST_FILE_REQUEST, self._h_list_file_request)
+        n.register(MsgType.GET_ALL_MATCHING_FILES, self._h_matching_request)
+        n.register(MsgType.DOWNLOAD_FILE_SUCCESS, self._h_download_result)
+        n.register(MsgType.DOWNLOAD_FILE_FAIL, self._h_download_result)
+        n.register(MsgType.DELETE_FILE_ACK, self._h_delete_result)
+        n.register(MsgType.DELETE_FILE_NAK, self._h_delete_result)
+        n.register(MsgType.REPLICATE_FILE_SUCCESS, self._h_replicate_result)
+        n.register(MsgType.REPLICATE_FILE_FAIL, self._h_replicate_result)
+        n.register(MsgType.ALL_LOCAL_FILES, self._h_all_local_files)
+        # standby side
+        n.register(MsgType.ALL_LOCAL_FILES_RELAY, self._h_all_local_files_relay)
+        # replica side
+        n.register(MsgType.DOWNLOAD_FILE, self._h_download_file)
+        n.register(MsgType.DELETE_FILE, self._h_delete_file)
+        n.register(MsgType.REPLICATE_FILE, self._h_replicate_file)
+
+    # ------------------------------------------------------------------
+    # leader-side handlers
+    # ------------------------------------------------------------------
+
+    def _on_became_leader(self) -> None:
+        """Seed the global table with our own inventory (reference
+        worker.py:577-588 seeds from local files + temporary dict)."""
+        self.metadata.set_node_inventory(self._me, self.store.inventory())
+
+    def _on_coordinate_ack(self, sender: str, files: Dict[str, Any]) -> None:
+        """Failover reconciliation: every node reports its inventory to
+        the new leader (reference worker.py:639-649)."""
+        self.metadata.set_node_inventory(
+            sender, {f: [int(v) for v in vs] for f, vs in files.items()}
+        )
+
+    async def _h_all_local_files(self, msg: Message, addr) -> None:
+        """A joining node reported its files (reference worker.py:598-614);
+        merge and keep the standby's copy warm."""
+        if not self.node.is_leader:
+            return
+        files = {f: [int(v) for v in vs] for f, vs in msg.data.get("files", {}).items()}
+        self.metadata.set_node_inventory(msg.sender, files)
+        self._relay_to_standby(
+            MsgType.ALL_LOCAL_FILES_RELAY, {"node": msg.sender, "files": files}
+        )
+
+    async def _h_all_local_files_relay(self, msg: Message, addr) -> None:
+        if msg.sender != self.node.leader_unique:
+            return
+        files = {f: [int(v) for v in vs] for f, vs in msg.data.get("files", {}).items()}
+        self.metadata.set_node_inventory(msg.data.get("node", msg.sender), files)
+
+    async def _h_put_request(self, msg: Message, addr) -> None:
+        """Leader PUT flow (reference worker.py:760-773): place
+        replicas, assign the version, fan out DOWNLOAD_FILE."""
+        if not self.node.is_leader:
+            return
+        file = msg.data["file"]
+        rid = msg.data.get("rid", "")
+        live = self._live_node_names()
+        replicas = self.metadata.place(file, live)
+        if not replicas:
+            self.node.send_unique(
+                msg.sender, MsgType.PUT_REQUEST_FAIL,
+                {"rid": rid, "ok": False, "error": "no live replicas"},
+            )
+            return
+        version = self.metadata.assign_version(file)
+        req_id = self.metadata.new_request("put", file, msg.sender, replicas, version)
+        self.metadata.requests[req_id].client_rid = rid
+        for r in replicas:
+            self.node.send_unique(
+                r,
+                MsgType.DOWNLOAD_FILE,
+                {
+                    "req": req_id,
+                    "file": file,
+                    "version": version,
+                    "token": msg.data["token"],
+                    "data_addr": msg.data["data_addr"],
+                },
+            )
+
+    async def _h_download_result(self, msg: Message, addr) -> None:
+        """Replica finished (or failed) pulling a PUT (reference
+        worker.py:702-730). All ok -> answer the client; any fail ->
+        reassign to another live node or fail the request."""
+        if not self.node.is_leader:
+            return
+        req_id = msg.data.get("req", "")
+        st = self.metadata.get_request(req_id)
+        if st is None:
+            return
+        ok = msg.type == MsgType.DOWNLOAD_FILE_SUCCESS
+        st.set_status(msg.sender, "ok" if ok else "fail")
+        if ok:
+            self.metadata.record_replica(msg.sender, st.file, st.version)
+        if st.completed:
+            self.metadata.finish_request(req_id)
+            self.node.send_unique(
+                st.requester,
+                MsgType.PUT_REQUEST_SUCCESS,
+                {
+                    "rid": st.client_rid,
+                    "ok": True,
+                    "file": st.file,
+                    "version": st.version,
+                    "replicas": self.metadata.replicas_of(st.file),
+                },
+            )
+        elif st.failed:
+            self.metadata.finish_request(req_id)
+            self.node.send_unique(
+                st.requester,
+                MsgType.PUT_REQUEST_FAIL,
+                {
+                    "rid": st.client_rid,
+                    "ok": False,
+                    "error": f"replica {msg.sender} failed: {msg.data.get('error')}",
+                },
+            )
+
+    async def _h_get_file_request(self, msg: Message, addr) -> None:
+        """Leader GET: reply replica set + versions; the client pulls
+        the bytes itself over the data plane."""
+        if not self.node.is_leader:
+            return
+        file = msg.data["file"]
+        replicas = [r for r in self.metadata.replicas_of(file) if self.node.membership.is_alive(r)]
+        if not replicas:
+            self.node.send_unique(
+                msg.sender,
+                MsgType.GET_FILE_REQUEST_FAIL,
+                {"rid": msg.data.get("rid"), "ok": False, "error": "file not found"},
+            )
+            return
+        versions = sorted(
+            {v for r in replicas for v in self.metadata.files.get(r, {}).get(file, [])}
+        )
+        self.node.send_unique(
+            msg.sender,
+            MsgType.GET_FILE_REQUEST_ACK,
+            {
+                "rid": msg.data.get("rid"),
+                "ok": True,
+                "file": file,
+                "replicas": replicas,
+                "version": versions[-1] if versions else 0,
+                "versions": versions,
+            },
+        )
+
+    async def _h_delete_file_request(self, msg: Message, addr) -> None:
+        """Leader DELETE: fan out to holders, aggregate ACKs."""
+        if not self.node.is_leader:
+            return
+        file = msg.data["file"]
+        rid = msg.data.get("rid", "")
+        holders = [r for r in self.metadata.replicas_of(file) if self.node.membership.is_alive(r)]
+        if not holders:
+            self.node.send_unique(
+                msg.sender,
+                MsgType.DELETE_FILE_REQUEST_FAIL,
+                {"rid": rid, "ok": False, "error": "file not found"},
+            )
+            return
+        req_id = self.metadata.new_request("delete", file, msg.sender, holders)
+        self.metadata.requests[req_id].client_rid = rid
+        for r in holders:
+            self.node.send_unique(r, MsgType.DELETE_FILE, {"req": req_id, "file": file})
+
+    async def _h_delete_result(self, msg: Message, addr) -> None:
+        if not self.node.is_leader:
+            return
+        req_id = msg.data.get("req", "")
+        st = self.metadata.get_request(req_id)
+        if st is None:
+            return
+        ok = msg.type == MsgType.DELETE_FILE_ACK
+        st.set_status(msg.sender, "ok" if ok else "fail")
+        if not (st.completed or st.failed):
+            return
+        done_ok = st.completed
+        self.metadata.finish_request(req_id)
+        if done_ok:
+            self.metadata.remove_file(st.file)
+        self.node.send_unique(
+            st.requester,
+            MsgType.DELETE_FILE_REQUEST_SUCCESS if done_ok else MsgType.DELETE_FILE_REQUEST_FAIL,
+            {"rid": st.client_rid, "ok": done_ok, "file": st.file},
+        )
+
+    async def _h_list_file_request(self, msg: Message, addr) -> None:
+        if not self.node.is_leader:
+            return
+        file = msg.data["file"]
+        self.node.send_unique(
+            msg.sender,
+            MsgType.LIST_FILE_REQUEST_ACK,
+            {
+                "rid": msg.data.get("rid"),
+                "ok": True,
+                "replicas": self.metadata.replicas_of(file),
+            },
+        )
+
+    async def _h_matching_request(self, msg: Message, addr) -> None:
+        if not self.node.is_leader:
+            return
+        pattern = msg.data.get("pattern", "*")
+        files = {
+            f: sorted({
+                v
+                for inv in self.metadata.files.values()
+                for v in inv.get(f, [])
+            })
+            for f in self.metadata.matching(pattern)
+        }
+        self.node.send_unique(
+            msg.sender,
+            MsgType.GET_ALL_MATCHING_FILES_ACK,
+            {"rid": msg.data.get("rid"), "ok": True, "files": files},
+        )
+
+    # ------------------------------------------------------------------
+    # replica-side handlers (reference worker.py:113-174)
+    # ------------------------------------------------------------------
+
+    async def _h_download_file(self, msg: Message, addr) -> None:
+        """Pull the client's exposed file into the local store at the
+        leader-assigned version, then ACK the leader."""
+        try:
+            await self.data_plane.fetch_token_to_store(
+                tuple(msg.data["data_addr"]),
+                msg.data["token"],
+                msg.data["file"],
+                int(msg.data["version"]),
+            )
+            self.node.send_unique(
+                msg.sender,
+                MsgType.DOWNLOAD_FILE_SUCCESS,
+                {"req": msg.data.get("req"), "file": msg.data["file"],
+                 "version": int(msg.data["version"])},
+            )
+        except Exception as e:
+            log.warning("%s: PUT pull failed: %s", self._me, e)
+            self.node.send_unique(
+                msg.sender,
+                MsgType.DOWNLOAD_FILE_FAIL,
+                {"req": msg.data.get("req"), "file": msg.data["file"], "error": str(e)},
+            )
+
+    async def _h_delete_file(self, msg: Message, addr) -> None:
+        ok = self.store.delete(msg.data["file"])
+        self.node.send_unique(
+            msg.sender,
+            MsgType.DELETE_FILE_ACK if ok else MsgType.DELETE_FILE_NAK,
+            {"req": msg.data.get("req"), "file": msg.data["file"]},
+        )
+
+    async def _h_replicate_file(self, msg: Message, addr) -> None:
+        """Pull every version of a file from a surviving replica
+        (reference replicate_file, file_service.py:52-61)."""
+        file = msg.data["file"]
+        source = self.node.spec.node_by_unique_name(msg.data["source"])
+        try:
+            if source is None:
+                raise RuntimeError(f"unknown source {msg.data['source']}")
+            versions = await self.data_plane.replicate_from(data_addr(source), file)
+            self.node.send_unique(
+                msg.sender,
+                MsgType.REPLICATE_FILE_SUCCESS,
+                {"file": file, "versions": versions},
+            )
+        except Exception as e:
+            log.warning("%s: replicate %s failed: %s", self._me, file, e)
+            self.node.send_unique(
+                msg.sender, MsgType.REPLICATE_FILE_FAIL, {"file": file, "error": str(e)}
+            )
+
+    async def _h_replicate_result(self, msg: Message, addr) -> None:
+        if not self.node.is_leader:
+            return
+        if msg.type == MsgType.REPLICATE_FILE_SUCCESS:
+            for v in msg.data.get("versions", []):
+                self.metadata.record_replica(msg.sender, msg.data["file"], int(v))
+
+    # ------------------------------------------------------------------
+    # failure handling (reference worker.py:1247-1321, leader.py:147-181)
+    # ------------------------------------------------------------------
+
+    def _on_node_failed(self, uname: str) -> None:
+        """A node was cleaned up: drop its inventory and repair
+        in-flight requests that were waiting on it (reference
+        replace_files_downloading_by_node, worker.py:1247-1277)."""
+        if not self.node.is_leader:
+            return
+        self.metadata.drop_node(uname)
+        # prompt repair: the reference batches re-replication until >=M
+        # nodes died (membershipList.py:49-52), leaving files
+        # under-replicated in the meantime; the plan is cheap and
+        # idempotent, so run it on every death
+        self._on_replication_needed([uname])
+        for req_id, st in self.metadata.requests_involving(uname):
+            # mark the dead replica failed; if that completes/fails the
+            # request the next result handler pass would miss it, so
+            # resolve inline
+            st.replicas.pop(uname, None)
+            if not st.replicas:
+                # every replica died mid-flight: fail loudly, never
+                # report a vacuous success
+                self.metadata.finish_request(req_id)
+                self.node.send_unique(
+                    st.requester,
+                    MsgType.PUT_REQUEST_FAIL
+                    if st.op == "put"
+                    else MsgType.DELETE_FILE_REQUEST_FAIL,
+                    {
+                        "rid": st.client_rid,
+                        "ok": False,
+                        "file": st.file,
+                        "error": "all replicas failed during the request",
+                    },
+                )
+            elif st.completed:
+                self.metadata.finish_request(req_id)
+                if st.op == "delete":
+                    self.metadata.remove_file(st.file)
+                self.node.send_unique(
+                    st.requester,
+                    MsgType.PUT_REQUEST_SUCCESS
+                    if st.op == "put"
+                    else MsgType.DELETE_FILE_REQUEST_SUCCESS,
+                    {
+                        "rid": st.client_rid,
+                        "ok": True,
+                        "file": st.file,
+                        "version": st.version,
+                        "replicas": self.metadata.replicas_of(st.file),
+                    },
+                )
+
+    def _on_replication_needed(self, cleaned: List[str]) -> None:
+        """Enough nodes died: bring every file back to
+        `replication_factor` copies (reference worker.py:1308-1321)."""
+        if not self.node.is_leader:
+            return
+        live = self._live_node_names()
+        plan = self.metadata.replication_plan(live)
+        for file, source, targets in plan:
+            for t in targets:
+                self.node.send_unique(
+                    t, MsgType.REPLICATE_FILE, {"file": file, "source": source}
+                )
+        if plan:
+            log.info("%s: re-replication plan: %d files", self._me, len(plan))

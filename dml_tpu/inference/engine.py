@@ -71,6 +71,7 @@ class _LoadedModel:
     forward: Any  # jitted fn(variables, uint8 batch) -> probs f32
     batch_size: int
     num_classes: int
+    seed: int = 0
     load_time: float = 0.0
     first_query: float = 0.0
     per_query: float = 0.0
@@ -107,7 +108,11 @@ class InferenceEngine:
         key = spec.name
         if key in self._models:
             cached = self._models[key]
-            if variables is None and batch_size in (None, cached.batch_size):
+            if (
+                variables is None
+                and seed == cached.seed
+                and batch_size in (None, cached.batch_size)
+            ):
                 return cached
             # explicit new weights or batch size: rebuild, don't silently
             # serve the stale entry
@@ -130,6 +135,7 @@ class InferenceEngine:
             forward=forward,
             batch_size=batch_size or spec.cost.default_batch_size,
             num_classes=int(pred.shape[-1]),
+            seed=seed,
         )
         lm.load_time = time.monotonic() - t0
         self._models[key] = lm

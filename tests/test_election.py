@@ -1,0 +1,55 @@
+"""Unit tests for the bully election state machine.
+
+The reference's election is hardcoded to declare H2 the winner
+(election.py:24-32); ours computes the highest-(rank, host, port) node
+among the alive set (SURVEY §7 quirk #1) — these tests pin that down.
+"""
+
+from dml_tpu.config import ClusterSpec
+from dml_tpu.cluster.election import Election
+
+
+def _spec(n=4):
+    return ClusterSpec.localhost(n, base_port=9000)
+
+
+def test_winner_is_highest_rank():
+    spec = _spec(4)
+    # localhost() assigns rank n-i: H1 highest
+    assert spec.election_winner(spec.nodes).name == "H1"
+    # H1 dead -> H2
+    assert spec.election_winner(spec.nodes[1:]).name == "H2"
+    assert spec.election_winner([]) is None
+
+
+def test_state_machine():
+    spec = _spec(3)
+    h2 = spec.node_by_name("H2")
+    e = Election(spec, h2)
+    assert not e.in_progress
+    assert e.start()
+    assert e.in_progress
+    assert not e.start()  # already electing
+    # H1 alive -> H2 does not win
+    assert not e.i_win(spec.nodes)
+    # H1 gone -> H2 wins
+    assert e.i_win(spec.nodes[1:])
+    e.resolved(h2.unique_name)
+    assert not e.in_progress
+    assert e.last_winner == h2.unique_name
+
+
+def test_peer_message_joins_election():
+    spec = _spec(3)
+    e = Election(spec, spec.nodes[2])
+    assert e.on_election_message()
+    assert e.in_progress
+    assert not e.on_election_message()  # already in
+
+
+def test_i_win_requires_in_progress():
+    spec = _spec(2)
+    h1 = spec.node_by_name("H1")
+    e = Election(spec, h1)
+    # not electing -> never "wins" spuriously
+    assert not e.i_win(spec.nodes)

@@ -52,6 +52,11 @@ def test_keras_parity(name, keras_builder):
     )
 
     assert ky.shape == fy.shape == (1, 1000)
-    np.testing.assert_allclose(fy, ky, atol=2e-5, rtol=1e-3)
-    # same argmax class, meaningful agreement beyond tolerance luck
-    assert int(np.argmax(fy)) == int(np.argmax(ky))
+    # with random weights the softmax is near-uniform (spread ~1e-5), so
+    # argmax is decided by float noise — assert a tight absolute error
+    # (accumulated f32 noise over ~300 layers measures ~4e-6) plus
+    # correlation of the centered signal, which tolerance luck can't fake
+    np.testing.assert_allclose(fy, ky, atol=1e-5)
+    kc, fc = ky - ky.mean(), fy - fy.mean()
+    corr = float((kc * fc).sum() / np.sqrt((kc * kc).sum() * (fc * fc).sum()))
+    assert corr > 0.5, f"centered correlation {corr:.3f} too low"
