@@ -1,0 +1,295 @@
+"""Process entry points + interactive operator CLI.
+
+Replaces the reference's `main.py` (bootstrap: main.py:15-77) and the
+2,000-line stdin menu `check_user_input` (worker.py:1629-2034). Same
+verb set, structured into a command table; plus `introducer` and
+`localspec` subcommands so a whole local cluster can be stood up
+without hand-editing config files (the reference requires editing
+config.py in two places per deployment, README STEP-1).
+
+Run:
+    python -m dml_tpu localspec -n 4 -o /tmp/cluster.json
+    python -m dml_tpu introducer --spec /tmp/cluster.json
+    python -m dml_tpu node --spec /tmp/cluster.json --name H1
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from .config import ClusterSpec
+from .cluster.introducer import IntroducerService
+from .cluster.node import Node
+from .cluster.store_service import StoreService
+from .jobs.service import JobService
+
+log = logging.getLogger(__name__)
+
+MENU = """\
+membership commands:
+  1 | list_mem                      print the membership list
+  2 | self_id                       print this node's id
+  3 | join                          (re)join the cluster via the introducer
+  4 | leave                         voluntarily leave the cluster
+  9 | bps                           bytes/sec sent by the control plane
+ 10 | fp-rate                       failure-detector false-positive stats
+file commands (replicated store):
+  put <local> <sdfs>                upload (replicated, versioned)
+  get <sdfs> <local>                download latest version
+  get-versions <sdfs> <n> <local>   download last n versions, concatenated
+  delete <sdfs>                     delete everywhere
+  ls <sdfs>                         replicas holding the file
+  ls-all [pattern]                  files in the store (wildcard ok)
+  store                             files replicated on THIS node
+  load-testfiles <dir> [n]          bulk-put *.jpeg from a directory
+job commands (ML inference):
+  submit-job <model> <N>            run N queries (ResNet50 | InceptionV3)
+  get-output <jobid>                collect + merge a job's results
+  predict-locally <model> <f...>    single-node inference on local files
+  C1                                per-model query counts + rates
+  C2 <model>                        processing-time stats (mean/percentiles)
+  C3 <model> <batch_size>           set batch size cluster-wide
+  C5                                current worker->batch assignments
+other: help, quit
+"""
+
+
+class NodeApp:
+    """One running cluster node: Node + StoreService + JobService +
+    the interactive prompt."""
+
+    def __init__(self, spec: ClusterSpec, name: str):
+        me = spec.node_by_name(name) or spec.node_by_unique_name(name)
+        if me is None:
+            raise SystemExit(f"unknown node {name!r}; spec has {[n.name for n in spec.nodes]}")
+        self.spec = spec
+        self.node = Node(spec, me)
+        self.store = StoreService(self.node)
+        self.jobs = JobService(self.node, self.store)
+
+    async def start(self) -> None:
+        await self.node.start()
+        await self.store.start()
+        await self.jobs.start()
+
+    async def stop(self) -> None:
+        await self.jobs.stop()
+        await self.store.stop()
+        await self.node.stop()
+
+    # ---- command dispatch ----
+
+    async def handle(self, line: str) -> bool:
+        """Run one command; returns False when the app should exit."""
+        parts = line.split()
+        if not parts:
+            return True
+        cmd, args = parts[0], parts[1:]
+        try:
+            return await self._dispatch(cmd, args)
+        except (TimeoutError, asyncio.TimeoutError):
+            print("!! timed out (no leader reachable?)")
+        except (FileNotFoundError, RuntimeError, KeyError, ValueError) as e:
+            print(f"!! {e}")
+        return True
+
+    async def _dispatch(self, cmd: str, a: List[str]) -> bool:
+        n, s, j = self.node, self.store, self.jobs
+        t0 = time.monotonic()
+        if cmd in ("q", "quit", "exit"):
+            return False
+        elif cmd in ("h", "help", "?"):
+            print(MENU)
+        elif cmd in ("1", "list_mem"):
+            print(n.membership.format())
+        elif cmd in ("2", "self_id"):
+            print(n.me.unique_name, f"(leader={n.leader_unique})")
+        elif cmd in ("3", "join"):
+            n.rejoin()
+            print("rejoining via introducer...")
+        elif cmd in ("4", "leave"):
+            n.leave()
+            print("left the cluster (use 'join' to come back)")
+        elif cmd in ("9", "bps"):
+            st = n.stats()
+            print(f"bytes_sent={st['bytes_sent']} bps={st['bps']:.1f} "
+                  f"dropped={st['packets_dropped']}")
+        elif cmd in ("10", "fp-rate"):
+            st = n.stats()
+            print(f"false_positives={st['false_positives']} "
+                  f"indirect_failures={st['indirect_failures']}")
+        elif cmd == "put" and len(a) == 2:
+            r = await s.put(a[0], a[1])
+            print(f"ok version={r['version']} replicas={r['replicas']} "
+                  f"({time.monotonic() - t0:.2f}s)")
+        elif cmd == "get" and len(a) == 2:
+            v = await s.get(a[0], a[1])
+            print(f"ok version={v} -> {a[1]} ({time.monotonic() - t0:.2f}s)")
+        elif cmd == "get-versions" and len(a) == 3:
+            vs = await s.get_versions(a[0], int(a[1]), a[2])
+            print(f"ok versions={vs} -> {a[2]}")
+        elif cmd == "delete" and len(a) == 1:
+            await s.delete(a[0])
+            print("ok deleted")
+        elif cmd == "ls" and len(a) == 1:
+            print("\n".join(await s.ls(a[0])) or "(no replicas)")
+        elif cmd == "ls-all":
+            files = await s.ls_all(a[0] if a else "*")
+            for f, vs in sorted(files.items()):
+                print(f"{f}  versions={vs}")
+            print(f"({len(files)} files)")
+        elif cmd == "store":
+            for f, vs in sorted(s.local_files().items()):
+                print(f"{f}  versions={vs}")
+        elif cmd == "load-testfiles" and a:
+            await self._load_testfiles(a[0], int(a[1]) if len(a) > 1 else None)
+        elif cmd == "submit-job" and len(a) == 2:
+            job_id = await j.submit_job(a[0], int(a[1]))
+            print(f"job {job_id} submitted; waiting...")
+            r = await j.wait_job(job_id)
+            print(f"job {job_id} DONE: {r['total_queries']} queries "
+                  f"({time.monotonic() - t0:.2f}s)")
+        elif cmd == "get-output" and len(a) == 1:
+            dest = f"final_{a[0]}.json"
+            merged = await j.get_output(int(a[0]), dest)
+            print(f"ok {len(merged)} results -> {dest}")
+        elif cmd == "predict-locally" and len(a) >= 2:
+            r = await j.predict_locally(a[0], a[1:])
+            print(json.dumps(r["results"], indent=2))
+            print(f"exec_time={r['exec_time']:.3f}s")
+        elif cmd == "C1":
+            for m, stats in j.c1_stats().items():
+                print(f"{m}: total={stats['total_queries']:.0f} "
+                      f"rate={stats['rate_per_sec']:.2f}/s")
+        elif cmd == "C2" and len(a) == 1:
+            print(json.dumps(await j.c2_stats(a[0]), indent=2))
+        elif cmd == "C3" and len(a) == 2:
+            await j.set_batch_size(a[0], int(a[1]))
+            print("ok")
+        elif cmd == "C5":
+            print(json.dumps(j.c5_assignments(), indent=2))
+        else:
+            print(f"unknown command {cmd!r} (try 'help')")
+        return True
+
+    async def _load_testfiles(self, directory: str, limit: Optional[int]) -> None:
+        """Bulk-put a directory of images (reference CLI option 5,
+        worker.py:1696-1708)."""
+        directory = os.path.expanduser(directory)
+        names = sorted(
+            f for f in os.listdir(directory)
+            if f.lower().endswith((".jpeg", ".jpg"))
+        )[: limit or None]
+        for i, f in enumerate(names):
+            await self.store.put(os.path.join(directory, f), f)
+            print(f"  put {f} ({i + 1}/{len(names)})")
+        print(f"loaded {len(names)} files")
+
+    async def repl(self) -> None:
+        print(f"dml_tpu node {self.node.me} — 'help' for commands")
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                line = await loop.run_in_executor(None, sys.stdin.readline)
+            except (EOFError, KeyboardInterrupt):
+                break
+            if not line:  # EOF
+                break
+            if not await self.handle(line.strip()):
+                break
+
+
+def _setup_logging(verbose: bool, logfile: str = "debug.log") -> None:
+    """File + stdout logging (reference main.py:66-73)."""
+    handlers: List[logging.Handler] = [logging.FileHandler(logfile)]
+    if verbose:
+        handlers.append(logging.StreamHandler())
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        handlers=handlers,
+    )
+
+
+async def _run_node(args) -> None:
+    spec = ClusterSpec.from_file(args.spec)
+    if args.testing:
+        spec.testing = True
+        if args.drop_pct is not None:
+            spec.packet_drop_pct = args.drop_pct
+    app = NodeApp(spec, args.name)
+    await app.start()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        asyncio.get_running_loop().add_signal_handler(sig, stop.set)
+    if args.no_repl:
+        await stop.wait()
+    else:
+        repl_task = asyncio.create_task(app.repl())
+        stop_task = asyncio.create_task(stop.wait())
+        await asyncio.wait({repl_task, stop_task}, return_when=asyncio.FIRST_COMPLETED)
+    await app.stop()
+
+
+async def _run_introducer(args) -> None:
+    spec = ClusterSpec.from_file(args.spec)
+    svc = IntroducerService(spec)
+    await svc.start()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        asyncio.get_running_loop().add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await svc.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="dml_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pn = sub.add_parser("node", help="run a cluster node")
+    pn.add_argument("--spec", required=True, help="cluster spec JSON")
+    pn.add_argument("--name", required=True, help="node name (e.g. H1) or host:port")
+    pn.add_argument("-t", "--testing", action="store_true",
+                    help="test mode: enable loss injection + accounting")
+    pn.add_argument("--drop-pct", type=float, default=None,
+                    help="packet drop %% in test mode")
+    pn.add_argument("--no-repl", action="store_true",
+                    help="headless: no interactive prompt")
+    pn.add_argument("-v", "--verbose", action="store_true")
+
+    pi = sub.add_parser("introducer", help="run the introducer DNS")
+    pi.add_argument("--spec", required=True)
+    pi.add_argument("-v", "--verbose", action="store_true")
+
+    ps = sub.add_parser("localspec", help="emit a localhost cluster spec")
+    ps.add_argument("-n", type=int, default=4, help="number of nodes")
+    ps.add_argument("-o", "--out", default="-", help="output path (default stdout)")
+    ps.add_argument("--base-port", type=int, default=8001)
+
+    args = p.parse_args(argv)
+    if args.command == "localspec":
+        spec = ClusterSpec.localhost(args.n, base_port=args.base_port)
+        text = spec.to_json()
+        if args.out == "-":
+            print(text)
+        else:
+            with open(args.out, "w") as f:
+                f.write(text)
+        return
+    _setup_logging(getattr(args, "verbose", False))
+    if args.command == "node":
+        asyncio.run(_run_node(args))
+    elif args.command == "introducer":
+        asyncio.run(_run_introducer(args))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -26,6 +26,11 @@ class RequestStatus:
     replicas: Dict[str, str] = field(default_factory=dict)  # node -> pending|ok|fail
     version: int = 0
     client_rid: str = ""  # the requester's rid, echoed in the final reply
+    # fan-out resend support (the control plane is at-most-once UDP):
+    # the per-replica message payload, re-sent to still-pending
+    # replicas until they ACK
+    fanout_payload: Dict = field(default_factory=dict)
+    last_sent: float = 0.0
 
     def set_status(self, node: str, status: str) -> None:
         if node in self.replicas:

@@ -25,6 +25,13 @@ Differences (intent over accident, SURVEY §7):
 - request/response correlation by rid futures, not single-slot events
 - the standby's file table stays warm via ALL_LOCAL_FILES_RELAY, and
   COORDINATE_ACK reconciliation rebuilds it authoritatively on failover
+
+Known limitation: the PUT/DELETE idempotency caches (`_put_tokens`,
+`_recent_deletes`) are leader-local. A client retry that crosses a
+leader failover may mint one duplicate version of the same content
+(benign in a versioned store) or report "file not found" for a delete
+that committed just before the failover. Relaying these caches to the
+standby would close the window; the cost/benefit hasn't justified it.
 """
 
 from __future__ import annotations
@@ -32,6 +39,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..config import ClusterSpec, NodeId, StoreConfig
@@ -39,6 +47,7 @@ from .node import Node
 from .store.data_plane import DataPlane
 from .store.local_store import LocalStore
 from .store.metadata import StoreMetadata
+from .util import BoundedDict, leader_retry
 from .wire import Message, MsgType
 
 log = logging.getLogger(__name__)
@@ -72,12 +81,57 @@ class StoreService:
         node.on_coordinate_ack_cbs.append(self._on_coordinate_ack)
         node.on_node_failed_cbs.append(self._on_node_failed)
         node.on_replication_needed_cbs.append(self._on_replication_needed)
+        # loss tolerance over the at-most-once UDP control plane:
+        # PUT idempotency tokens (client retries can't double-version)
+        # and a leader-side resend tick for un-ACKed fan-outs
+        # token -> in-flight req_id, or ("done", ok, reply) once resolved
+        self._put_tokens: BoundedDict = BoundedDict(1000)
+        # files whose delete completed recently: a retried DELETE whose
+        # success reply was dropped must converge to success, not
+        # "file not found"
+        self._recent_deletes: BoundedDict = BoundedDict(200)
+        self._resend_task: Optional[asyncio.Task] = None
+        self.resend_after = max(1.0, 4 * node.spec.timing.ping_interval)
 
     async def start(self) -> None:
         await self.data_plane.start()
+        self._resend_task = asyncio.create_task(
+            self._resend_loop(), name=f"{self._me}-store-resend"
+        )
 
     async def stop(self) -> None:
+        if self._resend_task is not None:
+            self._resend_task.cancel()
+            try:
+                await self._resend_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._resend_task = None
         await self.data_plane.stop()
+
+    async def _resend_loop(self) -> None:
+        """Re-send fan-out messages to replicas that haven't ACKed
+        (covers a dropped DOWNLOAD_FILE/DELETE_FILE or a dropped ACK;
+        replica handlers are idempotent so re-delivery is safe)."""
+        interval = max(self.node.spec.timing.ping_interval, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            if not self.node.is_leader:
+                continue
+            now = time.monotonic()
+            try:
+                for req_id, st in list(self.metadata.requests.items()):
+                    if not st.fanout_payload or now - st.last_sent <= self.resend_after:
+                        continue
+                    st.last_sent = now
+                    mtype = (
+                        MsgType.DOWNLOAD_FILE if st.op == "put" else MsgType.DELETE_FILE
+                    )
+                    for r in st.pending_nodes:
+                        if self.node.membership.is_alive(r):
+                            self.node.send_unique(r, mtype, st.fanout_payload)
+            except Exception:
+                log.exception("%s: store resend tick failed", self._me)
 
     # ------------------------------------------------------------------
     # helpers
@@ -109,15 +163,22 @@ class StoreService:
     # client verbs (reference CLI file commands, worker.py:1810-1958)
     # ------------------------------------------------------------------
 
+    async def _leader_retry(
+        self, mtype: MsgType, data: Dict[str, Any], timeout: float, retries: int = 3
+    ) -> Dict[str, Any]:
+        return await leader_retry(self.node, mtype, data, timeout, retries)
+
     async def put(self, local_path: str, sdfs_name: str, timeout: float = 60.0) -> Dict[str, Any]:
         """`put <local> <sdfs>` — upload with `replication_factor`-way
-        replication (§3.3)."""
+        replication (§3.3). Retried with an idempotency token: a
+        duplicate PUT_REQUEST joins the in-flight request (or re-fetches
+        the completed reply) instead of minting a second version."""
         local_path = os.path.abspath(os.path.expanduser(local_path))
         if not os.path.isfile(local_path):
             raise FileNotFoundError(local_path)
         token = self.data_plane.expose(local_path)
         try:
-            reply = await self.node.leader_request(
+            reply = await self._leader_retry(
                 MsgType.PUT_REQUEST,
                 {
                     "file": sdfs_name,
@@ -142,7 +203,7 @@ class StoreService:
         """`get <sdfs> <local>` — download one version (latest default)
         from any live replica (reference get_file_locally,
         worker.py:1323-1354). Returns the version fetched."""
-        reply = await self.node.leader_request(
+        reply = await self._leader_retry(
             MsgType.GET_FILE_REQUEST, {"file": sdfs_name}, timeout=timeout
         )
         if not reply.get("ok"):
@@ -172,7 +233,7 @@ class StoreService:
         """`get-versions <sdfs> <n> <local>` — latest n versions,
         concatenated with version markers (reference worker.py:1833-1880
         writes them into one output file)."""
-        reply = await self.node.leader_request(
+        reply = await self._leader_retry(
             MsgType.GET_FILE_REQUEST, {"file": sdfs_name}, timeout=timeout
         )
         if not reply.get("ok"):
@@ -202,7 +263,7 @@ class StoreService:
         return got
 
     async def delete(self, sdfs_name: str, timeout: float = 60.0) -> Dict[str, Any]:
-        reply = await self.node.leader_request(
+        reply = await self._leader_retry(
             MsgType.DELETE_FILE_REQUEST, {"file": sdfs_name}, timeout=timeout
         )
         if not reply.get("ok"):
@@ -211,16 +272,16 @@ class StoreService:
 
     async def ls(self, sdfs_name: str) -> List[str]:
         """`ls <sdfs>` — replica nodes currently holding the file."""
-        reply = await self.node.leader_request(
-            MsgType.LIST_FILE_REQUEST, {"file": sdfs_name}
+        reply = await self._leader_retry(
+            MsgType.LIST_FILE_REQUEST, {"file": sdfs_name}, timeout=15.0
         )
         return reply.get("replicas", [])
 
     async def ls_all(self, pattern: str = "*") -> Dict[str, List[int]]:
         """`ls-all <pattern>` — wildcard search over the global table
         (reference get_all_matching_files, leader.py:104-111)."""
-        reply = await self.node.leader_request(
-            MsgType.GET_ALL_MATCHING_FILES, {"pattern": pattern}
+        reply = await self._leader_retry(
+            MsgType.GET_ALL_MATCHING_FILES, {"pattern": pattern}, timeout=15.0
         )
         return {f: [int(v) for v in vs] for f, vs in reply.get("files", {}).items()}
 
@@ -294,6 +355,27 @@ class StoreService:
             return
         file = msg.data["file"]
         rid = msg.data.get("rid", "")
+        token = msg.data.get("token", "")
+        # idempotency: a client retry of an in-flight PUT re-targets the
+        # final reply at the new rid; a retry of a resolved PUT gets the
+        # recorded outcome (success OR failure) — never a second version
+        if token in self._put_tokens:
+            prior = self._put_tokens[token]
+            if isinstance(prior, tuple) and prior[0] == "done":
+                _, ok, reply = prior
+                self.node.send_unique(
+                    msg.sender,
+                    MsgType.PUT_REQUEST_SUCCESS if ok else MsgType.PUT_REQUEST_FAIL,
+                    {**reply, "rid": rid},
+                )
+                return
+            st = self.metadata.get_request(prior)
+            if st is not None:
+                st.client_rid = rid
+                return
+            # request vanished without a recorded outcome (shouldn't
+            # happen): fall through and treat as a fresh PUT
+            del self._put_tokens[token]
         live = self._live_node_names()
         replicas = self.metadata.place(file, live)
         if not replicas:
@@ -303,20 +385,37 @@ class StoreService:
             )
             return
         version = self.metadata.assign_version(file)
+        self._recent_deletes.pop(file, None)  # the file exists again
         req_id = self.metadata.new_request("put", file, msg.sender, replicas, version)
-        self.metadata.requests[req_id].client_rid = rid
+        st = self.metadata.requests[req_id]
+        st.client_rid = rid
+        st.fanout_payload = {
+            "req": req_id,
+            "file": file,
+            "version": version,
+            "token": msg.data["token"],
+            "data_addr": msg.data["data_addr"],
+        }
+        st.last_sent = time.monotonic()
+        if token:
+            self._put_tokens[token] = req_id
         for r in replicas:
-            self.node.send_unique(
-                r,
-                MsgType.DOWNLOAD_FILE,
-                {
-                    "req": req_id,
-                    "file": file,
-                    "version": version,
-                    "token": msg.data["token"],
-                    "data_addr": msg.data["data_addr"],
-                },
-            )
+            self.node.send_unique(r, MsgType.DOWNLOAD_FILE, st.fanout_payload)
+
+    def _resolve_put(self, req_id: str, st, ok: bool, reply: Dict[str, Any]) -> None:
+        """Single resolution point for a PUT request: finish it, record
+        the outcome against its idempotency token (so a retried
+        PUT_REQUEST re-fetches the verdict no matter which path
+        resolved it), and answer the client."""
+        self.metadata.finish_request(req_id)
+        token = st.fanout_payload.get("token", "")
+        if token:
+            self._put_tokens[token] = ("done", ok, reply)
+        self.node.send_unique(
+            st.requester,
+            MsgType.PUT_REQUEST_SUCCESS if ok else MsgType.PUT_REQUEST_FAIL,
+            reply,
+        )
 
     async def _h_download_result(self, msg: Message, addr) -> None:
         """Replica finished (or failed) pulling a PUT (reference
@@ -333,29 +432,19 @@ class StoreService:
         if ok:
             self.metadata.record_replica(msg.sender, st.file, st.version)
         if st.completed:
-            self.metadata.finish_request(req_id)
-            self.node.send_unique(
-                st.requester,
-                MsgType.PUT_REQUEST_SUCCESS,
-                {
-                    "rid": st.client_rid,
-                    "ok": True,
-                    "file": st.file,
-                    "version": st.version,
-                    "replicas": self.metadata.replicas_of(st.file),
-                },
-            )
+            self._resolve_put(req_id, st, True, {
+                "rid": st.client_rid,
+                "ok": True,
+                "file": st.file,
+                "version": st.version,
+                "replicas": self.metadata.replicas_of(st.file),
+            })
         elif st.failed:
-            self.metadata.finish_request(req_id)
-            self.node.send_unique(
-                st.requester,
-                MsgType.PUT_REQUEST_FAIL,
-                {
-                    "rid": st.client_rid,
-                    "ok": False,
-                    "error": f"replica {msg.sender} failed: {msg.data.get('error')}",
-                },
-            )
+            self._resolve_put(req_id, st, False, {
+                "rid": st.client_rid,
+                "ok": False,
+                "error": f"replica {msg.sender} failed: {msg.data.get('error')}",
+            })
 
     async def _h_get_file_request(self, msg: Message, addr) -> None:
         """Leader GET: reply replica set + versions; the client pulls
@@ -395,16 +484,28 @@ class StoreService:
         rid = msg.data.get("rid", "")
         holders = [r for r in self.metadata.replicas_of(file) if self.node.membership.is_alive(r)]
         if not holders:
-            self.node.send_unique(
-                msg.sender,
-                MsgType.DELETE_FILE_REQUEST_FAIL,
-                {"rid": rid, "ok": False, "error": "file not found"},
-            )
+            if file in self._recent_deletes:
+                # retry of a completed delete whose reply was dropped:
+                # converge to success, not "file not found"
+                self.node.send_unique(
+                    msg.sender,
+                    MsgType.DELETE_FILE_REQUEST_SUCCESS,
+                    {"rid": rid, "ok": True, "file": file},
+                )
+            else:
+                self.node.send_unique(
+                    msg.sender,
+                    MsgType.DELETE_FILE_REQUEST_FAIL,
+                    {"rid": rid, "ok": False, "error": "file not found"},
+                )
             return
         req_id = self.metadata.new_request("delete", file, msg.sender, holders)
-        self.metadata.requests[req_id].client_rid = rid
+        st = self.metadata.requests[req_id]
+        st.client_rid = rid
+        st.fanout_payload = {"req": req_id, "file": file}
+        st.last_sent = time.monotonic()
         for r in holders:
-            self.node.send_unique(r, MsgType.DELETE_FILE, {"req": req_id, "file": file})
+            self.node.send_unique(r, MsgType.DELETE_FILE, st.fanout_payload)
 
     async def _h_delete_result(self, msg: Message, addr) -> None:
         if not self.node.is_leader:
@@ -421,6 +522,7 @@ class StoreService:
         self.metadata.finish_request(req_id)
         if done_ok:
             self.metadata.remove_file(st.file)
+            self._recent_deletes[st.file] = True
         self.node.send_unique(
             st.requester,
             MsgType.DELETE_FILE_REQUEST_SUCCESS if done_ok else MsgType.DELETE_FILE_REQUEST_FAIL,
@@ -488,10 +590,13 @@ class StoreService:
             )
 
     async def _h_delete_file(self, msg: Message, addr) -> None:
-        ok = self.store.delete(msg.data["file"])
+        # idempotent: deleting an already-absent file ACKs success, so
+        # a re-sent DELETE (after a dropped ACK) converges instead of
+        # NAKing and failing the request
+        self.store.delete(msg.data["file"])
         self.node.send_unique(
             msg.sender,
-            MsgType.DELETE_FILE_ACK if ok else MsgType.DELETE_FILE_NAK,
+            MsgType.DELETE_FILE_ACK,
             {"req": msg.data.get("req"), "file": msg.data["file"]},
         )
 
@@ -546,36 +651,36 @@ class StoreService:
             if not st.replicas:
                 # every replica died mid-flight: fail loudly, never
                 # report a vacuous success
-                self.metadata.finish_request(req_id)
-                self.node.send_unique(
-                    st.requester,
-                    MsgType.PUT_REQUEST_FAIL
-                    if st.op == "put"
-                    else MsgType.DELETE_FILE_REQUEST_FAIL,
-                    {
-                        "rid": st.client_rid,
-                        "ok": False,
-                        "file": st.file,
-                        "error": "all replicas failed during the request",
-                    },
-                )
+                fail_reply = {
+                    "rid": st.client_rid,
+                    "ok": False,
+                    "file": st.file,
+                    "error": "all replicas failed during the request",
+                }
+                if st.op == "put":
+                    self._resolve_put(req_id, st, False, fail_reply)
+                else:
+                    self.metadata.finish_request(req_id)
+                    self.node.send_unique(
+                        st.requester, MsgType.DELETE_FILE_REQUEST_FAIL, fail_reply
+                    )
             elif st.completed:
-                self.metadata.finish_request(req_id)
-                if st.op == "delete":
+                ok_reply = {
+                    "rid": st.client_rid,
+                    "ok": True,
+                    "file": st.file,
+                    "version": st.version,
+                    "replicas": self.metadata.replicas_of(st.file),
+                }
+                if st.op == "put":
+                    self._resolve_put(req_id, st, True, ok_reply)
+                else:
+                    self.metadata.finish_request(req_id)
                     self.metadata.remove_file(st.file)
-                self.node.send_unique(
-                    st.requester,
-                    MsgType.PUT_REQUEST_SUCCESS
-                    if st.op == "put"
-                    else MsgType.DELETE_FILE_REQUEST_SUCCESS,
-                    {
-                        "rid": st.client_rid,
-                        "ok": True,
-                        "file": st.file,
-                        "version": st.version,
-                        "replicas": self.metadata.replicas_of(st.file),
-                    },
-                )
+                    self._recent_deletes[st.file] = True
+                    self.node.send_unique(
+                        st.requester, MsgType.DELETE_FILE_REQUEST_SUCCESS, ok_reply
+                    )
 
     def _on_replication_needed(self, cleaned: List[str]) -> None:
         """Enough nodes died: bring every file back to

@@ -46,9 +46,12 @@ class LossInjector:
         random.Random(seed).shuffle(slots)
         self._slots = slots
         self._i = 0
+        # fault-injection switch: tests can phase loss in (e.g. seed
+        # the store losslessly, then stress the job pipeline)
+        self.enabled = True
 
     def should_drop(self) -> bool:
-        if not self._slots or self.pct <= 0:
+        if not self.enabled or not self._slots or self.pct <= 0:
             return False
         drop = self._slots[self._i]
         self._i = (self._i + 1) % len(self._slots)
@@ -68,6 +71,9 @@ class UdpTransport(asyncio.DatagramProtocol):
         self.packets_sent = 0
         self.packets_dropped = 0
         self.first_send_time: Optional[float] = None
+
+    def set_loss_enabled(self, enabled: bool) -> None:
+        self._loss.enabled = enabled
 
     # -- DatagramProtocol callbacks --
 

@@ -1,0 +1,53 @@
+"""Small shared helpers for services built on the at-most-once UDP
+control plane."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+from .wire import MsgType
+
+
+class BoundedDict(dict):
+    """Dict that evicts its oldest insertion beyond `maxlen` — for
+    idempotency-token and recently-completed caches that must not grow
+    with a long-lived process."""
+
+    def __init__(self, maxlen: int = 1000):
+        super().__init__()
+        self.maxlen = maxlen
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        while len(self) > self.maxlen:
+            del self[next(iter(self))]
+
+    def setdefault(self, key, default=None):
+        # dict.setdefault is C-level and bypasses __setitem__; route it
+        # through so the bound holds for setdefault-populated caches
+        if key not in self:
+            self[key] = default
+            return default
+        return self[key]
+
+
+async def leader_retry(
+    node,
+    mtype: MsgType,
+    data: Dict[str, Any],
+    timeout: float,
+    retries: int = 3,
+) -> Dict[str, Any]:
+    """node.leader_request with retry on timeout: a dropped request or
+    reply datagram must not strand the caller. Callers ensure the
+    operation is idempotent (reads naturally; writes via dedup
+    tokens)."""
+    last: Optional[Exception] = None
+    per_try = max(0.5, timeout / max(1, retries))
+    for _ in range(max(1, retries)):
+        try:
+            return await node.leader_request(mtype, data, timeout=per_try)
+        except asyncio.TimeoutError as e:
+            last = e
+    raise TimeoutError(f"{mtype.name} got no reply after {retries} tries") from last

@@ -78,6 +78,10 @@ class MsgType(enum.IntEnum):
     SET_BATCH_SIZE = 67  # C3 (reference worker.py:1028-1037)
     GET_C2_COMMAND = 68
     GET_C2_COMMAND_ACK = 69
+    SET_BATCH_SIZE_ACK = 70
+    WORKER_TASK_FAIL = 71
+    JOB_STATUS_REQUEST = 72
+    JOB_STATUS_ACK = 73
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,22 @@
+"""ML job pipeline: intake, batching, fair-share scheduling, execution.
+
+TPU-native rebuild of the reference's L7 (worker.py:176-495, 518-537,
+887-1026) — see `cost_model` (analytical model + fair split),
+`scheduler` (pure-logic coordinator state machine), and `service`
+(the Node-attached I/O wiring).
+"""
+
+from .cost_model import ModelCost, batch_exec_time, query_rate, fair_split
+from .scheduler import Batch, JobState, Scheduler
+from .service import JobService
+
+__all__ = [
+    "ModelCost",
+    "batch_exec_time",
+    "query_rate",
+    "fair_split",
+    "Batch",
+    "JobState",
+    "Scheduler",
+    "JobService",
+]

@@ -1,0 +1,118 @@
+"""Analytical cost model + fair-share VM split.
+
+The reference predicts a batch's wall time on a worker VM as
+
+    T(B) = download*B + load + first + per_image*(B-1)
+
+(models.py:128-139) with constants measured once on CPU and hardcoded
+(worker.py:57-89). Its scheduler then picks the VM split between the
+two active models that minimizes the *relative difference of their
+query rates* (worker.py:303-324).
+
+The TPU cost structure differs in two ways, so the model is a
+parameterized dataclass rather than baked constants:
+
+- both models stay resident in HBM, so `load` is paid once per worker
+  lifetime, not per batch; the steady-state per-batch time is
+  `download*B + first_amortized + per_query*B` where `first` only
+  matters right after a batch-size change (recompile);
+- `per_query` on TPU is the batch step time / B measured by the
+  engine at warmup (engine.cost_constants), typically two orders of
+  magnitude below the reference's 250-325 ms/image CPU numbers.
+
+The split search itself is the reference's exact semantics: enumerate
+all (i, j) with i+j == n_workers, i,j >= 1, pick the argmin of
+|rate_a - rate_b| / max(rate_a, rate_b).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ModelCost:
+    """Per-model scheduling constants (reference ModelParameters,
+    models.py:128-139). `resident=True` is the TPU regime: weights
+    stay in HBM so load time is excluded from steady-state batches."""
+
+    load_time: float
+    first_query: float
+    per_query: float
+    download_time: float = 0.05
+    batch_size: int = 32
+    resident: bool = True
+
+    def with_measurements(
+        self,
+        load_time: Optional[float] = None,
+        first_query: Optional[float] = None,
+        per_query: Optional[float] = None,
+        batch_size: Optional[int] = None,
+    ) -> "ModelCost":
+        """Fold in engine warmup measurements (the reference hardcodes
+        its constants; we re-measure on the real device)."""
+        kw = {}
+        if load_time is not None:
+            kw["load_time"] = load_time
+        if first_query is not None:
+            kw["first_query"] = first_query
+        if per_query is not None:
+            kw["per_query"] = per_query
+        if batch_size is not None:
+            kw["batch_size"] = batch_size
+        return replace(self, **kw)
+
+
+def batch_exec_time(cost: ModelCost, batch: Optional[int] = None) -> float:
+    """Predicted wall time of one batch on one worker.
+
+    Reference formula (models.py:138-139): dl*B + load + first + per*(B-1).
+    TPU steady state drops the per-batch `load` and folds `first` into
+    compile-time only; one batched XLA program costs per_query*B.
+    """
+    b = batch if batch is not None else cost.batch_size
+    if b <= 0:
+        return 0.0
+    if cost.resident:
+        return cost.download_time * b + cost.per_query * b
+    return cost.download_time * b + cost.load_time + cost.first_query + cost.per_query * (b - 1)
+
+
+def query_rate(cost: ModelCost, n_workers: int, batch: Optional[int] = None) -> float:
+    """Predicted queries/sec with `n_workers` VMs running this model
+    (reference: rate = vms * batch_size / exec_time, worker.py:303-324)."""
+    b = batch if batch is not None else cost.batch_size
+    t = batch_exec_time(cost, b)
+    if t <= 0 or n_workers <= 0:
+        return 0.0
+    return n_workers * b / t
+
+
+def fair_split(
+    n_workers: int, cost_a: ModelCost, cost_b: ModelCost
+) -> Tuple[int, int]:
+    """Split `n_workers` between two active models to minimize the
+    relative difference of their predicted query rates (the reference's
+    dual-model case, worker.py:303-324: enumerate every split, argmin
+    |r_a - r_b| / max). Each model gets at least one worker when
+    n_workers >= 2."""
+    if n_workers <= 0:
+        return (0, 0)
+    if n_workers == 1:
+        # single worker: give it to the slower model (higher per-query
+        # time) so the worst-case rate is maximized
+        return (1, 0) if batch_exec_time(cost_a) >= batch_exec_time(cost_b) else (0, 1)
+    best = (1, n_workers - 1)
+    best_score = float("inf")
+    for i in range(1, n_workers):
+        j = n_workers - i
+        ra = query_rate(cost_a, i)
+        rb = query_rate(cost_b, j)
+        hi = max(ra, rb)
+        score = abs(ra - rb) / hi if hi > 0 else 0.0
+        if score < best_score:
+            best_score = score
+            best = (i, j)
+    return best

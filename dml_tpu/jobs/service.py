@@ -1,0 +1,746 @@
+"""Job service: attaches the ML job pipeline to a Node.
+
+Rebuilds the reference's L7 I/O wiring (worker.py:176-537, 887-1059,
+1356-1459, 1573-1627) on top of the pure-logic Scheduler:
+
+- coordinator role (while node.is_leader): job intake, fair-share
+  scheduling, ACK bookkeeping, completion notification, C1/C2/C3/C5
+  metrics, standby relays
+- worker role (every node): execute WORKER_TASK_REQUESTs — fetch the
+  batch's images over the store data plane, run the batched forward on
+  the TPU engine, PUT the output JSON into the replicated store, ACK
+  the coordinator with timing
+- standby role (the computed election runner-up): mirror the
+  primary's queues from SUBMIT_JOB_RELAY / WORKER_TASK_ACK_RELAY so a
+  failover resumes scheduling with no lost work (reference
+  worker.py:887-897, 965-986; promotion worker.py:577-588)
+
+TPU-specific deltas from the reference (SURVEY §7 hard part #2):
+- "preemption" on a worker cancels only the host-side task; both
+  models stay resident in HBM so the switch costs nothing (the
+  reference pays a model reload per switch, which its cost model
+  charges for)
+- the scheduler's cost constants are *measured* on the device (engine
+  warmup) and piggybacked on task ACKs back to the coordinator; the
+  reference hardcodes CPU measurements (worker.py:57-89)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import os
+import time
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ..config import NodeId
+from ..cluster.node import Node
+from ..cluster.store_service import StoreService, data_addr
+from ..cluster.util import BoundedDict, leader_retry
+from ..cluster.wire import Message, MsgType
+from ..models.registry import MODEL_REGISTRY, get_model
+from .cost_model import ModelCost
+from .scheduler import Assignment, Batch, Scheduler
+
+log = logging.getLogger(__name__)
+
+# (files_dict, exec_time_s, cost_constants_or_None)
+InferBackend = Callable[[str, List[str]], Awaitable[Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]]]
+
+
+class JobService:
+    """One per node. Acts in coordinator/worker/standby roles depending
+    on the node's current cluster position."""
+
+    def __init__(
+        self,
+        node: Node,
+        store: StoreService,
+        infer_backend: Optional[InferBackend] = None,
+        image_pattern: str = "*.jpeg",
+    ):
+        self.node = node
+        self.store = store
+        self.image_pattern = image_pattern
+        self._backend = infer_backend or self._engine_backend
+        self._engine = None  # lazy InferenceEngine (imports jax on first use)
+        self.scheduler = Scheduler(costs=self._seed_costs())
+        self._current: Optional[Tuple[Tuple[int, int], asyncio.Task]] = None
+        # client-side completion futures; bounded so fire-and-forget
+        # submitters don't leak (evicted callers fall back to polling)
+        self._job_done: BoundedDict = BoundedDict(1000)
+        self._sched_task: Optional[asyncio.Task] = None
+        # loss tolerance over the at-most-once UDP transport: the
+        # coordinator re-sends un-ACKed assignments (covers both a lost
+        # WORKER_TASK_REQUEST and a lost ACK; batch-completion dedup in
+        # the scheduler absorbs the resulting re-execution), and every
+        # assignment carries a monotonic seq so a reordered stale
+        # request can't cancel a newer batch on the worker
+        self._task_seq = itertools.count(1)
+        # incarnation stamp: a restarted coordinator's seq counter
+        # restarts at 1, so workers compare seqs only within one
+        # incarnation (keyed per sender as (inc, last_seq))
+        self._incarnation = int(time.time() * 1000)
+        self._assigned_at: Dict[str, Tuple[Tuple[int, int], float]] = {}
+        self._last_seq: Dict[str, Tuple[int, int]] = {}  # sender -> (inc, seq)
+        self.task_resend_after = max(
+            1.0, 4 * node.spec.timing.ping_interval
+        )
+        # submit idempotency tokens -> job id
+        self._submit_tokens: BoundedDict = BoundedDict(1000)
+        self._register()
+        node.on_node_failed_cbs.append(self._on_node_failed)
+        node.on_became_leader_cbs.append(self._on_became_leader)
+
+    @staticmethod
+    def _seed_costs() -> Dict[str, ModelCost]:
+        """Registry priors; replaced by device measurements as ACKs
+        arrive."""
+        costs: Dict[str, ModelCost] = {}
+        for spec in set(MODEL_REGISTRY.values()):
+            c = spec.cost
+            costs[spec.name] = ModelCost(
+                load_time=c.load_time,
+                first_query=c.first_query,
+                per_query=c.per_query,
+                download_time=c.download_time,
+                batch_size=c.default_batch_size,
+            )
+        return costs
+
+    async def start(self) -> None:
+        self._sched_task = asyncio.create_task(
+            self._schedule_loop(), name=f"{self.node.me}-sched"
+        )
+
+    async def stop(self) -> None:
+        for t in (self._sched_task, self._current[1] if self._current else None):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        self._sched_task = None
+        self._current = None
+
+    # ------------------------------------------------------------------
+    # roles
+    # ------------------------------------------------------------------
+
+    @property
+    def _me(self) -> str:
+        return self.node.me.unique_name
+
+    def worker_pool(self) -> List[str]:
+        """Live workers = alive nodes minus coordinator and standby
+        (reference hardcodes H3..H10, worker.py:52). A cluster too
+        small to spare dedicated coordinators uses every live node —
+        this is also the single-node "leader = self" mode (SURVEY §7
+        minimum slice)."""
+        alive = [n.unique_name for n in self.node.membership.alive_nodes()]
+        leader = self.node.leader_unique
+        sb = self.store.standby_node()
+        standby = sb.unique_name if sb else None
+        pool = [u for u in alive if u != leader and u != standby]
+        return pool if pool else alive
+
+    # ------------------------------------------------------------------
+    # client verbs (reference CLI submit-job / get-output /
+    # predict-locally, worker.py:1744-1997)
+    # ------------------------------------------------------------------
+
+    async def submit_job(
+        self, model: str, n_queries: int, timeout: float = 20.0, retries: int = 3
+    ) -> int:
+        """`submit-job <model> <N>`: returns the job id. Await
+        `wait_job(job_id)` for completion.
+
+        The request carries an idempotency token and is retried on
+        timeout (the transport is at-most-once UDP); the coordinator
+        dedups by token so a retry can't mint a second job."""
+        model = get_model(model).name
+        token = self.node.new_rid()
+        reply = await leader_retry(
+            self.node,
+            MsgType.SUBMIT_JOB_REQUEST,
+            {"model": model, "n": int(n_queries), "token": token},
+            timeout=timeout,
+            retries=retries,
+        )
+        if not reply.get("ok"):
+            raise RuntimeError(f"submit-job failed: {reply.get('error')}")
+        job_id = int(reply["job_id"])
+        self._job_done.setdefault(
+            job_id, asyncio.get_running_loop().create_future()
+        )
+        return job_id
+
+    async def wait_job(self, job_id: int, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Wait for completion. Primary signal is the coordinator's
+        SUBMIT_JOB_REQUEST_SUCCESS push; because that is a single
+        unacked datagram, we also poll job status as a fallback so a
+        dropped notification (or a failover) can't strand the caller."""
+        fut = self._job_done.setdefault(
+            job_id, asyncio.get_running_loop().create_future()
+        )
+
+        async def waiter() -> Dict[str, Any]:
+            unknown = 0
+            while not fut.done():
+                try:
+                    return await asyncio.wait_for(asyncio.shield(fut), 1.0)
+                except asyncio.TimeoutError:
+                    try:
+                        reply = await self.node.leader_request(
+                            MsgType.JOB_STATUS_REQUEST, {"job": job_id}, timeout=2.0
+                        )
+                    except Exception:
+                        continue
+                    if reply.get("done") and not fut.done():
+                        fut.set_result(dict(reply))
+                    elif not reply.get("ok"):
+                        # the (possibly newly-elected) coordinator has no
+                        # record of this job: the standby relay was lost
+                        # before the failover. Surface it instead of
+                        # polling forever; the caller resubmits.
+                        unknown += 1
+                        if unknown >= 5:
+                            raise RuntimeError(
+                                f"job {job_id} lost (coordinator has no record; "
+                                "resubmit)"
+                            )
+                    else:
+                        unknown = 0
+            return fut.result()
+
+        try:
+            return await asyncio.wait_for(waiter(), timeout)
+        finally:
+            if fut.done():
+                self._job_done.pop(job_id, None)
+
+    async def get_output(self, job_id: int, dest_path: str) -> Dict[str, Any]:
+        """`get-output <jobid>`: collect every worker's
+        output_<job>_<batch>_<host>.json from the store and merge into
+        final_<jobid>.json (reference get_output_cli +
+        merge_all_json_files, worker.py:1513-1534, 1617-1627)."""
+        listing = await self.store.ls_all(f"output_{job_id}_*.json")
+        merged: Dict[str, Any] = {}
+        tmpdir = self.store.cfg.download_path()
+        os.makedirs(tmpdir, exist_ok=True)
+        for name in sorted(listing):
+            local = os.path.join(tmpdir, name)
+            await self.store.get(name, local)
+            with open(local) as f:
+                part = json.load(f)
+            for k, v in part.items():
+                merged.setdefault(k, v)
+        dest_path = os.path.abspath(os.path.expanduser(dest_path))
+        os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+        with open(dest_path, "w") as f:
+            json.dump(merged, f, indent=2)
+        return merged
+
+    async def predict_locally(self, model: str, files: List[str]) -> Dict[str, Any]:
+        """`predict-locally <model> <files...>` (reference
+        worker.py:1573-1585): run inference on this node, no cluster."""
+        results, exec_time, _ = await self._backend(get_model(model).name, files)
+        return {"results": results, "exec_time": exec_time}
+
+    async def set_batch_size(self, model: str, batch_size: int) -> None:
+        """C3 verb: cluster-wide batch size change (reference
+        SET_BATCH_SIZE, worker.py:1028-1037)."""
+        await self.node.leader_request(
+            MsgType.SET_BATCH_SIZE,
+            {"model": get_model(model).name, "batch_size": int(batch_size)},
+        )
+
+    async def c2_stats(self, model: str) -> Dict[str, float]:
+        """C2: processing-time stats, computed on the coordinator,
+        fetchable from any node (reference GET_C2_COMMAND,
+        worker.py:1039-1059)."""
+        reply = await self.node.leader_request(
+            MsgType.GET_C2_COMMAND, {"model": get_model(model).name}
+        )
+        return reply.get("stats", {})
+
+    def c1_stats(self) -> Dict[str, Dict[str, float]]:
+        """C1 is local to the coordinator; non-coordinators show their
+        shadow counts (reference prints on the leader)."""
+        return self.scheduler.c1_stats()
+
+    def c5_assignments(self) -> Dict[str, Any]:
+        return self.scheduler.c5_assignments()
+
+    # ------------------------------------------------------------------
+    # handler registration
+    # ------------------------------------------------------------------
+
+    def _register(self) -> None:
+        n = self.node
+        n.register(MsgType.SUBMIT_JOB_REQUEST, self._h_submit_job)
+        n.register(MsgType.SUBMIT_JOB_REQUEST_SUCCESS, self._h_job_success)
+        n.register(MsgType.SUBMIT_JOB_RELAY, self._h_submit_relay)
+        n.register(MsgType.WORKER_TASK_REQUEST, self._h_task_request)
+        n.register(MsgType.WORKER_TASK_REQUEST_ACK, self._h_task_ack)
+        n.register(MsgType.WORKER_TASK_FAIL, self._h_task_fail)
+        n.register(MsgType.WORKER_TASK_ACK_RELAY, self._h_ack_relay)
+        n.register(MsgType.SET_BATCH_SIZE, self._h_set_batch_size)
+        n.register(MsgType.GET_C2_COMMAND, self._h_get_c2)
+        n.register(MsgType.JOB_STATUS_REQUEST, self._h_job_status)
+
+    # ------------------------------------------------------------------
+    # coordinator side
+    # ------------------------------------------------------------------
+
+    async def _schedule_loop(self) -> None:
+        """Periodic scheduling tick: catches workers that joined after
+        the last event-driven round (the reference reschedules only on
+        ACKs, worker.py:1025-1026, so late joiners idle until one)."""
+        interval = max(self.node.spec.timing.ping_interval, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                if self.node.is_leader:
+                    self._run_schedule()
+                    self._resend_stale_assignments()
+            except Exception:
+                log.exception("%s: scheduling tick failed", self._me)
+
+    def _run_schedule(self) -> None:
+        for a in self.scheduler.schedule(self.worker_pool()):
+            self._send_task(a.worker, a.batch)
+
+    def _resend_stale_assignments(self) -> None:
+        """Re-send assignments in flight past the resend deadline: the
+        request or its ACK may have been dropped (SWIM's reliability
+        pattern applied to the task channel)."""
+        now = time.monotonic()
+        for worker, batch in list(self.scheduler.in_progress.items()):
+            key_t = self._assigned_at.get(worker)
+            if key_t is None or key_t[0] != batch.key:
+                self._send_task(worker, batch)
+            elif now - key_t[1] > self.task_resend_after:
+                log.info(
+                    "%s: re-sending un-ACKed batch %s to %s",
+                    self._me, batch.key, worker,
+                )
+                self._send_task(worker, batch)
+
+    def _send_task(self, worker: str, b: Batch) -> None:
+        # replicas are resolved at send time from the live metadata so
+        # re-replication and failover promotions are reflected
+        # (reference resolves at assignment, worker.py:290-297)
+        versions: Dict[str, int] = {}
+        if self.node.is_leader:
+            for f in set(b.files):
+                reps = self.store.metadata.replicas_of(f)
+                if reps:
+                    b.replicas[f] = reps
+                versions[f] = self.store.metadata.latest_version(f)
+        self._assigned_at[worker] = (b.key, time.monotonic())
+        try:
+            self.node.send_unique(
+                worker,
+                MsgType.WORKER_TASK_REQUEST,
+                {
+                    "job": b.job_id,
+                    "batch": b.batch_id,
+                    "model": b.model,
+                    "files": b.files,
+                    "replicas": b.replicas,
+                    "versions": versions,
+                    "seq": next(self._task_seq),
+                    "inc": self._incarnation,
+                },
+            )
+        except Exception:
+            # oversized/failed frame: leave in_progress; the resend
+            # tick will retry and the failure is visible in the log
+            log.exception("%s: sending batch %s to %s failed", self._me, b.key, worker)
+
+    async def _h_submit_job(self, msg: Message, addr) -> None:
+        """Intake (reference SUBMIT_JOB_REQUEST, worker.py:911-920):
+        mint the id, batch the queries, relay to the standby, ACK the
+        client, schedule."""
+        if not self.node.is_leader:
+            return
+        rid = msg.data.get("rid")
+        token = msg.data.get("token")
+        if token and token in self._submit_tokens:
+            # duplicate of a submit whose ACK was lost: re-ACK, same id
+            self.node.send_unique(
+                msg.sender,
+                MsgType.SUBMIT_JOB_REQUEST_ACK,
+                {"rid": rid, "ok": True, "job_id": self._submit_tokens[token]},
+            )
+            return
+        model = msg.data.get("model", "")
+        n = int(msg.data.get("n", 0))
+        files = sorted(self.store.metadata.matching(self.image_pattern))
+        error = None
+        if n <= 0:
+            error = f"n_queries must be positive, got {n}"
+        elif not files:
+            error = f"no {self.image_pattern} files in the store"
+        if error is not None:
+            self.node.send_unique(
+                msg.sender,
+                MsgType.SUBMIT_JOB_REQUEST_ACK,
+                {"rid": rid, "ok": False, "error": error},
+            )
+            return
+        job_id = self.scheduler.next_job_id()
+        if token:
+            self._submit_tokens[token] = job_id
+        bs = self.scheduler.batch_size_of(model)
+        replicas = {f: self.store.metadata.replicas_of(f) for f in files}
+        self.scheduler.submit_job(
+            job_id, model, files, n, msg.sender, replicas, batch_size=bs
+        )
+        # client ACK first: a relay failure must never eat the ACK
+        self.node.send_unique(
+            msg.sender,
+            MsgType.SUBMIT_JOB_REQUEST_ACK,
+            {"rid": rid, "ok": True, "job_id": job_id},
+        )
+        sb = self.store.standby_node()
+        if sb is not None and sb.unique_name != self._me:
+            try:
+                # slim relay: file names + the exact batch_size used for
+                # slicing (so shadow batch ids always match); replicas
+                # are re-resolved from metadata at send/promotion time
+                self.node.send(
+                    sb,
+                    MsgType.SUBMIT_JOB_RELAY,
+                    {"job": job_id, "model": model, "n": n, "files": files,
+                     "batch_size": bs, "requester": msg.sender},
+                )
+            except Exception:
+                log.exception("%s: standby relay of job %d failed", self._me, job_id)
+        self._run_schedule()
+
+    async def _h_task_ack(self, msg: Message, addr) -> None:
+        """A worker finished a batch (reference WORKER_TASK_REQUEST_ACK
+        handler, worker.py:989-1026)."""
+        if not self.node.is_leader:
+            return
+        d = msg.data
+        job_id, batch_id = int(d["job"]), int(d["batch"])
+        cost = d.get("cost")
+        if cost:
+            self._fold_cost(d.get("model", ""), cost)
+        at = self._assigned_at.get(msg.sender)
+        if at is not None and at[0] == (job_id, batch_id):
+            del self._assigned_at[msg.sender]
+        done = self.scheduler.on_batch_done(
+            msg.sender, job_id, batch_id,
+            float(d.get("exec_time", 0.0)), int(d.get("n_images", 0)),
+        )
+        sb = self.store.standby_node()
+        if sb is not None and sb.unique_name != self._me:
+            self.node.send(
+                sb,
+                MsgType.WORKER_TASK_ACK_RELAY,
+                {"job": job_id, "batch": batch_id,
+                 "n_images": int(d.get("n_images", 0))},
+            )
+        if done is not None:
+            self.node.send_unique(
+                done.requester,
+                MsgType.SUBMIT_JOB_REQUEST_SUCCESS,
+                {"job_id": job_id, "model": done.model,
+                 "total_queries": done.total_queries},
+            )
+        self._run_schedule()
+
+    def _fold_cost(self, model: str, cost: Dict[str, Any]) -> None:
+        """Adopt device-measured constants (replaces the reference's
+        hardcoded CPU numbers, worker.py:57-89)."""
+        cur = self.scheduler.costs.get(model)
+        if cur is None:
+            return
+        self.scheduler.costs[model] = cur.with_measurements(
+            load_time=cost.get("load_time"),
+            first_query=cost.get("first_query"),
+            per_query=cost.get("per_query"),
+        )
+
+    async def _h_set_batch_size(self, msg: Message, addr) -> None:
+        """C3: leader updates the scheduler and fans out to every live
+        node so engines recompile at the new shape."""
+        model = msg.data["model"]
+        bs = int(msg.data["batch_size"])
+        if msg.data.get("fanout"):
+            # every node updates its scheduler too, so a standby
+            # promoted later batches new jobs at the current C3 setting
+            self._apply_batch_size(model, bs)
+            return
+        if not self.node.is_leader:
+            return
+        self._apply_batch_size(model, bs)
+        for node in self.node.membership.alive_nodes():
+            if node.unique_name != self._me:
+                self.node.send(
+                    node, MsgType.SET_BATCH_SIZE,
+                    {"model": model, "batch_size": bs, "fanout": True},
+                )
+        # reply type is unregistered, so the client dispatcher's
+        # fallback resolves the awaiting rid future
+        self.node.send_unique(
+            msg.sender, MsgType.SET_BATCH_SIZE_ACK,
+            {"rid": msg.data.get("rid"), "ok": True},
+        )
+
+    def _apply_batch_size(self, model: str, bs: int) -> None:
+        try:
+            self.scheduler.set_batch_size(model, bs)
+        except KeyError:
+            pass
+        if self._engine is not None and model in self._engine.loaded_models:
+            self._engine.set_batch_size(model, bs)
+
+    async def _h_job_status(self, msg: Message, addr) -> None:
+        """Pull-based completion fallback (no reference equivalent —
+        the reference's single completion datagram can strand clients;
+        this closes that gap)."""
+        if not self.node.is_leader:
+            return
+        st = self.scheduler.job_state(int(msg.data.get("job", -1)))
+        self.node.send_unique(
+            msg.sender,
+            MsgType.JOB_STATUS_ACK,
+            {
+                "rid": msg.data.get("rid"),
+                "ok": st is not None,
+                "done": bool(st and st.done),
+                "job_id": st.job_id if st else None,
+                "model": st.model if st else None,
+                "total_queries": st.total_queries if st else 0,
+            },
+        )
+
+    async def _h_get_c2(self, msg: Message, addr) -> None:
+        if not self.node.is_leader:
+            return
+        self.node.send_unique(
+            msg.sender,
+            MsgType.GET_C2_COMMAND_ACK,
+            {"rid": msg.data.get("rid"), "ok": True,
+             "stats": self.scheduler.c2_stats(msg.data.get("model", ""))},
+        )
+
+    async def _h_task_fail(self, msg: Message, addr) -> None:
+        """A live worker could not run its batch (e.g. an input had no
+        reachable replica): requeue it and free the worker — without
+        this the worker would sit 'busy' forever and the job would
+        hang."""
+        if not self.node.is_leader:
+            return
+        self._assigned_at.pop(msg.sender, None)
+        b = self.scheduler.on_batch_failed(
+            msg.sender, int(msg.data["job"]), int(msg.data["batch"])
+        )
+        if b is not None:
+            log.info(
+                "%s: batch %s failed on %s (%s); requeued",
+                self._me, b.key, msg.sender, msg.data.get("error"),
+            )
+        self._run_schedule()
+
+    def _on_node_failed(self, uname: str) -> None:
+        """Requeue the dead worker's batch and reschedule (reference
+        handle_failures_if_pending_status, worker.py:1279-1306)."""
+        if not self.node.is_leader:
+            return
+        self._assigned_at.pop(uname, None)
+        if self.scheduler.on_worker_failed(uname) is not None:
+            log.info("%s: requeued batch from dead worker %s", self._me, uname)
+        self._run_schedule()
+
+    def _on_became_leader(self) -> None:
+        """Failover promotion (reference worker.py:577-588): the shadow
+        queues built from relays become live; resume scheduling. Any
+        batch the dead primary had in flight on a worker will be ACKed
+        to us (workers ACK the *current* leader) or re-sent — shadow
+        queues still hold every un-ACKed batch, so nothing is lost."""
+        if self.scheduler.queue_depths():
+            log.info(
+                "%s: promoted to coordinator with shadow queues %s",
+                self._me, self.scheduler.queue_depths(),
+            )
+        self._run_schedule()
+
+    # ------------------------------------------------------------------
+    # standby side (reference worker.py:887-897, 965-986)
+    # ------------------------------------------------------------------
+
+    async def _h_submit_relay(self, msg: Message, addr) -> None:
+        if msg.sender != self.node.leader_unique:
+            return
+        d = msg.data
+        job_id = int(d["job"])
+        if self.scheduler.job_state(job_id) is not None:
+            return
+        self.scheduler.submit_job(
+            job_id, d["model"], d["files"], int(d["n"]), d["requester"],
+            batch_size=int(d["batch_size"]) if d.get("batch_size") else None,
+        )
+
+    async def _h_ack_relay(self, msg: Message, addr) -> None:
+        if msg.sender != self.node.leader_unique:
+            return
+        self.scheduler.shadow_prune(
+            int(msg.data["job"]), int(msg.data["batch"]),
+            int(msg.data.get("n_images", 0)),
+        )
+
+    # ------------------------------------------------------------------
+    # worker side (reference handle_worker_task_request,
+    # worker.py:518-537, 940-962)
+    # ------------------------------------------------------------------
+
+    async def _h_task_request(self, msg: Message, addr) -> None:
+        d = msg.data
+        key = (int(d["job"]), int(d["batch"]))
+        seq = int(d.get("seq", 0))
+        inc = int(d.get("inc", 0))
+        if seq:
+            prev_inc, prev_seq = self._last_seq.get(msg.sender, (0, 0))
+            if inc < prev_inc or (inc == prev_inc and seq <= prev_seq):
+                return  # reordered stale assignment: must not cancel newer work
+            self._last_seq[msg.sender] = (inc, seq)
+        if self._current is not None:
+            cur_key, cur_task = self._current
+            if cur_key == key and not cur_task.done():
+                return  # duplicate/re-sent delivery of the running batch
+            if not cur_task.done():
+                # preemption (reference worker.py:944-953): cancel the
+                # host-side task; the coordinator already requeued the
+                # displaced batch. Model weights stay resident in HBM.
+                cur_task.cancel()
+        batch = Batch(
+            job_id=key[0], batch_id=key[1], model=d["model"],
+            files=list(d["files"]),
+            replicas={f: list(r) for f, r in d.get("replicas", {}).items()},
+            versions={f: int(v) for f, v in d.get("versions", {}).items()},
+        )
+        task = asyncio.create_task(
+            self._execute(batch, coordinator=msg.sender),
+            name=f"{self.node.me}-task-{key[0]}-{key[1]}",
+        )
+        self._current = (key, task)
+
+    async def _execute(self, batch: Batch, coordinator: str) -> None:
+        t0 = time.monotonic()
+        try:
+            paths = await self._fetch_inputs(batch)
+            results, infer_time, cost = await self._backend(batch.model, paths)
+            out_name = f"output_{batch.job_id}_{batch.batch_id}_{self.node.me.port}.json"
+            tmp = os.path.join(self.store.cfg.download_path(), out_name)
+            os.makedirs(os.path.dirname(tmp), exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(results, f)
+            try:
+                await self.store.put(tmp, out_name)
+            except Exception as e:
+                # store unavailable (e.g. mid-failover): the ACK still
+                # carries the result timing; get-output will miss this
+                # shard, which the reference tolerates identically
+                log.warning("%s: PUT of %s failed: %s", self._me, out_name, e)
+            self.node.send_unique(
+                coordinator if self.node.leader_unique is None else self.node.leader_unique,
+                MsgType.WORKER_TASK_REQUEST_ACK,
+                {
+                    "job": batch.job_id,
+                    "batch": batch.batch_id,
+                    "model": batch.model,
+                    "n_images": len(batch.files),
+                    "exec_time": time.monotonic() - t0,
+                    "infer_time": infer_time,
+                    "cost": cost,
+                },
+            )
+        except asyncio.CancelledError:
+            log.info("%s: batch %s preempted", self._me, batch.key)
+            raise
+        except Exception as e:
+            log.exception("%s: batch %s failed", self._me, batch.key)
+            # tell the coordinator so it requeues the batch and frees
+            # this worker — silence would wedge the job forever
+            self.node.send_unique(
+                coordinator if self.node.leader_unique is None else self.node.leader_unique,
+                MsgType.WORKER_TASK_FAIL,
+                {"job": batch.job_id, "batch": batch.batch_id, "error": str(e)},
+            )
+
+    async def _fetch_inputs(self, batch: Batch) -> List[str]:
+        """Materialize the batch's images locally: local store hit if
+        this node replicates the file, else pull from a live replica
+        over the data plane (reference scp-per-image,
+        run_inference_cli worker.py:1361-1386)."""
+        dl = self.store.cfg.download_path()
+        os.makedirs(dl, exist_ok=True)
+        paths: List[str] = []
+        for f in batch.files:
+            want = batch.versions.get(f, 0) or None
+            if self.store.store.has(f, want):
+                paths.append(self.store.store.get_path(f, want))
+                continue
+            # version-qualified cache name: a re-PUT of the same sdfs
+            # name must never be served from a stale cached download
+            dest = os.path.join(dl, f"{f.replace('/', '_')}.v{want or 'latest'}")
+            if want is not None and os.path.exists(dest):
+                paths.append(dest)
+                continue
+            fetched = False
+            for uname in batch.replicas.get(f, []):
+                node = self.node.spec.node_by_unique_name(uname)
+                if node is None:
+                    continue
+                try:
+                    data, _ = await self.store.data_plane.fetch_from_store(
+                        data_addr(node), f, want
+                    )
+                    with open(dest, "wb") as fh:
+                        fh.write(data)
+                    paths.append(dest)
+                    fetched = True
+                    break
+                except Exception:
+                    continue
+            if not fetched:
+                raise RuntimeError(f"no live replica served {f}")
+        return paths
+
+    # ------------------------------------------------------------------
+    # client-side completion handler
+    # ------------------------------------------------------------------
+
+    async def _h_job_success(self, msg: Message, addr) -> None:
+        job_id = int(msg.data.get("job_id", -1))
+        fut = self._job_done.setdefault(
+            job_id, asyncio.get_running_loop().create_future()
+        )
+        if not fut.done():
+            fut.set_result(dict(msg.data))
+
+    # ------------------------------------------------------------------
+    # default inference backend: the TPU engine
+    # ------------------------------------------------------------------
+
+    async def _engine_backend(
+        self, model: str, paths: List[str]
+    ) -> Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]:
+        if self._engine is None:
+            from ..inference.engine import InferenceEngine
+
+            self._engine = InferenceEngine()
+        eng = self._engine
+        if model not in eng.loaded_models:
+            await asyncio.to_thread(eng.load_model, model)
+        res = await eng.infer_files_async(model, paths)
+        return res.to_json_dict(), res.infer_time, eng.cost_constants(model)

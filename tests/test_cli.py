@@ -1,0 +1,104 @@
+"""CLI tests: the command table drives the same verbs as the reference
+menu (worker.py:1629-2034) against a live localhost cluster."""
+
+import asyncio
+import io
+import json
+import sys
+
+from dml_tpu.cli import NodeApp, main
+from dml_tpu.config import ClusterSpec, StoreConfig, Timing
+
+FAST = Timing(ping_interval=0.05, ack_timeout=0.15, cleanup_time=0.3,
+              missed_acks_to_suspect=2, leader_rpc_timeout=5.0)
+
+
+def test_localspec_roundtrip(capsys):
+    main(["localspec", "-n", "3", "--base-port", "23001"])
+    out = capsys.readouterr().out
+    spec = ClusterSpec.from_json(out)
+    assert len(spec.nodes) == 3
+    assert spec.nodes[0].port == 23001
+    assert spec.introducer is not None
+
+
+async def test_nodeapp_commands(tmp_path, capsys):
+    from dml_tpu.cluster.introducer import IntroducerService
+
+    spec = ClusterSpec.localhost(
+        2, base_port=23101, introducer_port=23100, timing=FAST,
+        store=StoreConfig(root=str(tmp_path / "roots"),
+                          download_dir=str(tmp_path / "dl")),
+    )
+    dns = IntroducerService(spec)
+    await dns.start()
+    apps = []
+    try:
+        for n in spec.nodes:
+            app = NodeApp.__new__(NodeApp)
+            app.spec = spec
+            from dml_tpu.cluster.node import Node
+            from dml_tpu.cluster.store_service import StoreService
+            from dml_tpu.jobs.service import JobService
+            app.node = Node(spec, n)
+            app.store = StoreService(app.node, root=str(tmp_path / f"st_{n.port}"))
+
+            async def fake_backend(model, paths):
+                return (
+                    {p.split("/")[-1]: [{"label": model, "score": 1.0}] for p in paths},
+                    0.001,
+                    None,
+                )
+
+            app.jobs = JobService(app.node, app.store, infer_backend=fake_backend)
+            await app.start()
+            apps.append(app)
+
+        # convergence
+        for _ in range(100):
+            if all(a.node.joined and a.node.leader_unique for a in apps):
+                break
+            await asyncio.sleep(0.05)
+
+        app = apps[-1]
+        # membership + identity verbs
+        assert await app.handle("list_mem")
+        assert await app.handle("self_id")
+        out = capsys.readouterr().out
+        assert app.node.me.unique_name in out
+
+        # file verbs
+        src = tmp_path / "a.jpeg"
+        src.write_bytes(b"\xff\xd8data")
+        assert await app.handle(f"put {src} a.jpeg")
+        assert await app.handle("ls-all")
+        assert await app.handle("ls a.jpeg")
+        assert await app.handle("store")
+        dst = tmp_path / "back.jpeg"
+        assert await app.handle(f"get a.jpeg {dst}")
+        assert dst.read_bytes() == b"\xff\xd8data"
+        out = capsys.readouterr().out
+        assert "a.jpeg" in out and "ok version=1" in out
+
+        # job verbs (fake backend)
+        assert await app.handle("submit-job ResNet50 4")
+        out = capsys.readouterr().out
+        assert "DONE: 4 queries" in out
+        assert await app.handle("C1")
+        assert await app.handle("C5")
+
+        # stats + errors
+        assert await app.handle("bps")
+        assert await app.handle("fp-rate")
+        assert await app.handle("bogus-command")
+        out = capsys.readouterr().out
+        assert "unknown command" in out
+        assert await app.handle("get missing.file /tmp/x")
+        assert "!!" in capsys.readouterr().out
+
+        # quit returns False
+        assert not await app.handle("quit")
+    finally:
+        for a in apps:
+            await a.stop()
+        await dns.stop()

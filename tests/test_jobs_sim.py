@@ -1,0 +1,335 @@
+"""End-to-end job-pipeline simulation (reference call stack §3.4:
+submit-job -> schedule -> worker execute -> collect; §3.5 failover).
+
+Same in-process localhost-cluster pattern as test_cluster_sim, with a
+controllable fake inference backend so the pipeline is exercised
+deterministically and without JAX compiles. The real engine path is
+covered by test_engine/test_models; the seam between them
+(JobService._engine_backend) is a thin adapter.
+"""
+
+import asyncio
+import contextlib
+import json
+import os
+
+from dml_tpu.config import ClusterSpec, StoreConfig, Timing
+from dml_tpu.cluster.introducer import IntroducerService
+from dml_tpu.cluster.node import Node
+from dml_tpu.cluster.store_service import StoreService
+from dml_tpu.jobs.service import JobService
+
+FAST = Timing(
+    ping_interval=0.05,
+    ack_timeout=0.15,
+    cleanup_time=0.3,
+    missed_acks_to_suspect=2,
+    leader_rpc_timeout=5.0,
+)
+
+
+class FakeBackend:
+    """Deterministic stand-in for the TPU engine: records calls, can
+    be paused to hold a batch in flight (for preemption/failure tests)."""
+
+    def __init__(self):
+        self.calls = []
+        self.gate = None  # asyncio.Event to block on, if set
+        self.per_model_delay = {}
+        self.fail_times = 0  # raise on the first N calls
+
+    async def __call__(self, model, paths):
+        self.calls.append((model, list(paths)))
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("injected backend failure")
+        if self.gate is not None:
+            await self.gate.wait()
+        delay = self.per_model_delay.get(model, 0.0)
+        if delay:
+            await asyncio.sleep(delay)
+        results = {
+            os.path.basename(p): [{"wnid": "n000", "label": model, "score": 1.0}]
+            for p in paths
+        }
+        cost = {"load_time": 0.0, "first_query": 0.0, "per_query": 0.001}
+        return results, 0.001 * len(paths), cost
+
+
+class JobSim:
+    def __init__(self, spec: ClusterSpec, tmp_path):
+        self.spec = spec
+        self.tmp_path = tmp_path
+        self.dns = IntroducerService(spec)
+        self.nodes = {}
+        self.stores = {}
+        self.jobs = {}
+        self.backends = {}
+
+    async def start_node(self, node_id):
+        node = Node(self.spec, node_id)
+        store = StoreService(node, root=str(self.tmp_path / f"store_{node_id.port}"))
+        backend = FakeBackend()
+        jobs = JobService(node, store, infer_backend=backend)
+        await node.start()
+        await store.start()
+        await jobs.start()
+        u = node_id.unique_name
+        self.nodes[u], self.stores[u], self.jobs[u], self.backends[u] = (
+            node, store, jobs, backend,
+        )
+        return node
+
+    async def start_all(self):
+        await self.dns.start()
+        for n in self.spec.nodes:
+            await self.start_node(n)
+
+    async def stop_node(self, unique_name):
+        await self.jobs.pop(unique_name).stop()
+        await self.stores.pop(unique_name).stop()
+        await self.nodes.pop(unique_name).stop()
+        self.backends.pop(unique_name)
+
+    async def stop_all(self):
+        for u in list(self.nodes):
+            await self.stop_node(u)
+        await self.dns.stop()
+
+    async def wait_for(self, cond, timeout=10.0, what="condition"):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
+            if cond():
+                return
+            await asyncio.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    async def wait_converged(self, timeout=10.0):
+        n = len(self.nodes)
+
+        def ok():
+            return all(
+                node.joined
+                and node.leader_unique is not None
+                and len(node.membership.alive_nodes()) == n
+                for node in self.nodes.values()
+            )
+
+        await self.wait_for(ok, timeout, f"convergence of {n} nodes")
+
+    def by_name(self, name):
+        return self.spec.node_by_name(name).unique_name
+
+    async def seed_images(self, client_uname, count=4):
+        """PUT `count` tiny fake .jpeg files into the store."""
+        names = []
+        for i in range(count):
+            p = self.tmp_path / f"img_{i}.jpeg"
+            p.write_bytes(b"\xff\xd8fakejpeg" + bytes([i]))
+            await self.stores[client_uname].put(str(p), f"img_{i}.jpeg")
+            names.append(f"img_{i}.jpeg")
+        return names
+
+    def coordinator_jobs(self) -> JobService:
+        any_node = next(iter(self.nodes.values()))
+        return self.jobs[any_node.leader_unique]
+
+
+@contextlib.asynccontextmanager
+async def cluster(n, tmp_path, base_port, **spec_kw):
+    spec_kw.setdefault("timing", FAST)
+    spec = ClusterSpec.localhost(
+        n,
+        base_port=base_port,
+        introducer_port=base_port - 1,
+        store=StoreConfig(root=str(tmp_path / "roots"),
+                          download_dir=str(tmp_path / "dl")),
+        **spec_kw,
+    )
+    sim = JobSim(spec, tmp_path)
+    try:
+        await sim.start_all()
+        yield sim
+    finally:
+        await sim.stop_all()
+
+
+async def test_submit_job_end_to_end(tmp_path):
+    async with cluster(4, tmp_path, 22100) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 3)
+        client = sim.jobs[client_u]
+
+        job_id = await client.submit_job("ResNet50", 10)
+        done = await client.wait_job(job_id, timeout=15.0)
+        assert done["total_queries"] == 10
+
+        # outputs merged from the store (reference get-output)
+        out = tmp_path / "final.json"
+        merged = await client.get_output(job_id, str(out))
+        assert merged, "merged output must not be empty"
+        assert json.loads(out.read_text()) == merged
+        # every result row is a top-k list from the fake backend
+        for rows in merged.values():
+            assert rows[0]["label"] == "ResNet50"
+
+        # C1 on the coordinator counted all 10 queries
+        coord = sim.coordinator_jobs()
+        assert coord.c1_stats()["ResNet50"]["total_queries"] == 10.0
+
+
+async def test_dual_model_jobs_complete(tmp_path):
+    async with cluster(5, tmp_path, 22200) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H5")
+        await sim.seed_images(client_u, 2)
+        client = sim.jobs[client_u]
+
+        j1 = await client.submit_job("ResNet50", 12)
+        j2 = await client.submit_job("InceptionV3", 12)
+        r1 = await client.wait_job(j1, timeout=20.0)
+        r2 = await client.wait_job(j2, timeout=20.0)
+        assert r1["total_queries"] == 12 and r2["total_queries"] == 12
+        c1 = sim.coordinator_jobs().c1_stats()
+        assert c1["ResNet50"]["total_queries"] == 12.0
+        assert c1["InceptionV3"]["total_queries"] == 12.0
+
+
+async def test_c2_and_c3_verbs(tmp_path):
+    async with cluster(3, tmp_path, 22300) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        await sim.seed_images(client_u, 2)
+        client = sim.jobs[client_u]
+
+        # C3: shrink the batch size cluster-wide before submitting
+        await client.set_batch_size("ResNet50", 4)
+        job = await client.submit_job("ResNet50", 8)
+        await client.wait_job(job, timeout=15.0)
+
+        coord = sim.coordinator_jobs()
+        # 8 queries at batch 4 -> 2 batches
+        assert coord.scheduler.job_state(job).total_queries == 8
+        samples = coord.scheduler.latency_samples["ResNet50"]
+        assert sum(n for (_, _, n) in samples) == 8
+        assert {n for (_, _, n) in samples} == {4}
+
+        # C2 fetched remotely from a non-coordinator
+        stats = await client.c2_stats("ResNet50")
+        assert stats["count"] == 2.0
+        assert stats["mean"] > 0
+
+
+async def test_worker_failure_requeues_and_completes(tmp_path):
+    async with cluster(4, tmp_path, 22400) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+        coord_u = coord.node.me.unique_name
+
+        # block every worker's backend so batches stay in flight
+        gates = {}
+        for u, be in sim.backends.items():
+            gates[u] = be.gate = asyncio.Event()
+
+        client = sim.jobs[client_u]
+        job_id = await client.submit_job("ResNet50", 32)  # 1 batch of 32
+
+        # wait until some worker holds the batch
+        await sim.wait_for(
+            lambda: len(coord.scheduler.in_progress) == 1,
+            what="batch assigned",
+        )
+        victim = next(iter(coord.scheduler.in_progress))
+        assert victim != coord_u
+
+        await sim.stop_node(victim)
+        # release the remaining gates so the requeued batch can run
+        for u, ev in gates.items():
+            if u != victim:
+                ev.set()
+
+        done = await client.wait_job(job_id, timeout=20.0)
+        assert done["total_queries"] == 32
+
+
+async def test_backend_failure_sends_fail_ack_and_requeues(tmp_path):
+    async with cluster(3, tmp_path, 22600) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        await sim.seed_images(client_u, 2)
+        # every backend fails its first call; the WORKER_TASK_FAIL path
+        # must requeue and the retry completes the job
+        for be in sim.backends.values():
+            be.fail_times = 1
+        client = sim.jobs[client_u]
+        job_id = await client.submit_job("ResNet50", 32)
+        done = await client.wait_job(job_id, timeout=20.0)
+        assert done["total_queries"] == 32
+        assert sum(len(be.calls) for be in sim.backends.values()) >= 2
+
+
+LOSSY = Timing(
+    # 3% drop with suspicion after >5 consecutive misses: per-round
+    # miss ~6% (ping AND ack must survive), 5-in-a-row ~1e-7 — the
+    # detector stays quiet, matching the reference's deployed regime
+    # (3% drop, >3 misses at 12s ticks). Tighter settings make false
+    # suspicion a statistical certainty at test ping rates.
+    ping_interval=0.05,
+    ack_timeout=0.25,
+    cleanup_time=1.0,
+    missed_acks_to_suspect=5,
+    leader_rpc_timeout=3.0,
+)
+
+
+async def test_job_completes_under_packet_loss(tmp_path):
+    # the reference's test-mode drops 3% of datagrams (protocol.py:10):
+    # exercise task resend, ACK-loss recovery, and submit retry
+    async with cluster(4, tmp_path, 22700, testing=True,
+                       packet_drop_pct=3.0, timing=LOSSY) as sim:
+        # everything runs lossy, including store seeding: PUT carries
+        # an idempotency token and the leader re-sends un-ACKed
+        # fan-outs, so the whole stack must converge under drops
+        await sim.wait_converged(timeout=20.0)
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 2)
+        client = sim.jobs[client_u]
+        job_id = await client.submit_job("ResNet50", 64)  # 2 batches
+        done = await client.wait_job(job_id, timeout=40.0)
+        assert done["total_queries"] == 64
+        dropped = sum(n.transport.packets_dropped for n in sim.nodes.values())
+        assert dropped > 0, "loss injection must actually have dropped packets"
+
+
+async def test_coordinator_failover_resumes_from_shadow(tmp_path):
+    async with cluster(5, tmp_path, 22500) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H5")
+        await sim.seed_images(client_u, 2)
+        client = sim.jobs[client_u]
+        coord = sim.coordinator_jobs()
+        coord_u = coord.node.me.unique_name
+        standby = coord.store.standby_node().unique_name
+
+        # slow the backends so the job outlives the coordinator kill
+        for be in sim.backends.values():
+            be.per_model_delay["ResNet50"] = 0.3
+
+        job_id = await client.submit_job("ResNet50", 96)  # 3 batches
+
+        # the standby must have mirrored the job before we kill
+        await sim.wait_for(
+            lambda: job_id in sim.jobs[standby].scheduler.jobs,
+            what="standby shadow of the job",
+        )
+        await sim.stop_node(coord_u)
+
+        # standby wins the election and finishes the job
+        done = await client.wait_job(job_id, timeout=30.0)
+        assert done["total_queries"] == 96
+        new_coord = sim.jobs[standby]
+        assert new_coord.node.is_leader
+        assert new_coord.scheduler.job_state(job_id).done
