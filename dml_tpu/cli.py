@@ -97,8 +97,12 @@ class NodeApp:
             return await self._dispatch(cmd, args)
         except (TimeoutError, asyncio.TimeoutError):
             print("!! timed out (no leader reachable?)")
-        except (FileNotFoundError, RuntimeError, KeyError, ValueError) as e:
-            print(f"!! {e}")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # a typo'd path or bad argument must never take the node
+            # out of the ring — report and keep the REPL alive
+            print(f"!! {type(e).__name__}: {e}")
         return True
 
     async def _dispatch(self, cmd: str, a: List[str]) -> bool:

@@ -381,8 +381,11 @@ class Scheduler:
             return None
         del self.in_progress[worker]
         st = self.jobs.get(job_id)
-        if st is not None and batch_id in st.completed_batches:
-            return None  # already done elsewhere; don't re-run
+        if st is None or batch_id in st.completed_batches:
+            # unknown/retired job or already done elsewhere: free the
+            # worker but never requeue (a deterministically-failing
+            # orphan batch would loop forever)
+            return None
         self._queue(cur.model).appendleft(cur)
         return cur
 

@@ -59,11 +59,11 @@ class JobService:
         node: Node,
         store: StoreService,
         infer_backend: Optional[InferBackend] = None,
-        image_pattern: str = "*.jpeg",
+        image_patterns: Tuple[str, ...] = ("*.jpeg", "*.jpg"),
     ):
         self.node = node
         self.store = store
-        self.image_pattern = image_pattern
+        self.image_patterns = image_patterns
         self._backend = infer_backend or self._engine_backend
         self._engine = None  # lazy InferenceEngine (imports jax on first use)
         self.scheduler = Scheduler(costs=self._seed_costs())
@@ -380,12 +380,14 @@ class JobService:
             return
         model = msg.data.get("model", "")
         n = int(msg.data.get("n", 0))
-        files = sorted(self.store.metadata.matching(self.image_pattern))
+        files = sorted({
+            f for p in self.image_patterns for f in self.store.metadata.matching(p)
+        })
         error = None
         if n <= 0:
             error = f"n_queries must be positive, got {n}"
         elif not files:
-            error = f"no {self.image_pattern} files in the store"
+            error = f"no {'/'.join(self.image_patterns)} files in the store"
         if error is not None:
             self.node.send_unique(
                 msg.sender,

@@ -1,0 +1,26 @@
+"""Multi-chip execution: device meshes, sharded inference, and the
+distributed training step.
+
+This is the TPU-native replacement for the reference's only
+parallelism — embarrassingly-parallel batches over worker VMs
+(worker.py:255-495) — extended with the parallelism the reference
+lacks but a TPU framework needs (SURVEY §2 "parallelism strategies"):
+batch data-parallelism over a chip mesh for inference, and dp×tp
+sharded training. All sharding is `jax.sharding` + `jit` (GSPMD):
+annotate in/out shardings, let XLA place the collectives on ICI.
+"""
+
+from .mesh import make_mesh, local_mesh
+from .sharding import partition_params, replicated
+from .inference import ShardedInference
+from .train import Trainer, make_train_step
+
+__all__ = [
+    "make_mesh",
+    "local_mesh",
+    "partition_params",
+    "replicated",
+    "ShardedInference",
+    "Trainer",
+    "make_train_step",
+]

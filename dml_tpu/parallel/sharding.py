@@ -1,0 +1,48 @@
+"""Parameter partitioning rules for tensor parallelism.
+
+Megatron-style channel partitioning expressed as GSPMD sharding
+annotations instead of hand-written collectives: shard every parameter
+tensor's output-channel axis (the last axis for both conv HWIO kernels
+and dense kernels) across `tp` when it divides evenly, replicate
+otherwise. Under `jit`, XLA propagates these shardings through the
+graph and inserts the all-gathers/reduce-scatters on ICI itself —
+the "How to Scale Your Model" recipe rather than a port of NCCL calls.
+
+1-D channel vectors (BN scale/bias, dense bias) follow the same rule,
+so they stay aligned with the kernels that produce their axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _spec_for(path: tuple, leaf: Any, tp: int) -> P:
+    if tp <= 1:
+        return P()
+    shape = getattr(leaf, "shape", ())
+    if not shape:
+        return P()
+    last = shape[-1]
+    if last % tp != 0 or last < 2 * tp:
+        return P()
+    # shard the output-channel (last) axis over tp; all other axes
+    # replicated: conv HWIO -> (None, None, None, 'tp'),
+    # dense (in, out) -> (None, 'tp'), channel vectors -> ('tp',)
+    return P(*([None] * (len(shape) - 1) + ["tp"]))
+
+
+def partition_params(tree: Any, mesh: Mesh) -> Any:
+    """PyTree of NamedShardings matching `tree` (params, batch_stats,
+    or optimizer state — anything whose leaves mirror param shapes)."""
+    tp = mesh.shape.get("tp", 1)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _spec_for(path, leaf, tp)), tree
+    )

@@ -1,0 +1,159 @@
+"""Distributed training step over a dp×tp device mesh.
+
+The reference is inference-only (SURVEY §0: "no training, no
+gradients, no optimizer"), so this is net-new TPU scope: the same model
+zoo becomes trainable — e.g. fine-tuning a classifier on new labels
+before serving it through the job pipeline — with the canonical
+sharded-training recipe:
+
+- batch sharded over `dp` (each chip computes grads on batch/dp
+  examples); gradients come out replicated because XLA inserts the
+  cross-`dp` psum the moment replicated params meet dp-sharded data
+- params/optimizer state channel-sharded over `tp` (sharding.py), so
+  weight-update math runs where the weights live
+- BatchNorm statistics are global automatically: the batch mean under
+  `jit` is a mean over a dp-sharded axis, which GSPMD lowers to a
+  cross-chip reduction (sync-BN for free)
+- loss is NLL on the models' softmax output; compute in the model
+  dtype (bfloat16 MXU), reduce in float32
+
+Everything is one jitted function with explicit in/out shardings —
+no hand-written collectives, per the scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.params_io import init_variables
+from ..models.preprocess import normalize_on_device
+from ..models.registry import get_model
+from .sharding import partition_params
+
+
+def make_train_step(
+    model,
+    preprocess_mode: str,
+    optimizer,
+    dtype=jnp.bfloat16,
+) -> Callable:
+    """The un-jitted step: (state, images_u8, labels) -> (state, metrics).
+
+    `state` is a dict {params, batch_stats, opt_state, step} — a plain
+    pytree so sharding annotations apply leaf-wise.
+    """
+
+    def train_step(state, images_u8, labels):
+        x = normalize_on_device(images_u8, preprocess_mode, dtype)
+
+        def loss_fn(params):
+            probs, updated = model.apply(
+                {"params": params, "batch_stats": state["batch_stats"]},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            logp = jnp.log(probs.astype(jnp.float32) + 1e-9)
+            nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+            acc = (jnp.argmax(probs, axis=-1) == labels).mean()
+            return nll, (updated["batch_stats"], acc)
+
+        (loss, (batch_stats, acc)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state["params"])
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {
+            "params": params,
+            "batch_stats": batch_stats,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    return train_step
+
+
+class Trainer:
+    """A model + optimizer compiled for a mesh.
+
+    >>> mesh = local_mesh(dp=4, tp=2)
+    >>> tr = Trainer("ResNet50", mesh, batch_size=32)
+    >>> metrics = tr.step(images_u8, labels)          # one sharded step
+    """
+
+    def __init__(
+        self,
+        model_name: str,
+        mesh: Mesh,
+        batch_size: int,
+        learning_rate: float = 1e-3,
+        optimizer=None,
+        dtype=jnp.bfloat16,
+        seed: int = 0,
+        num_classes: int = 1000,
+        variables: Any = None,
+    ):
+        self.spec = get_model(model_name)
+        self.mesh = mesh
+        dp = mesh.shape.get("dp", 1)
+        if batch_size % dp != 0:
+            raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
+        self.batch_size = batch_size
+        self.model = self.spec.build(dtype=dtype, num_classes=num_classes)
+        self.optimizer = optimizer or optax.adamw(learning_rate)
+        if variables is None:
+            variables = init_variables(
+                self.spec, seed=seed, dtype=dtype, num_classes=num_classes
+            )
+        opt_state = self.optimizer.init(variables["params"])
+        state = {
+            "params": variables["params"],
+            "batch_stats": variables.get("batch_stats", {}),
+            "opt_state": opt_state,
+            "step": jnp.zeros((), jnp.int32),
+        }
+        self._state_shardings = partition_params(state, mesh)
+        self.state = jax.device_put(state, self._state_shardings)
+        data_sh = NamedSharding(mesh, P("dp"))
+        repl = NamedSharding(mesh, P())
+        step = make_train_step(self.model, self.spec.preprocess, self.optimizer, dtype)
+        self._step = jax.jit(
+            step,
+            in_shardings=(self._state_shardings, data_sh, data_sh),
+            out_shardings=(self._state_shardings, repl),
+            donate_argnums=(0,),
+        )
+        self.last_step_time: Optional[float] = None
+
+    def step(self, images_u8: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+        """Run one training step; returns host-side metrics."""
+        t0 = time.monotonic()
+        self.state, metrics = self._step(
+            self.state, jnp.asarray(images_u8), jnp.asarray(labels.astype(np.int32))
+        )
+        metrics = jax.device_get(metrics)
+        self.last_step_time = time.monotonic() - t0
+        return {k: float(v) for k, v in metrics.items()}
+
+    @property
+    def params(self):
+        return self.state["params"]
+
+    def export_variables(self) -> Dict[str, Any]:
+        """Gather a replicated copy, e.g. to hand to the inference
+        engine or checkpoint through the replicated store."""
+        repl = NamedSharding(self.mesh, P())
+        return jax.device_get({
+            "params": jax.device_put(self.state["params"], repl),
+            "batch_stats": jax.device_put(self.state["batch_stats"], repl),
+        })

@@ -14,7 +14,12 @@ class TinyNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.relu(nn.Conv(8, (3, 3), strides=2, name="c1", dtype=self.dtype)(x))
+        x = nn.Conv(8, (3, 3), strides=2, name="c1", dtype=self.dtype)(x)
+        # BN so tests cover the mutable batch_stats path the real
+        # models (ResNet/Inception) rely on
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         name="bn1", dtype=self.dtype)(x)
+        x = nn.relu(x)
         x = nn.relu(nn.Conv(16, (3, 3), strides=2, name="c2", dtype=self.dtype)(x))
         x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
         x = nn.Dense(self.num_classes, name="predictions")(x)

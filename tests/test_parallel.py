@@ -1,0 +1,116 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh
+(conftest sets xla_force_host_platform_device_count=8).
+
+Validates the dp/tp sharded inference + training paths that the driver
+dry-runs (`__graft_entry__.dryrun_multichip`): shardings actually
+applied, cross-device numerics matching single-device, training loss
+decreasing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _tinynet import ensure_tinynet
+from dml_tpu.config import MeshSpec
+from dml_tpu.parallel.mesh import make_mesh, local_mesh
+from dml_tpu.parallel.inference import ShardedInference
+from dml_tpu.parallel.sharding import partition_params
+from dml_tpu.parallel.train import Trainer
+
+ensure_tinynet()
+
+
+def test_make_mesh_resolves_axes():
+    mesh = make_mesh(MeshSpec(dp=-1, tp=2))
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2 and mesh.shape["sp"] == 1
+    assert mesh.devices.size == 8
+
+
+def test_make_mesh_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        make_mesh(MeshSpec(dp=3, tp=3))  # 9 != 8
+
+
+def test_partition_params_shards_output_channels():
+    mesh = local_mesh(dp=4, tp=2)
+    params = {
+        "dense": {"kernel": jnp.zeros((16, 64)), "bias": jnp.zeros((64,))},
+        "odd": {"kernel": jnp.zeros((16, 7))},  # 7 % 2 != 0 -> replicated
+    }
+    sh = partition_params(params, mesh)
+    assert sh["dense"]["kernel"].spec == jax.sharding.PartitionSpec(None, "tp")
+    assert sh["dense"]["bias"].spec == jax.sharding.PartitionSpec("tp")
+    assert sh["odd"]["kernel"].spec == jax.sharding.PartitionSpec()
+
+
+def test_sharded_inference_matches_single_device():
+    from dml_tpu.inference.engine import InferenceEngine
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, size=(8, 32, 32, 3), dtype=np.uint8)
+
+    eng = InferenceEngine(dtype=jnp.float32)
+    eng.load_model("TinyNet", batch_size=8, warmup=False)
+    single = eng.infer_arrays("TinyNet", imgs)
+
+    mesh = local_mesh(dp=4, tp=2)
+    sh = ShardedInference("TinyNet", mesh, batch_size=8, dtype=jnp.float32)
+    multi = sh(imgs)
+
+    assert multi.shape == single.shape
+    np.testing.assert_allclose(multi, single, rtol=2e-4, atol=2e-5)
+    # probs rows sum to 1
+    np.testing.assert_allclose(multi.sum(axis=1), 1.0, rtol=1e-3)
+
+
+def test_sharded_inference_pads_ragged_batches():
+    mesh = local_mesh(dp=8, tp=1)
+    sh = ShardedInference("TinyNet", mesh, batch_size=8, dtype=jnp.float32)
+    imgs = np.random.RandomState(1).randint(0, 255, (13, 32, 32, 3), dtype=np.uint8)
+    out = sh(imgs)
+    assert out.shape[0] == 13
+
+
+def test_trainer_sharded_step_learns(tmp_path):
+    mesh = local_mesh(dp=4, tp=2)
+    tr = Trainer("TinyNet", mesh, batch_size=16, learning_rate=5e-3,
+                 dtype=jnp.float32, num_classes=10)
+    rng = np.random.RandomState(0)
+    # tiny synthetic task: label = brightness bucket (learnable signal)
+    imgs = rng.randint(0, 255, size=(16, 32, 32, 3), dtype=np.uint8)
+    labels = (imgs.mean(axis=(1, 2, 3)) // 26).astype(np.int32).clip(0, 9)
+
+    first = tr.step(imgs, labels)
+    assert np.isfinite(first["loss"])
+    losses = [first["loss"]]
+    for _ in range(10):
+        losses.append(tr.step(imgs, labels)["loss"])
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+    # step counter advanced on device
+    assert int(jax.device_get(tr.state["step"])) == 11
+
+    # params are actually tp-sharded on the mesh
+    pred_kernel = tr.state["params"]["predictions"]["kernel"]
+    assert pred_kernel.sharding.spec == jax.sharding.PartitionSpec(None, "tp")
+    # batch_stats were updated by the mutable BN collection
+    bs = jax.device_get(tr.state["batch_stats"])
+    leaves = jax.tree_util.tree_leaves(bs)
+    assert leaves and any(np.abs(l).sum() > 0 for l in leaves)
+
+
+def test_trainer_export_roundtrips_to_engine():
+    from dml_tpu.inference.engine import InferenceEngine
+
+    mesh = local_mesh(dp=8, tp=1)
+    tr = Trainer("TinyNet", mesh, batch_size=8, dtype=jnp.float32, num_classes=1000)
+    imgs = np.random.RandomState(2).randint(0, 255, (8, 32, 32, 3), dtype=np.uint8)
+    tr.step(imgs, np.zeros(8, np.int32))
+    exported = tr.export_variables()
+
+    eng = InferenceEngine(dtype=jnp.float32)
+    eng.load_model("TinyNet", variables=exported, batch_size=8, warmup=False)
+    probs = eng.infer_arrays("TinyNet", imgs)
+    assert probs.shape == (8, 1000)
+    assert np.all(np.isfinite(probs))
