@@ -1,0 +1,191 @@
+"""EfficientNet family in Flax (Keras-graph-compatible B0..B7).
+
+Net-new model family beyond the reference's two executors
+(models.py:23-71) — the registry makes adding it one `register()` call
+(BASELINE.json config 5 names EfficientNet-B4 as the plug-in case).
+Architecture and flat layer naming follow
+`keras.applications.efficientnet.EfficientNetB*` exactly
+(`stem_conv`, `block2a_expand_conv`, `block2a_dwconv`, `block2a_se_reduce`,
+`top_conv`, `predictions`, ...) so `params_io.from_keras_model` maps
+pretrained weights name-for-name, like ResNet50.
+
+Keras bakes preprocessing into the graph (Rescaling(1/255) +
+Normalization with torch-style mean/std); this module does the same,
+so the registry preprocess mode is "raw" (uint8 in, no host-side
+normalization).
+
+TPU notes: NHWC, bfloat16 compute via `dtype`, depthwise convs as
+`feature_group_count=C` (XLA lowers them natively), squeeze-excite as
+1x1 convs on the pooled map. Inference path only applies dropout off.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+BN_EPS = 1e-3
+# torch-style normalization baked into the keras graph
+_MEAN = (0.485, 0.456, 0.406)
+_STD = (0.229, 0.224, 0.225)
+
+# B0 base config: (kernel, repeats, filters_in, filters_out, expand, stride, se)
+_BASE_BLOCKS = (
+    (3, 1, 32, 16, 1, 1, 0.25),
+    (3, 2, 16, 24, 6, 2, 0.25),
+    (5, 2, 24, 40, 6, 2, 0.25),
+    (3, 3, 40, 80, 6, 2, 0.25),
+    (5, 3, 80, 112, 6, 1, 0.25),
+    (5, 4, 112, 192, 6, 2, 0.25),
+    (3, 1, 192, 320, 6, 1, 0.25),
+)
+# name -> (width_mult, depth_mult, input_size)
+VARIANTS = {
+    "b0": (1.0, 1.0, 224),
+    "b1": (1.0, 1.1, 240),
+    "b2": (1.1, 1.2, 260),
+    "b3": (1.2, 1.4, 300),
+    "b4": (1.4, 1.8, 380),
+    "b5": (1.6, 2.2, 456),
+    "b6": (1.8, 2.6, 528),
+    "b7": (2.0, 3.1, 600),
+}
+
+
+def _round_filters(filters: float, width: float, divisor: int = 8) -> int:
+    """Keras round_filters: scale then round to the divisor."""
+    filters *= width
+    new = max(divisor, int(filters + divisor / 2) // divisor * divisor)
+    if new < 0.9 * filters:
+        new += divisor
+    return int(new)
+
+
+def _round_repeats(repeats: int, depth: float) -> int:
+    return int(math.ceil(depth * repeats))
+
+
+def _correct_pad(
+    kernel: int, size_hw: Tuple[int, int]
+) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """Keras imagenet_utils.correct_pad for stride-2 VALID convs:
+    per-dimension `adjust = 1 - size % 2` (even inputs drop one pixel
+    of leading pad; odd inputs keep the symmetric pad). Getting this
+    wrong on odd feature maps (e.g. B4's 95px block3 input) silently
+    shifts every downstream activation off the Keras graph."""
+    correct = kernel // 2
+    adj_h = 1 - size_hw[0] % 2
+    adj_w = 1 - size_hw[1] % 2
+    return ((correct - adj_h, correct), (correct - adj_w, correct))
+
+
+class EfficientNet(nn.Module):
+    """EfficientNet-B{n}; flat Keras-named layers for weight import."""
+
+    width: float = 1.0
+    depth: float = 1.0
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            epsilon=BN_EPS,
+            momentum=0.99,
+            dtype=self.dtype,
+        )
+        swish = nn.swish
+
+        # keras rescaling + normalization layers (baked-in preprocessing)
+        x = x.astype(self.dtype) / 255.0
+        mean = jnp.asarray(_MEAN, self.dtype)
+        std = jnp.asarray(_STD, self.dtype)
+        x = (x - mean) / std
+
+        # stem: ZeroPadding(correct_pad(3)) + valid 3x3/2
+        x = jnp.pad(x, ((0, 0), *_correct_pad(3, x.shape[1:3]), (0, 0)))
+        x = conv(_round_filters(32, self.width), (3, 3), strides=2,
+                 padding="VALID", name="stem_conv")(x)
+        x = bn(name="stem_bn")(x)
+        x = swish(x)
+
+        block_id = 0
+        total = sum(_round_repeats(r, self.depth) for (_, r, *_rest) in _BASE_BLOCKS)
+        for i, (k, repeats, fin, fout, expand, stride, se) in enumerate(_BASE_BLOCKS):
+            fin = _round_filters(fin, self.width)
+            fout = _round_filters(fout, self.width)
+            for j in range(_round_repeats(repeats, self.depth)):
+                name = f"block{i + 1}{chr(ord('a') + j)}"
+                x = self._mbconv(
+                    x, conv, bn, swish, name,
+                    kernel=k,
+                    filters_in=fin if j == 0 else fout,
+                    filters_out=fout,
+                    expand=expand,
+                    stride=stride if j == 0 else 1,
+                    se_ratio=se,
+                )
+                block_id += 1
+
+        # top
+        x = conv(_round_filters(1280, self.width), (1, 1), padding="SAME",
+                 name="top_conv")(x)
+        x = bn(name="top_bn")(x)
+        x = swish(x)
+        x = jnp.mean(x, axis=(1, 2))  # avg_pool
+        x = x.astype(jnp.float32)
+        x = nn.Dense(self.num_classes, name="predictions")(x)
+        return nn.softmax(x, axis=-1)
+
+    def _mbconv(self, x, conv, bn, swish, name, *, kernel, filters_in,
+                filters_out, expand, stride, se_ratio):
+        filters = filters_in * expand
+        inputs = x
+        if expand != 1:
+            x = conv(filters, (1, 1), padding="SAME",
+                     name=f"{name}_expand_conv")(x)
+            x = bn(name=f"{name}_expand_bn")(x)
+            x = swish(x)
+        # depthwise
+        if stride == 2:
+            x = jnp.pad(x, ((0, 0), *_correct_pad(kernel, x.shape[1:3]), (0, 0)))
+            pad = "VALID"
+        else:
+            pad = "SAME"
+        x = nn.Conv(
+            filters, (kernel, kernel), strides=stride, padding=pad,
+            feature_group_count=filters, use_bias=False, dtype=self.dtype,
+            name=f"{name}_dwconv",
+        )(x)
+        x = bn(name=f"{name}_bn")(x)
+        x = swish(x)
+        # squeeze & excite (1x1 convs on the pooled map, with bias)
+        if 0 < se_ratio <= 1:
+            se_filters = max(1, int(filters_in * se_ratio))
+            se = jnp.mean(x, axis=(1, 2), keepdims=True)  # se_squeeze+reshape
+            se = nn.Conv(se_filters, (1, 1), padding="SAME", use_bias=True,
+                         dtype=self.dtype, name=f"{name}_se_reduce")(se)
+            se = swish(se)
+            se = nn.Conv(filters, (1, 1), padding="SAME", use_bias=True,
+                         dtype=self.dtype, name=f"{name}_se_expand")(se)
+            se = nn.sigmoid(se)
+            x = x * se
+        # project
+        x = conv(filters_out, (1, 1), padding="SAME",
+                 name=f"{name}_project_conv")(x)
+        x = bn(name=f"{name}_project_bn")(x)
+        if stride == 1 and filters_in == filters_out:
+            x = x + inputs  # drop-connect is identity at inference
+        return x
+
+
+def build_variant(variant: str, num_classes: int = 1000, dtype=jnp.float32) -> EfficientNet:
+    width, depth, _ = VARIANTS[variant]
+    return EfficientNet(width=width, depth=depth, num_classes=num_classes, dtype=dtype)

@@ -37,7 +37,25 @@ def decode_image(data: bytes, size: Tuple[int, int]) -> np.ndarray:
 
 
 def load_images(paths: Iterable[str], size: Tuple[int, int]) -> np.ndarray:
-    """Decode a batch of image files -> uint8 (N, H, W, 3)."""
+    """Decode a batch of image files -> uint8 (N, H, W, 3).
+
+    Fast path: the native C++ loader (libjpeg DCT-scaled decode +
+    threaded resize, dml_tpu/native) for all-JPEG batches; PIL
+    otherwise or when the native lib is unavailable.
+    """
+    paths = [str(p) for p in paths]
+    if paths and all(p.lower().endswith((".jpg", ".jpeg")) for p in paths):
+        from ..native.loader import get_loader
+
+        loader = get_loader()
+        if loader is not None:
+            try:
+                return loader.decode_batch(paths, size)
+            except RuntimeError as e:
+                # e.g. a non-JPEG payload with a .jpeg name: PIL decides
+                import logging
+
+                logging.getLogger(__name__).debug("native decode fell back: %s", e)
     arrs: List[np.ndarray] = []
     for p in paths:
         with open(p, "rb") as f:
@@ -55,6 +73,8 @@ def normalize_on_device(x, mode: str, dtype=jnp.float32):
         x = x / 127.5 - 1.0
     elif mode == "unit":
         x = x / 255.0
+    elif mode == "raw":
+        pass  # model normalizes internally (EfficientNet bakes it in)
     else:
         raise ValueError(f"unknown preprocess mode {mode!r}")
     return x.astype(dtype)

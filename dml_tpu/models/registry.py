@@ -10,9 +10,16 @@ and the scheduler/engine pick it up untouched.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, Callable, Dict, Tuple
 
 import jax.numpy as jnp
+
+
+def _build_efficientnet(variant: str, num_classes: int = 1000, dtype=jnp.bfloat16):
+    from .efficientnet import build_variant
+
+    return build_variant(variant, num_classes=num_classes, dtype=dtype)
 
 
 @dataclass(frozen=True)
@@ -80,6 +87,20 @@ def _register_builtin() -> None:
             aliases=("resnet", "resnet-50"),
         )
     )
+    # input sizes inlined (efficientnet.VARIANTS) so registering stays
+    # lazy — the flax-heavy module loads on first build, not on import
+    for variant, size in (("b0", 224), ("b4", 380)):
+        register(
+            ModelSpec(
+                name=f"EfficientNet{variant.upper()}",
+                builder=partial(_build_efficientnet, variant),
+                input_size=(size, size),
+                preprocess="raw",  # normalization baked into the graph
+                # priors scaled from the ResNet CPU numbers by FLOPs
+                cost=CostDefaults(load_time=4.0, first_query=1.5, per_query=0.3),
+                aliases=(f"efficientnet-{variant}", f"effnet{variant}"),
+            )
+        )
     register(
         ModelSpec(
             name="InceptionV3",

@@ -1,0 +1,99 @@
+"""Decoder-only transformer LM with pluggable attention — the host
+model for long-context sequence parallelism (parallel/ring_attention).
+
+Net-new vs the reference (which has no sequence models, SURVEY §0):
+the framework's long-context path. The module itself is written as
+global-array code; only the attention kernel differs between
+single-chip (`reference_attention`) and sp-sharded execution
+(`ring_attention` under shard_map). Everything else — embeddings,
+norms, MLPs, the LM head — is GSPMD-sharded by jit from the in/out
+annotations (tokens sharded [dp, sp]).
+
+TPU notes: bf16 activations; d_model/d_ff sized for MXU tiling
+(multiples of 128 in real configs); rotary position embeddings (no
+learned position table to shard or overflow).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+AttentionFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
+
+
+def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding. x: [B, T, H, D], positions: [T]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class Block(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    attention: AttentionFn
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, positions):
+        b, t, _ = x.shape
+        h, hd = self.n_heads, self.d_model // self.n_heads
+        y = nn.RMSNorm(dtype=self.dtype, name="ln_attn")(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=self.dtype,
+                       name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = rope(q.reshape(b, t, h, hd), positions)
+        k = rope(k.reshape(b, t, h, hd), positions)
+        v = v.reshape(b, t, h, hd)
+        attn = self.attention(q, k, v, causal=True)
+        attn = attn.reshape(b, t, self.d_model)
+        x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                         name="proj")(attn)
+        y = nn.RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
+        y = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype, name="up")(y)
+        y = nn.silu(y)
+        y = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype, name="down")(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tokens [B, T] int32 -> logits [B, T, vocab] f32."""
+
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    attention: Optional[AttentionFn] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):
+        from ..parallel.ring_attention import reference_attention
+
+        attn = self.attention or reference_attention
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     name="embed")(tokens)
+        positions = jnp.arange(tokens.shape[1])
+        for i in range(self.n_layers):
+            x = Block(
+                d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
+                attention=attn, dtype=self.dtype, name=f"block_{i}",
+            )(x, positions)
+        x = nn.RMSNorm(dtype=self.dtype, name="ln_out")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
+                          name="lm_head")(x.astype(jnp.float32))
+        return logits

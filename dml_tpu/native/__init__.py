@@ -1,0 +1,3 @@
+from .loader import NativeLoader, get_loader, native_available
+
+__all__ = ["NativeLoader", "get_loader", "native_available"]

@@ -1,0 +1,121 @@
+"""ctypes wrapper for the native batch image loader (native/dataloader.cpp).
+
+Builds `libdmlloader.so` with g++ on first use (cached beside the
+source; rebuilt when the source is newer). The loader is the fast path
+of `models.preprocess.load_images`: libjpeg DCT-scaled decode + C++
+bilinear resize + thread pool, producing the contiguous NHWC uint8
+batch the engine ships to HBM. Falls back cleanly when a compiler or
+libjpeg is unavailable (`native_available()` -> False) — the PIL path
+stays fully supported.
+
+Set DML_NATIVE_LOADER=0 to force the PIL path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.abspath(os.path.join(_SRC_DIR, "dataloader.cpp"))
+_LIB = os.path.abspath(os.path.join(_SRC_DIR, "libdmlloader.so"))
+
+_lock = threading.Lock()
+_loader: Optional["NativeLoader"] = None
+_failed = False
+
+
+def _build() -> bool:
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    # compile to a private temp path and rename into place: concurrent
+    # processes (several nodes on one host) must never observe a
+    # half-written .so
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-march=native", "-fPIC", "-std=c++17", "-shared",
+        "-o", tmp, _SRC, "-ljpeg", "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception as e:
+        stderr = getattr(e, "stderr", b"")
+        log.info("native loader build failed (%s); using PIL path. %s",
+                 e, stderr.decode(errors="replace") if stderr else "")
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+class NativeLoader:
+    def __init__(self, lib_path: str = _LIB):
+        self._lib = ctypes.CDLL(lib_path)
+        self._lib.dml_decode_batch.restype = ctypes.c_int
+        self._lib.dml_decode_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        assert self._lib.dml_loader_version() >= 1
+
+    def decode_batch(
+        self, paths: Sequence[str], size, n_threads: int = 0
+    ) -> np.ndarray:
+        """JPEG files -> uint8 (N, H, W, 3). Raises RuntimeError with
+        the first file's error on failure."""
+        n = len(paths)
+        h, w = int(size[0]), int(size[1])
+        out = np.empty((n, h, w, 3), np.uint8)
+        if n == 0:
+            return out
+        arr = (ctypes.c_char_p * n)(*[os.fsencode(p) for p in paths])
+        errbuf = ctypes.create_string_buffer(512)
+        rc = self._lib.dml_decode_batch(
+            arr, n, h, w,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            int(n_threads), errbuf, len(errbuf),
+        )
+        if rc != 0:
+            raise RuntimeError(
+                f"native decode failed: {errbuf.value.decode(errors='replace')}"
+            )
+        return out
+
+
+def get_loader() -> Optional[NativeLoader]:
+    """The process-wide loader, built on first call; None if disabled
+    or unbuildable."""
+    global _loader, _failed
+    if os.environ.get("DML_NATIVE_LOADER", "1") == "0":
+        return None
+    if _loader is not None or _failed:
+        return _loader
+    with _lock:
+        if _loader is not None or _failed:
+            return _loader
+        try:
+            if not os.path.exists(_SRC) or not _build():
+                _failed = True
+                return None
+            _loader = NativeLoader()
+        except Exception:
+            log.exception("native loader unavailable; using PIL path")
+            _failed = True
+    return _loader
+
+
+def native_available() -> bool:
+    return get_loader() is not None

@@ -46,6 +46,9 @@ class ShardedInference:
         self.dtype = dtype
         if variables is None:
             variables = init_variables(self.spec, seed=seed, dtype=dtype)
+        self.num_classes = int(
+            variables["params"]["predictions"]["bias"].shape[-1]
+        )
         self._shardings = partition_params(variables, mesh)
         self.variables = jax.device_put(variables, self._shardings)
         model = self.spec.build(dtype=dtype)
@@ -77,4 +80,6 @@ class ShardedInference:
                 )
             probs = self._forward(self.variables, jnp.asarray(chunk))
             outs.append(np.asarray(probs)[: bs - pad if pad else bs])
-        return np.concatenate(outs)[:n] if outs else np.zeros((0,), np.float32)
+        if not outs:
+            return np.zeros((0, self.num_classes), np.float32)
+        return np.concatenate(outs)[:n]

@@ -1,0 +1,122 @@
+"""Long-context LM execution: sequences sharded across the mesh.
+
+Ties TransformerLM + ring attention + GSPMD sharding into runnable
+forward/train steps: tokens arrive [B, T] with B sharded over `dp` and
+T over `sp`, attention runs as the ring (KV blocks rotating over ICI),
+and the loss is the standard next-token cross-entropy computed on the
+sharded logits (XLA reduces across the mesh).
+
+This is the capability the reference never had — its inputs are single
+JPEGs — but which a TPU framework must treat as first-class: context
+length scales linearly with `sp` at constant per-chip memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerLM
+from .ring_attention import ring_attention
+from .sharding import partition_params
+
+
+def make_lm(mesh: Mesh, **config) -> TransformerLM:
+    """A TransformerLM whose attention is the sp-ring over `mesh`."""
+    attn = functools.partial(ring_attention, mesh=mesh)
+
+    def attention(q, k, v, causal=True):
+        return attn(q, k, v, causal=causal)
+
+    return TransformerLM(attention=attention, **config)
+
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy; last position predicts nothing."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+class LongContextLM:
+    """A sharded LM with compiled forward + train step.
+
+    >>> mesh = local_mesh(dp=1, sp=8)
+    >>> lm = LongContextLM(mesh, vocab_size=1000, d_model=128, seq_len=1024)
+    >>> loss = lm.train_step(tokens)          # T=1024 split 8 ways
+    >>> logits = lm.forward(tokens)
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        seq_len: int,
+        learning_rate: float = 3e-4,
+        dtype=jnp.bfloat16,
+        seed: int = 0,
+        **config,
+    ):
+        sp = mesh.shape.get("sp", 1)
+        if seq_len % max(sp, 1) != 0:
+            raise ValueError(f"seq_len {seq_len} not divisible by sp={sp}")
+        self.mesh = mesh
+        self.seq_len = seq_len
+        self.model = make_lm(mesh, dtype=dtype, **config)
+        tokens0 = jnp.zeros((1, seq_len), jnp.int32)
+        with mesh:
+            variables = jax.jit(
+                lambda rng: self.model.init(rng, tokens0)
+            )(jax.random.PRNGKey(seed))
+        self.optimizer = optax.adamw(learning_rate)
+        state = {
+            "params": variables["params"],
+            "opt_state": self.optimizer.init(variables["params"]),
+            "step": jnp.zeros((), jnp.int32),
+        }
+        self._state_sh = partition_params(state, mesh)
+        self.state = jax.device_put(state, self._state_sh)
+        tok_sh = NamedSharding(mesh, P("dp", "sp"))
+        logits_sh = NamedSharding(mesh, P("dp", "sp", None))
+        repl = NamedSharding(mesh, P())
+
+        def fwd(params, tokens):
+            return self.model.apply({"params": params}, tokens)
+
+        self.forward = jax.jit(
+            fwd,
+            in_shardings=(self._state_sh["params"], tok_sh),
+            out_shardings=logits_sh,
+        )
+
+        def train_step(state, tokens):
+            def loss_fn(params):
+                return lm_loss(fwd(params, tokens), tokens)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            updates, opt_state = self.optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
+            return {
+                "params": params,
+                "opt_state": opt_state,
+                "step": state["step"] + 1,
+            }, loss
+
+        self._train_step = jax.jit(
+            train_step,
+            in_shardings=(self._state_sh, tok_sh),
+            out_shardings=(self._state_sh, repl),
+            donate_argnums=(0,),
+        )
+
+    def train_step(self, tokens: np.ndarray) -> float:
+        self.state, loss = self._train_step(self.state, jnp.asarray(tokens))
+        return float(jax.device_get(loss))

@@ -1,0 +1,148 @@
+"""Ring attention: exact attention over sequences sharded across the
+`sp` mesh axis.
+
+Long-context support the TPU way (net-new vs the reference, which has
+no sequence models at all — SURVEY §0): each device holds a sequence
+chunk of Q/K/V; K/V blocks rotate around the ring via `ppermute` over
+ICI while every device accumulates its queries' attention with the
+flash-attention online-softmax recurrence (running max + running
+denominator), so the full T×T score matrix never materializes and the
+sequence length scales with the number of devices. Communication
+overlaps the per-block compute under XLA's scheduler.
+
+Written with `shard_map` (per-device code, explicit collective) —
+this is the one place the framework hand-places a collective, because
+the KV rotation order IS the algorithm; everything else in
+dml_tpu.parallel stays GSPMD-annotated jit.
+
+Layout convention: [batch, seq, heads, head_dim] ("BTHD"), seq sharded
+over `sp`, batch over `dp`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # jax >= 0.8 promotes shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """Scores + masked softmax numerator pieces for one KV block.
+
+    q: [B,Tq,H,D], k/v: [B,Tk,H,D], mask: [Tq,Tk] bool (True=keep).
+    Returns (m_blk [B,H,Tq], p [B,H,Tq,Tk]) with p = exp(s - m_blk).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m_blk[..., None])
+    if mask is not None:
+        # a fully-masked row yields exp(NEG_INF - NEG_INF) = 1s; zero it
+        any_valid = jnp.any(mask, axis=-1)  # [Tq]
+        p = p * any_valid[None, None, :, None]
+        m_blk = jnp.where(any_valid[None, None], m_blk, NEG_INF)
+    return m_blk, p
+
+
+def _ring_attention_local(
+    q, k, v, *, axis_name: str, batch_axis: str, causal: bool, scale: float
+):
+    """Per-device body (inside shard_map). q,k,v: [B, T_local, H, D]."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    q_pos = my_idx * t_local + jnp.arange(t_local)  # global query positions
+
+    # pvary: the scan carry must be device-varying like q/k/v are, or
+    # shard_map's type checker rejects the loop (jax >= 0.9)
+    def varying(x):
+        return jax.lax.pvary(x, (batch_axis, axis_name))
+
+    o = varying(jnp.zeros((b, h, t_local, d), jnp.float32))
+    m = varying(jnp.full((b, h, t_local), NEG_INF, jnp.float32))
+    l = varying(jnp.zeros((b, h, t_local), jnp.float32))
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # the block we hold at step i originated on device (my_idx - i)
+        src = (my_idx - i) % axis_size
+        mask = None
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        m_blk, p = _block_attn(
+            q.astype(jnp.float32), k_blk.astype(jnp.float32),
+            v_blk.astype(jnp.float32), scale, mask,
+        )
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p * jnp.exp(m_blk - m_new)[..., None],
+            v_blk.astype(jnp.float32),
+        )
+        l = l * alpha + jnp.sum(p, axis=-1) * jnp.exp(m_blk - m_new)
+        m = m_new
+        # rotate KV around the ring (ICI neighbor exchange)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, m, l, k_blk, v_blk), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v), jnp.arange(axis_size)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact (flash-equivalent) attention with the sequence sharded
+    over `axis_name`. Inputs/outputs [B, T, H, D] with T sharded on
+    `axis_name` and B on `dp`. T must divide evenly by the axis size.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    spec = P("dp", axis_name, None, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, batch_axis="dp",
+            causal=causal, scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal: bool = True, scale=None):
+    """Plain full-matrix attention (the correctness oracle)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -60,3 +60,41 @@ def test_keras_parity(name, keras_builder):
     kc, fc = ky - ky.mean(), fy - fy.mean()
     corr = float((kc * fc).sum() / np.sqrt((kc * kc).sum() * (fc * fc).sum()))
     assert corr > 0.5, f"centered correlation {corr:.3f} too low"
+
+
+@pytest.mark.parametrize("size", [(128, 128), (190, 190)])
+def test_keras_parity_efficientnet_b0(size):
+    """EfficientNetB0 parity at reduced input sizes (the graph is
+    fully convolutional; small inputs keep the 1-core CPU run fast).
+    Exercises the DepthwiseConv2D conversion, exact-name mapping, the
+    baked-in rescaling/normalization layers, and — at 190px, whose stem
+    output is an odd 95px map — the size-dependent `adjust` term in
+    Keras's correct_pad for stride-2 blocks."""
+    tf = _keras()
+    from dml_tpu.models import get_model
+
+    spec = get_model("EfficientNetB0")
+    # pin TF's global RNG: run order changes the random weights, and
+    # with an unlucky draw the softmax spread sinks below f32 noise,
+    # making the correlation check meaningless
+    tf.keras.utils.set_random_seed(7)
+    kmodel = tf.keras.applications.EfficientNetB0(
+        weights=None, input_shape=(*size, 3)
+    )
+    variables = init_variables(spec, seed=0, dtype=jnp.float32, image_size=size)
+    variables = from_keras_model(kmodel, variables)
+
+    rng = np.random.default_rng(0)
+    # raw-image domain: EfficientNet normalizes inside the graph
+    x = rng.uniform(0, 255, (1, *size, 3)).astype(np.float32)
+
+    ky = np.asarray(kmodel(x, training=False))
+    model = spec.build(dtype=jnp.float32)
+    fy = np.asarray(
+        jax.jit(lambda v, a: model.apply(v, a, train=False))(variables, x)
+    )
+    assert ky.shape == fy.shape == (1, 1000)
+    np.testing.assert_allclose(fy, ky, atol=2e-5)
+    kc, fc = ky - ky.mean(), fy - fy.mean()
+    corr = float((kc * fc).sum() / np.sqrt((kc * kc).sum() * (fc * fc).sum()))
+    assert corr > 0.5, f"centered correlation {corr:.3f} too low"

@@ -1,0 +1,64 @@
+"""Ring attention vs full-matrix attention on the 8-device mesh, and
+the long-context LM built on it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dml_tpu.parallel.mesh import local_mesh
+from dml_tpu.parallel.ring_attention import reference_attention, ring_attention
+
+
+def _qkv(b=2, t=128, h=4, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, t, h, d)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(causal):
+    mesh = local_mesh(dp=1, sp=8)
+    q, k, v = _qkv()
+    want = reference_attention(q, k, v, causal=causal)
+    got = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_with_dp_and_sp():
+    mesh = local_mesh(dp=2, sp=4)
+    q, k, v = _qkv(b=4, t=64)
+    want = reference_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_first_token_attends_only_itself():
+    # causal correctness at the chunk boundary: token 0 sees only v[0]
+    mesh = local_mesh(dp=1, sp=8)
+    q, k, v = _qkv(b=1, t=64)
+    out = np.asarray(ring_attention(q, k, v, mesh, causal=True))
+    np.testing.assert_allclose(out[0, 0], np.asarray(v)[0, 0], rtol=1e-5, atol=1e-5)
+
+
+def test_long_context_lm_trains_sharded():
+    from dml_tpu.parallel.long_context import LongContextLM
+
+    mesh = local_mesh(dp=1, sp=8)
+    lm = LongContextLM(
+        mesh, seq_len=256, vocab_size=128, d_model=64, n_heads=4,
+        n_layers=2, d_ff=128, dtype=jnp.float32, learning_rate=1e-2,
+    )
+    rng = np.random.RandomState(0)
+    # learnable data: short repeating pattern
+    tokens = np.tile(rng.randint(0, 128, 16), 16)[None, :256].astype(np.int32)
+    losses = [lm.train_step(tokens) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    logits = lm.forward(lm.state["params"], jnp.asarray(tokens))
+    assert logits.shape == (1, 256, 128)
+    # logits really are sp-sharded over the mesh
+    assert "sp" in str(logits.sharding.spec)
