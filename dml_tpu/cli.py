@@ -54,6 +54,8 @@ job commands (ML inference):
   submit-job <model> <N>            run N queries (ResNet50 | InceptionV3)
   get-output <jobid>                collect + merge a job's results
   predict-locally <model> <f...>    single-node inference on local files
+  save-model <model>                publish weights into the store
+  load-model <model> [version]      load published weights for serving
   C1                                per-model query counts + rates
   C2 <model>                        processing-time stats (mean/percentiles)
   C3 <model> <batch_size>           set batch size cluster-wide
@@ -169,6 +171,19 @@ class NodeApp:
             r = await j.predict_locally(a[0], a[1:])
             print(json.dumps(r["results"], indent=2))
             print(f"exec_time={r['exec_time']:.3f}s")
+        elif cmd == "save-model" and len(a) == 1:
+            r = await j.publish_model(a[0])
+            print(f"ok version={r['version']} replicas={r['replicas']}")
+        elif cmd == "load-model" and a:
+            await j.load_model_weights(a[0], int(a[1]) if len(a) > 1 else None)
+            print("ok loaded")
+        elif cmd == "profile" and len(a) == 1:
+            from .observability import SPANS
+
+            if a[0] == "spans":
+                print(json.dumps(SPANS.summary(), indent=2))
+            else:
+                print("usage: profile spans")
         elif cmd == "C1":
             for m, stats in j.c1_stats().items():
                 print(f"{m}: total={stats['total_queries']:.0f} "

@@ -1,0 +1,70 @@
+"""Model-weight distribution through the replicated store.
+
+The reference's workers each (re)download pretrained Keras weights at
+model construction (models.py:26, 51). Here weights move like any
+other replicated file: publish once (PUT, 4-way replicated, versioned
+— rollback is "load version N-1"), and every worker fetches from a
+nearby replica and loads straight into HBM. Trained checkpoints from
+parallel.Trainer flow through the same path back into serving.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from ..cluster.store_service import StoreService
+from ..models.params_io import (
+    init_variables,
+    variables_from_bytes,
+    variables_to_bytes,
+)
+from ..models.registry import get_model
+
+
+def weights_name(model_name: str) -> str:
+    return f"weights_{get_model(model_name).name}.msgpack"
+
+
+async def publish_weights(
+    store: StoreService, model_name: str, variables: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Serialize + PUT a model's variables; returns the PUT reply
+    (version + replica set)."""
+    data = variables_to_bytes(variables)
+    tmp = os.path.join(store.cfg.download_path(), f".pub_{weights_name(model_name)}")
+    os.makedirs(os.path.dirname(tmp), exist_ok=True)
+    with open(tmp, "wb") as f:
+        f.write(data)
+    try:
+        return await store.put(tmp, weights_name(model_name))
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+async def fetch_weights(
+    store: StoreService,
+    model_name: str,
+    version: Optional[int] = None,
+    dtype=None,
+) -> Dict[str, Any]:
+    """GET a model's published weights (latest or pinned version) and
+    deserialize against a fresh init tree."""
+    import jax.numpy as jnp
+
+    spec = get_model(model_name)
+    dest = os.path.join(
+        store.cfg.download_path(), f".fetch_{weights_name(model_name)}"
+    )
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    await store.get(weights_name(model_name), dest, version=version)
+    with open(dest, "rb") as f:
+        data = f.read()
+    # small init image: shapes are spatial-size independent
+    like = init_variables(
+        spec, dtype=dtype or jnp.bfloat16, image_size=(64, 64)
+    )
+    return variables_from_bytes(data, like)

@@ -731,17 +731,52 @@ class JobService:
             fut.set_result(dict(msg.data))
 
     # ------------------------------------------------------------------
+    # model-weight distribution (store-backed; inference/weights.py)
+    # ------------------------------------------------------------------
+
+    async def publish_model(self, model: str) -> Dict[str, Any]:
+        """Publish this node's current weights for `model` into the
+        replicated store (loads/initializes the model first if needed)."""
+        from ..inference.weights import publish_weights
+
+        eng = self._ensure_engine()
+        name = get_model(model).name
+        if name not in eng.loaded_models:
+            await asyncio.to_thread(eng.load_model, name)
+        lm = eng._require(name)
+        import jax
+
+        return await publish_weights(
+            self.store, name, jax.device_get(lm.variables)
+        )
+
+    async def load_model_weights(
+        self, model: str, version: Optional[int] = None
+    ) -> None:
+        """Fetch published weights from the store and (re)load the
+        serving engine with them."""
+        from ..inference.weights import fetch_weights
+
+        eng = self._ensure_engine()
+        name = get_model(model).name
+        variables = await fetch_weights(self.store, name, version=version)
+        await asyncio.to_thread(eng.load_model, name, variables)
+
+    def _ensure_engine(self):
+        if self._engine is None:
+            from ..inference.engine import InferenceEngine
+
+            self._engine = InferenceEngine()
+        return self._engine
+
+    # ------------------------------------------------------------------
     # default inference backend: the TPU engine
     # ------------------------------------------------------------------
 
     async def _engine_backend(
         self, model: str, paths: List[str]
     ) -> Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]:
-        if self._engine is None:
-            from ..inference.engine import InferenceEngine
-
-            self._engine = InferenceEngine()
-        eng = self._engine
+        eng = self._ensure_engine()
         if model not in eng.loaded_models:
             await asyncio.to_thread(eng.load_model, model)
         res = await eng.infer_files_async(model, paths)

@@ -1,0 +1,97 @@
+"""Tracing, profiling, and structured logging.
+
+The reference's observability is print-based wall-clock spans and a
+debug.log (SURVEY §5: no tracing, no profiling). The TPU-native
+equivalents:
+
+- `profile()` — jax.profiler trace context producing TensorBoard /
+  Perfetto traces of the XLA programs (compile + execute + transfers)
+- `span()` — lightweight wall-clock spans collected into a process
+  registry (the reference's `PUT runtime:` prints, structured)
+- `jsonl_logging()` — one-JSON-object-per-line log formatting for
+  machine-readable node logs
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional
+
+
+@contextlib.contextmanager
+def profile(logdir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace (view with TensorBoard's profile
+    plugin or Perfetto). Wrap a few representative steps, not a whole
+    run — traces are large."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Spans:
+    """Process-wide wall-clock span registry (mean/count per label)."""
+
+    def __init__(self):
+        self._acc: Dict[str, List[float]] = defaultdict(list)
+
+    @contextlib.contextmanager
+    def span(self, label: str) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self._acc[label].append(time.monotonic() - t0)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for label, xs in sorted(self._acc.items()):
+            out[label] = {
+                "count": float(len(xs)),
+                "total_s": sum(xs),
+                "mean_s": sum(xs) / len(xs),
+                "max_s": max(xs),
+            }
+        return out
+
+    def reset(self) -> None:
+        self._acc.clear()
+
+
+SPANS = Spans()
+span = SPANS.span
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        obj = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            obj["exc"] = self.formatException(record.exc_info)
+        return json.dumps(obj, ensure_ascii=False)
+
+
+def jsonl_logging(
+    path: Optional[str] = None, level: int = logging.INFO
+) -> logging.Handler:
+    """Install a JSON-lines handler on the root logger (file or stderr)."""
+    handler: logging.Handler = (
+        logging.FileHandler(path) if path else logging.StreamHandler()
+    )
+    handler.setFormatter(_JsonFormatter())
+    root = logging.getLogger()
+    root.addHandler(handler)
+    if root.level > level:
+        root.setLevel(level)
+    return handler
