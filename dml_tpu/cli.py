@@ -60,6 +60,8 @@ job commands (ML inference):
   C2 <model>                        processing-time stats (mean/percentiles)
   C3 <model> <batch_size>           set batch size cluster-wide
   C5                                current worker->batch assignments
+observability:
+  profile spans                     wall-clock span stats (store/job hot paths)
 other: help, quit
 """
 

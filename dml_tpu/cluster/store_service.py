@@ -173,20 +173,23 @@ class StoreService:
         replication (§3.3). Retried with an idempotency token: a
         duplicate PUT_REQUEST joins the in-flight request (or re-fetches
         the completed reply) instead of minting a second version."""
+        from ..observability import span
+
         local_path = os.path.abspath(os.path.expanduser(local_path))
         if not os.path.isfile(local_path):
             raise FileNotFoundError(local_path)
         token = self.data_plane.expose(local_path)
         try:
-            reply = await self._leader_retry(
-                MsgType.PUT_REQUEST,
-                {
-                    "file": sdfs_name,
-                    "token": token,
-                    "data_addr": list(data_addr(self.node.me)),
-                },
-                timeout=timeout,
-            )
+            with span("store.put"):
+                reply = await self._leader_retry(
+                    MsgType.PUT_REQUEST,
+                    {
+                        "file": sdfs_name,
+                        "token": token,
+                        "data_addr": list(data_addr(self.node.me)),
+                    },
+                    timeout=timeout,
+                )
         finally:
             self.data_plane.unexpose(token)
         if not reply.get("ok"):
@@ -203,6 +206,18 @@ class StoreService:
         """`get <sdfs> <local>` — download one version (latest default)
         from any live replica (reference get_file_locally,
         worker.py:1323-1354). Returns the version fetched."""
+        from ..observability import span
+
+        with span("store.get"):
+            return await self._get_impl(sdfs_name, local_path, version, timeout)
+
+    async def _get_impl(
+        self,
+        sdfs_name: str,
+        local_path: str,
+        version: Optional[int],
+        timeout: float,
+    ) -> int:
         reply = await self._leader_retry(
             MsgType.GET_FILE_REQUEST, {"file": sdfs_name}, timeout=timeout
         )

@@ -11,6 +11,7 @@ parallel.Trainer flow through the same path back into serving.
 from __future__ import annotations
 
 import os
+import uuid
 from typing import Any, Dict, Optional
 
 from ..cluster.store_service import StoreService
@@ -32,7 +33,12 @@ async def publish_weights(
     """Serialize + PUT a model's variables; returns the PUT reply
     (version + replica set)."""
     data = variables_to_bytes(variables)
-    tmp = os.path.join(store.cfg.download_path(), f".pub_{weights_name(model_name)}")
+    # unique temp name: concurrent publishes of the same model must not
+    # share a path (one's cleanup could delete the other's upload)
+    tmp = os.path.join(
+        store.cfg.download_path(),
+        f".pub_{uuid.uuid4().hex}_{weights_name(model_name)}",
+    )
     os.makedirs(os.path.dirname(tmp), exist_ok=True)
     with open(tmp, "wb") as f:
         f.write(data)
@@ -56,15 +62,32 @@ async def fetch_weights(
     import jax.numpy as jnp
 
     spec = get_model(model_name)
+    # unique temp name (see publish_weights) + cleanup after read
     dest = os.path.join(
-        store.cfg.download_path(), f".fetch_{weights_name(model_name)}"
+        store.cfg.download_path(),
+        f".fetch_{uuid.uuid4().hex}_{weights_name(model_name)}",
     )
     os.makedirs(os.path.dirname(dest), exist_ok=True)
     await store.get(weights_name(model_name), dest, version=version)
-    with open(dest, "rb") as f:
-        data = f.read()
+    try:
+        with open(dest, "rb") as f:
+            data = f.read()
+    finally:
+        try:
+            os.unlink(dest)
+        except OSError:
+            pass
     # small init image: shapes are spatial-size independent
     like = init_variables(
         spec, dtype=dtype or jnp.bfloat16, image_size=(64, 64)
     )
-    return variables_from_bytes(data, like)
+    restored = variables_from_bytes(data, like)
+    if dtype is not None:
+        # from_bytes keeps the serialized dtypes; honor the caller's ask
+        import jax
+
+        restored = jax.tree.map(
+            lambda like_leaf, leaf: jnp.asarray(leaf, like_leaf.dtype),
+            like, restored,
+        )
+    return restored

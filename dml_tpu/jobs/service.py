@@ -637,10 +637,14 @@ class JobService:
         self._current = (key, task)
 
     async def _execute(self, batch: Batch, coordinator: str) -> None:
+        from ..observability import span
+
         t0 = time.monotonic()
         try:
-            paths = await self._fetch_inputs(batch)
-            results, infer_time, cost = await self._backend(batch.model, paths)
+            with span("worker.fetch_inputs"):
+                paths = await self._fetch_inputs(batch)
+            with span("worker.inference"):
+                results, infer_time, cost = await self._backend(batch.model, paths)
             out_name = f"output_{batch.job_id}_{batch.batch_id}_{self.node.me.port}.json"
             tmp = os.path.join(self.store.cfg.download_path(), out_name)
             os.makedirs(os.path.dirname(tmp), exist_ok=True)
@@ -760,7 +764,13 @@ class JobService:
         eng = self._ensure_engine()
         name = get_model(model).name
         variables = await fetch_weights(self.store, name, version=version)
-        await asyncio.to_thread(eng.load_model, name, variables)
+        # keep the serving batch size across the reload — a C3
+        # set_batch_size must survive a weight rollout
+        prev = eng._models.get(name)
+        batch_size = prev.batch_size if prev is not None else None
+        await asyncio.to_thread(
+            eng.load_model, name, variables, batch_size
+        )
 
     def _ensure_engine(self):
         if self._engine is None:

@@ -1,0 +1,20 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The compute path is JAX/XLA; these kernels cover the spots where
+hand-placement beats the compiler's defaults:
+
+- `flash_attention`: blockwise attention (online softmax) — the
+  transformer serving/training hot op and the per-device block of the
+  sp ring (parallel/ring_attention.py).
+- `fused_normalize`: uint8 image -> normalized bf16/f32 in one VMEM
+  pass — the serving ingest op in front of every model forward
+  (models/preprocess.py).
+
+Every kernel has an `interpret` escape hatch so the same code runs on
+the CPU test mesh (tests/) and compiled on TPU.
+"""
+
+from .flash_attention import flash_attention
+from .preprocess import fused_normalize
+
+__all__ = ["flash_attention", "fused_normalize"]

@@ -1,0 +1,11 @@
+"""Shared helpers for the Pallas kernels."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_default() -> bool:
+    """Compile via Mosaic on TPU; run the Pallas interpreter elsewhere
+    (the CPU test mesh)."""
+    return jax.default_backend() != "tpu"

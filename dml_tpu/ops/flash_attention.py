@@ -1,0 +1,375 @@
+"""Flash attention as a Pallas TPU kernel (forward + custom-VJP backward).
+
+Blockwise attention with the online-softmax recurrence: the [T, T]
+score matrix never materializes; each (batch, head, q-block) streams
+over k-blocks accumulating output, running max, and running
+denominator in VMEM scratch. The grid's innermost dimension is the
+k-block index — TPU grids execute sequentially, so scratch carries
+the accumulation across k-steps and the output block is written once
+on the last step.
+
+Backward is two more kernels with the standard recomputation split:
+`dq` accumulates over k-blocks, `dk`/`dv` accumulate over q-blocks,
+both driven by the saved per-row logsumexp and the precomputed
+`delta = rowsum(dO * O)`.
+
+This is the single-device analog of parallel/ring_attention.py: the
+ring rotates KV chunks across chips via ppermute, this kernel streams
+KV blocks through VMEM within a chip. Layout convention matches the
+rest of the framework: [batch, seq, heads, head_dim] ("BTHD").
+
+The reference has no attention anywhere (SURVEY §0 — its models are
+CNNs over single images); this is part of the net-new long-context
+path, written per /opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._util import interpret_default as _interpret_default
+
+NEG_INF = -1e30
+# lane width: scratch for the per-row running stats is kept
+# (block_q, 128) so every read/write is a full native tile
+LANES = 128
+
+
+def _causal_mask(s, iq, ik, block_q, block_k):
+    qpos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+def _kv_valid_mask(s, ik, block_k, t_kv):
+    """Mask k positions past the true sequence length (pad columns)."""
+    kpos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(kpos < t_kv, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, t_kv, padded_kv):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        q = q_ref[:].astype(jnp.float32)  # [bq, D]
+        k = k_ref[:].astype(jnp.float32)  # [bk, D]
+        v = v_ref[:].astype(jnp.float32)  # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            s = _causal_mask(s, iq, ik, block_q, block_k)
+        if padded_kv:
+            s = _kv_valid_mask(s, ik, block_k, t_kv)
+        m_prev = m_scr[:, :1]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip blocks entirely above the diagonal
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_body)
+    else:
+        _body()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[:] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[:] = m_scr[:, :1] + jnp.log(l)  # [bq, 1]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k, t_kv,
+                   padded_kv):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _body():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)  # [bq, D]
+        lse = lse_ref[:]                    # [bq, 1]
+        delta = delta_ref[:]                # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, block_q, block_k)
+        if padded_kv:
+            s = _kv_valid_mask(s, ik, block_k, t_kv)
+        p = jnp.exp(s - lse)                   # [bq, bk]
+        dp = jax.lax.dot_general(              # dO @ V^T: [bq, bk]
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_body)
+    else:
+        _body()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                    block_q, block_k, t_kv, padded_kv):
+    # note the transposed grid: (b, h, k-block, q-block)
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _body():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]    # [bq, 1]
+        delta = delta_ref[:]  # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, block_q, block_k)
+        if padded_kv:
+            s = _kv_valid_mask(s, ik, block_k, t_kv)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(  # P^T @ dO: [bk, D]
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(  # dS^T @ Q: [bk, D]
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_body)
+    else:
+        _body()
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pad_seq(x, block):
+    """Pad the seq axis (axis 2 of [B,H,T,D] / [B,H,T]) to a block
+    multiple."""
+    t = x.shape[2]
+    pad = (-t) % block
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[2] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _bhtd(x):
+    return jnp.transpose(x, (0, 2, 1, 3))  # BTHD <-> BHTD (involution)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd_impl(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+    """q,k,v: [B,H,T,D]. Returns (out [B,H,T,D], lse [B,H,T]) f32 lse."""
+    b, h, t, d = q.shape
+    t_kv = k.shape[2]
+    bq = min(block_q, t)
+    bk = min(block_k, t_kv)
+    qp = _pad_seq(q, bq)
+    kp = _pad_seq(k, bk)
+    vp = _pad_seq(v, bk)
+    nq = qp.shape[2] // bq
+    nk = kp.shape[2] // bk
+    padded_kv = kp.shape[2] != t_kv
+
+    q_spec = pl.BlockSpec((None, None, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((None, None, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    o_spec = pl.BlockSpec((None, None, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    # rows stored [B, H, T, 1]: trailing singleton lane dim keeps the
+    # block's last-two-dims (bq, 1) legal for Mosaic (bs0 == as0)
+    lse_spec = pl.BlockSpec((None, None, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        t_kv=t_kv, padded_kv=padded_kv,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[o_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            jax.ShapeDtypeStruct((*qp.shape[:3], 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),  # running max
+            pltpu.VMEM((bq, LANES), jnp.float32),  # running denominator
+            pltpu.VMEM((bq, d), jnp.float32),      # output accumulator
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :, :t], lse[:, :, :t, 0]
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    b, h, t, d = q.shape
+    t_kv = k.shape[2]
+    bq = min(block_q, t)
+    bk = min(block_k, t_kv)
+    # delta_i = sum_d dO_i O_i — the rowwise correction term
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B,H,T]
+
+    qp, gp = _pad_seq(q, bq), _pad_seq(g, bq)
+    kp, vp = _pad_seq(k, bk), _pad_seq(v, bk)
+    # rows as [B, H, T, 1] (see forward); pad lse with +big so pad
+    # q-rows produce p = exp(s - big) = 0
+    lsep = _pad_seq(lse[..., None], bq)
+    if lsep.shape[2] != t:
+        pad_rows = (
+            jax.lax.broadcasted_iota(jnp.int32, lsep.shape, 2) >= t
+        )
+        lsep = jnp.where(pad_rows, jnp.float32(-NEG_INF), lsep)
+    deltap = _pad_seq(delta[..., None], bq)
+    nq = qp.shape[2] // bq
+    nk = kp.shape[2] // bk
+    padded_kv = kp.shape[2] != t_kv
+
+    q_spec = pl.BlockSpec((None, None, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((None, None, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    row_spec = pl.BlockSpec((None, None, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, t_kv=t_kv, padded_kv=padded_kv,
+        ),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lsep, deltap)[:, :, :t]
+
+    # transposed grid: q-block innermost so dk/dv accumulate in scratch
+    q_spec_t = pl.BlockSpec((None, None, bq, d), lambda b_, h_, j, i: (b_, h_, i, 0))
+    kv_spec_t = pl.BlockSpec((None, None, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0))
+    row_spec_t = pl.BlockSpec((None, None, bq, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, t_kv=t_kv, padded_kv=padded_kv,
+        ),
+        grid=(b, h, nk, nq),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[
+            jax.ShapeDtypeStruct(kp.shape, k.dtype),
+            jax.ShapeDtypeStruct(vp.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lsep, deltap)
+    return dq, dk[:, :, :t_kv], dv[:, :, :t_kv]
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Blockwise (flash) attention. q, k, v: [B, T, H, D] (T of k/v may
+    differ from q's); returns [B, Tq, H, D] in q's dtype.
+
+    Differentiable (custom VJP, both passes are Pallas kernels).
+    `interpret=None` auto-selects: compiled on TPU, interpreter
+    elsewhere (the CPU test mesh).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [B,T,H,D], got {q.shape}")
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError("causal attention needs equal q/k lengths")
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    interpret = _interpret_default() if interpret is None else interpret
+    out = _flash(
+        _bhtd(q), _bhtd(k), _bhtd(v), causal, scale, block_q, block_k,
+        interpret,
+    )
+    return _bhtd(out)

@@ -28,11 +28,41 @@ from .sharding import partition_params
 
 
 def make_lm(mesh: Mesh, **config) -> TransformerLM:
-    """A TransformerLM whose attention is the sp-ring over `mesh`."""
-    attn = functools.partial(ring_attention, mesh=mesh)
+    """A TransformerLM with the right attention for `mesh`: the sp-ring
+    (KV rotation over ICI) when the sequence is sharded, the Pallas
+    flash kernel (ops/flash_attention.py) on a single sequence shard —
+    dp/tp sharding of the flash path is GSPMD's job."""
+    if mesh.shape.get("sp", 1) > 1:
+        attn = functools.partial(ring_attention, mesh=mesh)
 
-    def attention(q, k, v, causal=True):
-        return attn(q, k, v, causal=causal)
+        def attention(q, k, v, causal=True):
+            return attn(q, k, v, causal=causal)
+    else:
+        from jax import shard_map
+
+        from ..ops import flash_attention
+
+        # GSPMD can't partition an opaque pallas_call, so place the
+        # kernel per-device explicitly: batch over dp, heads over tp
+        # (both embarrassingly parallel in attention)
+        spec = P("dp", None, "tp", None)
+
+        def attention(q, k, v, causal=True):
+            def local(q, k, v):
+                return flash_attention(q, k, v, causal=causal)
+
+            # model.init traces with batch=1; anything not evenly
+            # shardable (batch over dp, heads over tp) runs the kernel
+            # unplaced — correct, just not partitioned
+            if (q.shape[0] % mesh.shape.get("dp", 1) != 0
+                    or q.shape[2] % mesh.shape.get("tp", 1) != 0):
+                return flash_attention(q, k, v, causal=causal)
+            # check_vma=False: pallas_call out_shapes carry no vma
+            # info, and the kernel is per-device pure anyway
+            return shard_map(
+                local, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=False,
+            )(q, k, v)
 
     return TransformerLM(attention=attention, **config)
 
