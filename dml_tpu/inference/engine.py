@@ -115,7 +115,11 @@ class InferenceEngine:
             ):
                 return cached
             # explicit new weights or batch size: rebuild, don't silently
-            # serve the stale entry
+            # serve the stale entry — but a reload without an explicit
+            # batch size keeps the serving one (a C3 set_batch_size must
+            # survive a weight rollout)
+            if batch_size is None:
+                batch_size = cached.batch_size
             del self._models[key]
         t0 = time.monotonic()
         if variables is None:
@@ -128,7 +132,16 @@ class InferenceEngine:
             return model.apply(vs, x, train=False)
 
         forward = jax.jit(fwd)
-        pred = variables["params"]["predictions"]["bias"]
+        # classifier width from the head params ("predictions" is the
+        # Keras-parity name on the CNN families, "head" on ViT)
+        params = variables["params"]
+        head = params.get("predictions") or params.get("head")
+        if head is None or "bias" not in head:
+            raise ValueError(
+                f"{spec.name}: cannot find classifier head in params "
+                f"(top-level keys: {sorted(params)[:8]}...)"
+            )
+        pred = head["bias"]
         lm = _LoadedModel(
             spec=spec,
             variables=variables,

@@ -77,9 +77,13 @@ async def fetch_weights(
             os.unlink(dest)
         except OSError:
             pass
-    # small init image: shapes are spatial-size independent
+    # small init image where param shapes allow it (spatial_invariant
+    # CNNs); ViT-style models size pos_embed by patch count, so their
+    # template must be built at the deployment input size
     like = init_variables(
-        spec, dtype=dtype or jnp.bfloat16, image_size=(64, 64)
+        spec,
+        dtype=dtype or jnp.bfloat16,
+        image_size=(64, 64) if spec.spatial_invariant else None,
     )
     restored = variables_from_bytes(data, like)
     if dtype is not None:

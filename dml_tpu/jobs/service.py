@@ -764,13 +764,9 @@ class JobService:
         eng = self._ensure_engine()
         name = get_model(model).name
         variables = await fetch_weights(self.store, name, version=version)
-        # keep the serving batch size across the reload — a C3
-        # set_batch_size must survive a weight rollout
-        prev = eng._models.get(name)
-        batch_size = prev.batch_size if prev is not None else None
-        await asyncio.to_thread(
-            eng.load_model, name, variables, batch_size
-        )
+        # engine.load_model keeps the serving batch size across a
+        # reload (a C3 set_batch_size survives a weight rollout)
+        await asyncio.to_thread(eng.load_model, name, variables)
 
     def _ensure_engine(self):
         if self._engine is None:

@@ -45,6 +45,10 @@ class ModelSpec:
     preprocess: str  # normalize_on_device mode
     cost: CostDefaults
     aliases: Tuple[str, ...] = ()
+    # True when param shapes are independent of the input's spatial size
+    # (fully-conv + global-pool CNNs) — lets init/restore templates use a
+    # small image. ViT's pos_embed is sized by patch count, so False there.
+    spatial_invariant: bool = True
 
     def build(self, dtype=jnp.bfloat16, num_classes: int = 1000):
         return self.builder(num_classes=num_classes, dtype=dtype)
@@ -99,6 +103,23 @@ def _register_builtin() -> None:
                 # priors scaled from the ResNet CPU numbers by FLOPs
                 cost=CostDefaults(load_time=4.0, first_query=1.5, per_query=0.3),
                 aliases=(f"efficientnet-{variant}", f"effnet{variant}"),
+            )
+        )
+    def _build_vit(variant, num_classes=1000, dtype=jnp.bfloat16):
+        from . import vit
+
+        return getattr(vit, f"ViT_{variant}")(num_classes=num_classes, dtype=dtype)
+
+    for variant in ("B16", "S16", "Ti16"):
+        register(
+            ModelSpec(
+                name=f"ViT-{variant}",
+                builder=partial(_build_vit, variant),
+                input_size=(224, 224),
+                preprocess="tf",  # [-1, 1] scaling, the standard ViT input
+                cost=CostDefaults(load_time=4.0, first_query=1.5, per_query=0.3),
+                aliases=(f"vit{variant.lower()}", f"vit_{variant.lower()}"),
+                spatial_invariant=False,  # pos_embed sized by patch count
             )
         )
     register(

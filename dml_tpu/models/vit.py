@@ -1,0 +1,139 @@
+"""Vision Transformer (ViT) family in Flax.
+
+Net-new model family beyond the reference's two CNNs (reference
+models.py:23-71 hardwires InceptionV3 + ResNet50) — the registry makes
+adding a family a single `register()` call (models/registry.py), and
+the scheduler/engine/job pipeline pick it up untouched, which is the
+capability the reference lacks.
+
+TPU notes:
+- The patch embedding is a stride-`p` conv, which XLA lowers to one
+  [N*patches, p*p*3] x [p*p*3, hidden] matmul on the MXU.
+- Attention is pluggable (same `AttentionFn` convention as
+  models/transformer.py): the default is the XLA-fused reference
+  attention — at ViT sequence lengths (197 tokens for B/16 at 224²)
+  the [T, T] score matrix is tiny and XLA's fusion is already optimal;
+  `ops.flash_attention` drops in for long-sequence variants.
+- bf16 activations end-to-end, f32 classifier head + softmax,
+  matching the ResNet/Inception output convention (probs, not logits).
+- All shapes static: one jit compilation serves every batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+AttentionFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> [B,T,H,D]
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN transformer encoder block (non-causal)."""
+
+    hidden: int
+    n_heads: int
+    mlp_dim: int
+    attention: AttentionFn
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, _ = x.shape
+        h, hd = self.n_heads, self.hidden // self.n_heads
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        qkv = nn.Dense(3 * self.hidden, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        attn = self.attention(
+            q.reshape(b, t, h, hd),
+            k.reshape(b, t, h, hd),
+            v.reshape(b, t, h, hd),
+            causal=False,
+        )
+        x = x + nn.Dense(self.hidden, dtype=self.dtype, name="proj")(
+            attn.reshape(b, t, self.hidden)
+        )
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="up")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden, dtype=self.dtype, name="down")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT classifier: uint-normalized NHWC images -> class probs.
+
+    Position embeddings are sized from the input at `init` time, so a
+    ViT instance is bound to one image size (use `spec.input_size`).
+    """
+
+    patch: int = 16
+    hidden: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    attention: Optional[AttentionFn] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from ..parallel.ring_attention import reference_attention
+
+        attn = self.attention or reference_attention
+        x = x.astype(self.dtype)
+        b = x.shape[0]
+        x = nn.Conv(
+            self.hidden,
+            (self.patch, self.patch),
+            strides=self.patch,
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, self.hidden)  # [B, patches, hidden]
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, self.hidden), jnp.float32
+        )
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (b, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.hidden),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.n_layers):
+            x = EncoderBlock(
+                hidden=self.hidden,
+                n_heads=self.n_heads,
+                mlp_dim=self.mlp_dim,
+                attention=attn,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_out")(x)
+        x = x[:, 0].astype(jnp.float32)  # cls token, f32 head
+        x = nn.Dense(self.num_classes, name="head")(x)
+        return nn.softmax(x, axis=-1)
+
+
+def ViT_B16(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ViT:
+    return ViT(num_classes=num_classes, dtype=dtype)
+
+
+def ViT_S16(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ViT:
+    return ViT(
+        hidden=384, n_layers=12, n_heads=6, mlp_dim=1536,
+        num_classes=num_classes, dtype=dtype,
+    )
+
+
+def ViT_Ti16(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ViT:
+    """Tiny variant — fast enough for CPU-mesh tests."""
+    return ViT(
+        hidden=192, n_layers=3, n_heads=3, mlp_dim=768,
+        num_classes=num_classes, dtype=dtype,
+    )

@@ -7,8 +7,10 @@ hand-placement beats the compiler's defaults:
   transformer serving/training hot op and the per-device block of the
   sp ring (parallel/ring_attention.py).
 - `fused_normalize`: uint8 image -> normalized bf16/f32 in one VMEM
-  pass — the serving ingest op in front of every model forward
-  (models/preprocess.py).
+  pass — a drop-in Pallas alternative to `normalize_on_device`
+  (models/preprocess.py), which the serving engine uses today (XLA
+  already fuses the elementwise normalize into the first conv; this
+  kernel exists for pipelines that want the ingest op standalone).
 
 Every kernel has an `interpret` escape hatch so the same code runs on
 the CPU test mesh (tests/) and compiled on TPU.

@@ -14,6 +14,7 @@ length scales linearly with `sp` at constant per-chip memory.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -53,9 +54,19 @@ def make_lm(mesh: Mesh, **config) -> TransformerLM:
 
             # model.init traces with batch=1; anything not evenly
             # shardable (batch over dp, heads over tp) runs the kernel
-            # unplaced — correct, just not partitioned
+            # unplaced — correct, just not partitioned. Warn outside
+            # the known init trace: at real batch sizes this replicates
+            # full attention on every device, a silent perf cliff.
             if (q.shape[0] % mesh.shape.get("dp", 1) != 0
                     or q.shape[2] % mesh.shape.get("tp", 1) != 0):
+                if q.shape[0] > 1:
+                    logging.getLogger(__name__).warning(
+                        "attention batch=%d heads=%d not divisible by "
+                        "mesh dp=%d/tp=%d: running UNPARTITIONED "
+                        "(replicated on every device)",
+                        q.shape[0], q.shape[2],
+                        mesh.shape.get("dp", 1), mesh.shape.get("tp", 1),
+                    )
                 return flash_attention(q, k, v, causal=causal)
             # check_vma=False: pallas_call out_shapes carry no vma
             # info, and the kernel is per-device pure anyway

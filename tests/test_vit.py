@@ -1,0 +1,112 @@
+"""ViT model family: shapes, registry wiring, jit, trainability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dml_tpu.models import get_model
+from dml_tpu.models.vit import ViT_Ti16, ViT
+
+
+def test_vit_forward_shape_and_probs():
+    # small image + tiny variant keeps the CPU compile fast; the graph
+    # structure (patchify, cls token, pos embed, encoder) is identical
+    model = ViT(patch=8, hidden=64, n_layers=2, n_heads=2, mlp_dim=128,
+                num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    # 16 patches + cls token
+    assert variables["params"]["pos_embed"].shape == (1, 17, 64)
+    y = jax.jit(lambda v, a: model.apply(v, a, train=False))(variables, x)
+    assert y.shape == (2, 10)
+    np.testing.assert_allclose(np.sum(np.asarray(y), axis=-1), 1.0, rtol=1e-4)
+
+
+def test_vit_registry():
+    for name, alias in (("ViT-B16", "vitb16"), ("ViT-S16", "vits16"),
+                        ("ViT-Ti16", "vitti16")):
+        spec = get_model(name)
+        assert spec.name == name
+        assert get_model(alias) is spec
+        assert spec.input_size == (224, 224)
+
+
+def test_vit_registry_builds_and_runs():
+    spec = get_model("ViT-Ti16")
+    model = spec.build(dtype=jnp.float32)
+    assert isinstance(model, ViT)
+    x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = jax.jit(
+        lambda: model.init(jax.random.PRNGKey(0), x, train=False)
+    )()
+    # 196 patches + cls
+    assert variables["params"]["pos_embed"].shape == (1, 197, 192)
+    y = model.apply(variables, x, train=False)
+    assert y.shape == (1, 1000)
+
+
+def test_vit_gradients_flow():
+    model = ViT(patch=8, hidden=32, n_layers=1, n_heads=2, mlp_dim=64,
+                num_classes=5, dtype=jnp.float32)
+    x = jnp.ones((2, 16, 16, 3), jnp.float32) * 0.5
+    labels = jnp.array([1, 3])
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+
+    def loss_fn(params):
+        probs = model.apply({"params": params}, x, train=True)
+        logp = jnp.log(probs + 1e-9)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+    assert np.isfinite(float(loss))
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert any(float(jnp.abs(g).max()) > 0 for g in leaves)
+
+
+def test_vit_weights_roundtrip_template_uses_full_size():
+    # ViT is NOT spatial-size invariant (pos_embed is sized by patch
+    # count), so restore templates must be built at spec.input_size —
+    # the registry flag drives fetch_weights' template choice.
+    from dml_tpu.models.params_io import (
+        init_variables, variables_from_bytes, variables_to_bytes,
+    )
+
+    spec = get_model("ViT-Ti16")
+    assert not spec.spatial_invariant
+    assert get_model("ResNet50").spatial_invariant
+    published = init_variables(spec, seed=1, dtype=jnp.float32)
+    data = variables_to_bytes(published)
+    like = init_variables(spec, seed=0, dtype=jnp.float32, image_size=None)
+    restored = variables_from_bytes(data, like)
+    assert restored["params"]["pos_embed"].shape == (1, 197, 192)
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["pos_embed"]),
+        np.asarray(published["params"]["pos_embed"]),
+    )
+
+
+def test_vit_serves_through_engine():
+    from dml_tpu.inference.engine import InferenceEngine
+
+    e = InferenceEngine(dtype=jnp.float32)
+    e.load_model("ViT-Ti16", batch_size=2, warmup=False)
+    probs = e.infer_arrays("ViT-Ti16", np.zeros((3, 224, 224, 3), np.uint8))
+    assert probs.shape == (3, 1000)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_vit_flash_attention_matches_reference():
+    from dml_tpu.ops.flash_attention import flash_attention
+
+    x = jnp.asarray(
+        np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32
+    )
+    kw = dict(patch=8, hidden=64, n_layers=2, n_heads=2, mlp_dim=128,
+              num_classes=10, dtype=jnp.float32)
+    ref_model = ViT(**kw)
+    variables = ref_model.init(jax.random.PRNGKey(0), x, train=False)
+    ref = ref_model.apply(variables, x, train=False)
+    flash_model = ViT(**kw, attention=flash_attention)
+    out = flash_model.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
