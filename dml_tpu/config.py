@@ -91,9 +91,14 @@ class MeshSpec:
     dp: int = -1
     tp: int = 1
     sp: int = 1
+    pp: int = 1  # pipeline stages (parallel/pipeline.py)
+    ep: int = 1  # expert shards (parallel/moe.py)
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        sizes = {"dp": self.dp, "tp": self.tp, "sp": self.sp}
+        sizes = {
+            "dp": self.dp, "tp": self.tp, "sp": self.sp,
+            "pp": self.pp, "ep": self.ep,
+        }
         fixed = 1
         free = None
         for ax, s in sizes.items():

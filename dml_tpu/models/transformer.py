@@ -46,6 +46,11 @@ class Block(nn.Module):
     d_ff: int
     attention: AttentionFn
     dtype: Any = jnp.bfloat16
+    # >0 turns this block's FFN into a mixture-of-experts
+    # (parallel/moe.py), sharded over `ep` when `mesh` is given
+    num_experts: int = 0
+    capacity_factor: float = 1.25
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -63,6 +68,15 @@ class Block(nn.Module):
         x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
                          name="proj")(attn)
         y = nn.RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
+        if self.num_experts:
+            from ..parallel.moe import MoEMLP
+
+            y = MoEMLP(
+                num_experts=self.num_experts, d_ff=self.d_ff,
+                capacity_factor=self.capacity_factor, mesh=self.mesh,
+                dtype=self.dtype, name="moe",
+            )(y)
+            return x + y
         y = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype, name="up")(y)
         y = nn.silu(y)
         y = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype, name="down")(y)
@@ -79,6 +93,12 @@ class TransformerLM(nn.Module):
     d_ff: int = 2048
     attention: Optional[AttentionFn] = None
     dtype: Any = jnp.bfloat16
+    # num_experts > 0 makes every `moe_every`-th block's FFN an MoE
+    # (GShard-style interleaving: dense and sparse blocks alternate)
+    num_experts: int = 0
+    moe_every: int = 2
+    capacity_factor: float = 1.25
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, tokens):
@@ -89,9 +109,15 @@ class TransformerLM(nn.Module):
                      name="embed")(tokens)
         positions = jnp.arange(tokens.shape[1])
         for i in range(self.n_layers):
+            is_moe = (
+                self.num_experts > 0
+                and i % self.moe_every == self.moe_every - 1
+            )
             x = Block(
                 d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
                 attention=attn, dtype=self.dtype, name=f"block_{i}",
+                num_experts=self.num_experts if is_moe else 0,
+                capacity_factor=self.capacity_factor, mesh=self.mesh,
             )(x, positions)
         x = nn.RMSNorm(dtype=self.dtype, name="ln_out")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,

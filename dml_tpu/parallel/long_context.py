@@ -75,7 +75,7 @@ def make_lm(mesh: Mesh, **config) -> TransformerLM:
                 out_specs=spec, check_vma=False,
             )(q, k, v)
 
-    return TransformerLM(attention=attention, **config)
+    return TransformerLM(attention=attention, mesh=mesh, **config)
 
 
 def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
@@ -102,6 +102,7 @@ class LongContextLM:
         learning_rate: float = 3e-4,
         dtype=jnp.bfloat16,
         seed: int = 0,
+        moe_aux_weight: float = 1e-2,
         **config,
     ):
         sp = mesh.shape.get("sp", 1)
@@ -110,7 +111,9 @@ class LongContextLM:
         self.mesh = mesh
         self.seq_len = seq_len
         self.model = make_lm(mesh, dtype=dtype, **config)
-        tokens0 = jnp.zeros((1, seq_len), jnp.int32)
+        # init at batch=dp so the ring's shard_map (batch over dp) is
+        # satisfiable in the init trace; param shapes are batch-free
+        tokens0 = jnp.zeros((max(1, mesh.shape.get("dp", 1)), seq_len), jnp.int32)
         with mesh:
             variables = jax.jit(
                 lambda rng: self.model.init(rng, tokens0)
@@ -135,10 +138,23 @@ class LongContextLM:
             in_shardings=(self._state_sh["params"], tok_sh),
             out_shardings=logits_sh,
         )
+        aux_w = moe_aux_weight
 
         def train_step(state, tokens):
             def loss_fn(params):
-                return lm_loss(fwd(params, tokens), tokens)
+                # collect the MoE load-balance losses sown by MoEMLP —
+                # without them in the objective the top-2 router can
+                # collapse onto one expert and silently drop tokens
+                logits, updated = self.model.apply(
+                    {"params": params}, tokens, mutable=["losses"]
+                )
+                aux_terms = jax.tree_util.tree_leaves(
+                    updated.get("losses", {})
+                )
+                aux = (
+                    sum(aux_terms) / len(aux_terms) if aux_terms else 0.0
+                )
+                return lm_loss(logits, tokens) + aux_w * aux
 
             loss, grads = jax.value_and_grad(loss_fn)(state["params"])
             updates, opt_state = self.optimizer.update(

@@ -20,7 +20,7 @@ from jax.sharding import Mesh
 
 from ..config import MeshSpec
 
-AXES = ("dp", "tp", "sp")
+AXES = ("dp", "tp", "sp", "pp", "ep")
 
 
 def make_mesh(
@@ -40,6 +40,8 @@ def make_mesh(
     return Mesh(arr, AXES)
 
 
-def local_mesh(dp: int = -1, tp: int = 1, sp: int = 1) -> Mesh:
+def local_mesh(
+    dp: int = -1, tp: int = 1, sp: int = 1, pp: int = 1, ep: int = 1
+) -> Mesh:
     """Convenience: mesh over whatever devices this process sees."""
-    return make_mesh(MeshSpec(dp=dp, tp=tp, sp=sp))
+    return make_mesh(MeshSpec(dp=dp, tp=tp, sp=sp, pp=pp, ep=ep))

@@ -1,0 +1,152 @@
+"""Mixture-of-Experts FFN with expert parallelism over an `ep` mesh axis.
+
+Net-new vs the reference (SURVEY §2: EP absent). GShard-style static
+dispatch, built for XLA rather than around it:
+
+- Routing is top-2 softmax gating with a fixed per-expert capacity
+  C = ceil(tokens/E * capacity_factor): the dispatch and combine
+  tensors are dense one-hot [tokens, E, C] arrays, so every shape is
+  static and the whole layer is three einsums — no sorting, no
+  ragged gathers, nothing the TPU can't tile.
+- Expert weights are stacked [E, ...] and sharded over `ep`
+  (`moe_partition_spec`); the dispatch einsum's output is
+  sharding-constrained to `ep`, which is exactly the point where GSPMD
+  inserts the token all_to_all over ICI. No hand-written collectives.
+- Routing math runs in float32 (softmax + cumsum position assignment
+  are precision-sensitive); expert FFNs run in the model dtype (MXU).
+- Dropped tokens (over capacity) pass through on the residual path,
+  the standard GShard behavior. The load-balance auxiliary loss is
+  sown into the `losses` collection for the trainer to pick up.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def top2_dispatch(gates: jax.Array, capacity: int):
+    """GShard top-2 gating. gates: [n, E] float32 (softmaxed).
+
+    Returns (dispatch [n, E, C] bool-ish f32, combine [n, E, C] f32,
+    aux_loss scalar).
+    """
+    n, e = gates.shape
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=gates.dtype)
+    gates2 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=gates.dtype)
+
+    # aux load-balance loss (GShard eq.4): E * <fraction routed to e> . <mean gate of e>
+    density = mask1.mean(axis=0)
+    density_proxy = gates.mean(axis=0)
+    aux = (density * density_proxy).sum() * e
+
+    # position of each token in its expert's queue (first-choice queue
+    # fills before second-choice overflow, like the reference impl)
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1
+    mask1 = mask1 * (pos1 < capacity)
+    count1 = mask1.sum(axis=0, keepdims=True)
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + count1
+    mask2 = mask2 * (pos2 < capacity)
+
+    g1 = (gates * mask1).sum(axis=-1)
+    g2 = (gates * mask2).sum(axis=-1)
+    denom = g1 + g2
+    denom = jnp.where(denom > 0, denom, 1.0)
+    g1, g2 = g1 / denom, g2 / denom
+
+    p1 = jax.nn.one_hot(
+        (pos1 * mask1).sum(-1).astype(jnp.int32), capacity, dtype=gates.dtype
+    )
+    p2 = jax.nn.one_hot(
+        (pos2 * mask2).sum(-1).astype(jnp.int32), capacity, dtype=gates.dtype
+    )
+    combine = (
+        g1[:, None, None] * mask1[:, :, None] * p1[:, None, :]
+        + g2[:, None, None] * mask2[:, :, None] * p2[:, None, :]
+    )
+    dispatch = (combine > 0).astype(gates.dtype)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for a transformer FFN: [B, T, d] -> [B, T, d].
+
+    `mesh` enables the ep sharding constraints (None = single-device
+    semantics, same math).
+    """
+
+    num_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    mesh: Optional[Mesh] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        n = b * t
+        e = self.num_experts
+        capacity = max(1, math.ceil(n / e * self.capacity_factor))
+        tokens = x.reshape(n, d)
+
+        # router in f32 regardless of model dtype
+        logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                          name="router")(tokens.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine, aux = top2_dispatch(gates, capacity)
+        self.sow("losses", "moe_aux", aux)
+
+        w_up = self.param(
+            "w_up",
+            nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+            (e, d, self.d_ff), jnp.float32,
+        ).astype(self.dtype)
+        w_down = self.param(
+            "w_down",
+            nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+            (e, self.d_ff, d), jnp.float32,
+        ).astype(self.dtype)
+
+        def constrain_ep(arr):
+            if self.mesh is not None and self.mesh.shape.get("ep", 1) > 1:
+                spec = P("ep", *([None] * (arr.ndim - 1)))
+                return jax.lax.with_sharding_constraint(
+                    arr, NamedSharding(self.mesh, spec)
+                )
+            return arr
+
+        # [n,d] -> [E,C,d]: the all_to_all point (tokens leave their
+        # dp shard for their expert's ep shard)
+        expert_in = jnp.einsum(
+            "nec,nd->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
+        )
+        expert_in = constrain_ep(expert_in)
+        h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_up))
+        h = constrain_ep(h)
+        out_e = jnp.einsum("ecf,efd->ecd", h, w_down)
+        out_e = constrain_ep(out_e)
+        # [E,C,d] -> [n,d]: the return all_to_all + weighted combine
+        out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), out_e)
+        return out.reshape(b, t, d)
+
+
+def moe_partition_spec(path: tuple, leaf: Any, mesh: Mesh) -> Optional[P]:
+    """Sharding rule for MoE expert weights: leading E axis over `ep`
+    when it divides. Router weights replicate. Returns None when the
+    leaf is not MoE-owned (caller falls through to its tp rules)."""
+    keys = [getattr(k, "key", str(k)) for k in path]
+    if not any("w_up" == k or "w_down" == k for k in keys):
+        return None
+    ep = mesh.shape.get("ep", 1)
+    shape = getattr(leaf, "shape", ())
+    if ep > 1 and shape and shape[0] % ep == 0:
+        return P("ep", *([None] * (len(shape) - 1)))
+    return P()

@@ -24,7 +24,13 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def _spec_for(path: tuple, leaf: Any, tp: int) -> P:
+def _spec_for(path: tuple, leaf: Any, tp: int, mesh: Mesh = None) -> P:
+    if mesh is not None:
+        from .moe import moe_partition_spec
+
+        moe_spec = moe_partition_spec(path, leaf, mesh)
+        if moe_spec is not None:
+            return moe_spec
     if tp <= 1:
         return P()
     shape = getattr(leaf, "shape", ())
@@ -44,5 +50,6 @@ def partition_params(tree: Any, mesh: Mesh) -> Any:
     or optimizer state — anything whose leaves mirror param shapes)."""
     tp = mesh.shape.get("tp", 1)
     return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: NamedSharding(mesh, _spec_for(path, leaf, tp)), tree
+        lambda path, leaf: NamedSharding(mesh, _spec_for(path, leaf, tp, mesh)),
+        tree,
     )

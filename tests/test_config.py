@@ -35,7 +35,10 @@ def test_election_winner_by_rank():
 
 
 def test_mesh_spec_resolve():
-    assert MeshSpec(dp=-1, tp=2).resolve(8) == {"dp": 4, "tp": 2, "sp": 1}
+    assert MeshSpec(dp=-1, tp=2).resolve(8) == {
+        "dp": 4, "tp": 2, "sp": 1, "pp": 1, "ep": 1,
+    }
+    assert MeshSpec(dp=-1, pp=2).resolve(8)["pp"] == 2
     assert MeshSpec(dp=8, tp=1).resolve(8)["dp"] == 8
     try:
         MeshSpec(dp=3, tp=3).resolve(8)

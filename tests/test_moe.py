@@ -1,0 +1,165 @@
+"""MoE layer: gating math vs naive reference, ep sharding, LM wiring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dml_tpu.parallel.mesh import local_mesh
+from dml_tpu.parallel.moe import MoEMLP, top2_dispatch, moe_partition_spec
+from dml_tpu.parallel.sharding import partition_params
+
+
+def test_top2_dispatch_vs_naive():
+    rng = np.random.RandomState(0)
+    n, e, c = 12, 4, 12  # capacity >= n: nothing dropped
+    gates = jax.nn.softmax(jnp.asarray(rng.randn(n, e), jnp.float32))
+    dispatch, combine, aux = top2_dispatch(gates, c)
+    g = np.asarray(gates)
+    for i in range(n):
+        order = np.argsort(-g[i])
+        e1, e2 = order[0], order[1]
+        tot = g[i, e1] + g[i, e2]
+        w = np.asarray(combine)[i]
+        # each token's combine weights hit exactly its top-2 experts
+        np.testing.assert_allclose(w.sum(), 1.0, atol=1e-5)
+        np.testing.assert_allclose(w[e1].sum(), g[i, e1] / tot, atol=1e-5)
+        np.testing.assert_allclose(w[e2].sum(), g[i, e2] / tot, atol=1e-5)
+    # every dispatched (expert, slot) pair is unique
+    d = np.asarray(dispatch)
+    assert (d.sum(axis=0) <= 1.0 + 1e-6).all()
+    assert float(aux) > 0
+
+
+def test_top2_capacity_drops():
+    # all tokens prefer expert 0 -> only `capacity` fit in choice-1;
+    # the rest overflow to their second choice or drop
+    n, e, c = 8, 2, 2
+    logits = np.zeros((n, e), np.float32)
+    logits[:, 0] = 5.0
+    gates = jax.nn.softmax(jnp.asarray(logits))
+    dispatch, combine, _ = top2_dispatch(gates, c)
+    d = np.asarray(dispatch)
+    assert d[:, 0].sum() == c  # expert 0 full
+    assert d[:, 1].sum() == c  # overflow fills expert 1's queue too
+
+
+def _naive_moe(params, x, e):
+    """Per-token loop reference (top-2, assumes no capacity drops)."""
+    n, d = x.shape
+    router = np.asarray(params["router"]["kernel"])
+    w_up = np.asarray(params["w_up"])
+    w_down = np.asarray(params["w_down"])
+    gates = np.asarray(jax.nn.softmax(jnp.asarray(x @ router), axis=-1))
+    out = np.zeros_like(x)
+    for i in range(n):
+        order = np.argsort(-gates[i])
+        e1, e2 = order[0], order[1]
+        tot = gates[i, e1] + gates[i, e2]
+
+        def ffn(expert):
+            h = np.asarray(jax.nn.silu(jnp.asarray(x[i] @ w_up[expert])))
+            return h @ w_down[expert]
+
+        out[i] = (gates[i, e1] * ffn(e1) + gates[i, e2] * ffn(e2)) / tot
+    return out
+
+
+def test_moe_mlp_matches_naive_reference():
+    b, t, d, e = 2, 6, 8, 4
+    model = MoEMLP(num_experts=e, d_ff=16, capacity_factor=8.0,
+                   dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(1).randn(b, t, d), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y = model.apply(variables, x)
+    assert y.shape == (b, t, d)
+    ref = _naive_moe(variables["params"], np.asarray(x).reshape(-1, d), e)
+    np.testing.assert_allclose(
+        np.asarray(y).reshape(-1, d), ref, atol=1e-4
+    )
+
+
+def test_moe_aux_loss_sown():
+    model = MoEMLP(num_experts=4, d_ff=16, dtype=jnp.float32)
+    x = jnp.zeros((1, 8, 8), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y, updated = model.apply(
+        {"params": variables["params"]}, x, mutable=["losses"]
+    )
+    (aux,) = updated["losses"]["moe_aux"]
+    assert np.isfinite(float(aux))
+
+
+def test_moe_ep_sharded_matches_unsharded():
+    mesh = local_mesh(dp=2, ep=4)
+    b, t, d, e = 4, 8, 8, 4
+    model_plain = MoEMLP(num_experts=e, d_ff=16, capacity_factor=8.0,
+                         dtype=jnp.float32)
+    model_ep = MoEMLP(num_experts=e, d_ff=16, capacity_factor=8.0,
+                      mesh=mesh, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(2).randn(b, t, d), jnp.float32)
+    variables = model_plain.init(jax.random.PRNGKey(0), x)
+    ref = model_plain.apply(variables, x)
+
+    shardings = partition_params(variables["params"], mesh)
+    # expert weights shard over ep, router replicates
+    assert "ep" in str(shardings["w_up"].spec)
+    assert "ep" not in str(shardings["router"]["kernel"].spec)
+    sharded_vars = {"params": jax.device_put(variables["params"], shardings)}
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    y = jax.jit(
+        model_ep.apply,
+        in_shardings=(None, NamedSharding(mesh, P("dp"))),
+    )(sharded_vars, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-4)
+
+
+def test_moe_gradients_flow():
+    model = MoEMLP(num_experts=4, d_ff=16, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 4, 8), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    def loss(params):
+        y = model.apply({"params": params}, x)
+        return jnp.mean(y**2)
+
+    grads = jax.grad(loss)(variables["params"])
+    for name in ("w_up", "w_down"):
+        assert float(jnp.abs(grads[name]).max()) > 0
+    assert float(jnp.abs(grads["router"]["kernel"]).max()) > 0
+
+
+def test_longcontext_lm_moe_aux_in_objective():
+    from dml_tpu.parallel.long_context import LongContextLM, lm_loss
+
+    mesh = local_mesh(dp=2, sp=2, ep=2)
+    kw = dict(seq_len=32, vocab_size=16, d_model=16, n_heads=2, n_layers=2,
+              d_ff=32, num_experts=4, moe_every=2)
+    lm = LongContextLM(mesh, **kw)
+    # mesh is forwarded so MoEMLP's ep constraints are live
+    assert lm.model.mesh is mesh
+    tokens = np.random.RandomState(0).randint(0, 16, (2, 32)).astype(np.int32)
+    # the train objective includes the sown aux term: it differs from
+    # the bare lm_loss of the same params/tokens
+    logits = lm.forward(lm.state["params"], jnp.asarray(tokens))
+    bare = float(lm_loss(logits, jnp.asarray(tokens)))
+    stepped = lm.train_step(tokens)
+    assert np.isfinite(stepped)
+    assert abs(stepped - bare) > 1e-6  # aux term present (weight 1e-2)
+
+
+def test_transformer_lm_with_moe():
+    from dml_tpu.models.transformer import TransformerLM
+
+    lm = TransformerLM(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                       d_ff=64, num_experts=4, moe_every=2,
+                       dtype=jnp.float32)
+    tokens = jnp.asarray(np.random.RandomState(4).randint(0, 64, (2, 8)))
+    variables = lm.init(jax.random.PRNGKey(0), tokens)
+    # block_1 (every 2nd) is MoE, block_0 dense
+    assert "moe" in variables["params"]["block_1"]
+    assert "up" in variables["params"]["block_0"]
+    logits = lm.apply(variables, tokens)
+    assert logits.shape == (2, 8, 64)
+    assert np.isfinite(np.asarray(logits)).all()
