@@ -56,6 +56,8 @@ job commands (ML inference):
   predict-locally <model> <f...>    single-node inference on local files
   save-model <model>                publish weights into the store
   load-model <model> [version]      load published weights for serving
+  checkpoint-jobs                   snapshot scheduler state into the store
+  restore-jobs [version] [force]    restore scheduler state (coordinator)
   C1                                per-model query counts + rates
   C2 <model>                        processing-time stats (mean/percentiles)
   C3 <model> <batch_size>           set batch size cluster-wide
@@ -179,6 +181,13 @@ class NodeApp:
         elif cmd == "load-model" and a:
             await j.load_model_weights(a[0], int(a[1]) if len(a) > 1 else None)
             print("ok loaded")
+        elif cmd == "checkpoint-jobs":
+            r = await j.checkpoint_jobs()
+            print(f"ok version={r['version']} replicas={r['replicas']}")
+        elif cmd == "restore-jobs":
+            ver = next((int(x) for x in a if x.isdigit()), None)
+            r = await j.restore_jobs(ver, force="force" in a)
+            print(f"ok jobs={r['jobs']} queued_batches={r['queued_batches']}")
         elif cmd == "profile" and len(a) == 1:
             from .observability import SPANS
 

@@ -40,6 +40,7 @@ import asyncio
 import logging
 import os
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..config import ClusterSpec, NodeId, StoreConfig
@@ -241,6 +242,48 @@ class StoreService:
             except Exception as e:  # try the next replica
                 last_err = e
         raise FileNotFoundError(f"{sdfs_name}: no replica served it ({last_err})")
+
+    async def put_bytes(
+        self, sdfs_name: str, data: bytes, timeout: float = 60.0
+    ) -> Dict[str, Any]:
+        """PUT an in-memory blob: spill to a unique temp file under the
+        download dir, upload, clean up. The one canonical home for the
+        tmp-file + put + unlink pattern (weights publishing, scheduler
+        checkpoints)."""
+        tmp = os.path.join(
+            self.cfg.download_path(), f".putbytes_{uuid.uuid4().hex}"
+        )
+        os.makedirs(os.path.dirname(tmp), exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(data)
+        try:
+            return await self.put(tmp, sdfs_name, timeout=timeout)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    async def get_bytes(
+        self,
+        sdfs_name: str,
+        version: Optional[int] = None,
+        timeout: float = 60.0,
+    ) -> bytes:
+        """GET a file's contents into memory (inverse of put_bytes)."""
+        dest = os.path.join(
+            self.cfg.download_path(), f".getbytes_{uuid.uuid4().hex}"
+        )
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        await self.get(sdfs_name, dest, version=version, timeout=timeout)
+        try:
+            with open(dest, "rb") as f:
+                return f.read()
+        finally:
+            try:
+                os.unlink(dest)
+            except OSError:
+                pass
 
     async def get_versions(
         self, sdfs_name: str, count: int, local_path: str, timeout: float = 60.0

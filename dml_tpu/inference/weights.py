@@ -10,8 +10,6 @@ parallel.Trainer flow through the same path back into serving.
 
 from __future__ import annotations
 
-import os
-import uuid
 from typing import Any, Dict, Optional
 
 from ..cluster.store_service import StoreService
@@ -32,23 +30,9 @@ async def publish_weights(
 ) -> Dict[str, Any]:
     """Serialize + PUT a model's variables; returns the PUT reply
     (version + replica set)."""
-    data = variables_to_bytes(variables)
-    # unique temp name: concurrent publishes of the same model must not
-    # share a path (one's cleanup could delete the other's upload)
-    tmp = os.path.join(
-        store.cfg.download_path(),
-        f".pub_{uuid.uuid4().hex}_{weights_name(model_name)}",
+    return await store.put_bytes(
+        weights_name(model_name), variables_to_bytes(variables)
     )
-    os.makedirs(os.path.dirname(tmp), exist_ok=True)
-    with open(tmp, "wb") as f:
-        f.write(data)
-    try:
-        return await store.put(tmp, weights_name(model_name))
-    finally:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
 
 
 async def fetch_weights(
@@ -62,21 +46,7 @@ async def fetch_weights(
     import jax.numpy as jnp
 
     spec = get_model(model_name)
-    # unique temp name (see publish_weights) + cleanup after read
-    dest = os.path.join(
-        store.cfg.download_path(),
-        f".fetch_{uuid.uuid4().hex}_{weights_name(model_name)}",
-    )
-    os.makedirs(os.path.dirname(dest), exist_ok=True)
-    await store.get(weights_name(model_name), dest, version=version)
-    try:
-        with open(dest, "rb") as f:
-            data = f.read()
-    finally:
-        try:
-            os.unlink(dest)
-        except OSError:
-            pass
+    data = await store.get_bytes(weights_name(model_name), version=version)
     # small init image where param shapes allow it (spatial_invariant
     # CNNs); ViT-style models size pos_embed by patch count, so their
     # template must be built at the deployment input size

@@ -488,3 +488,73 @@ class Scheduler:
 
     def all_queued_batches(self) -> List[Batch]:
         return [b for q in self.queues.values() for b in q]
+
+    # ------------------------------------------------------------------
+    # snapshot / restore (net-new vs the reference, whose scheduler
+    # state survives only leader failover via the hot-standby relays —
+    # SURVEY §5 "Checkpoint/resume: ... not via disk". This makes the
+    # job pipeline survive a FULL cluster restart: the coordinator
+    # snapshots to the replicated store and a fresh leader restores.)
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able dump of all scheduling state. In-flight batches
+        are folded back into their queue fronts (their workers won't
+        exist after a restart — same semantics as worker failure)."""
+        def batch_dict(b: Batch) -> Dict[str, Any]:
+            return {
+                "job_id": b.job_id, "batch_id": b.batch_id,
+                "model": b.model, "files": list(b.files),
+                "replicas": {f: list(r) for f, r in b.replicas.items()},
+                "versions": dict(b.versions),
+            }
+
+        queues: Dict[str, List[Dict[str, Any]]] = {
+            m: [batch_dict(b) for b in q] for m, q in self.queues.items() if q
+        }
+        for worker, b in self.in_progress.items():
+            queues.setdefault(b.model, []).insert(0, batch_dict(b))
+        return {
+            "job_counter": self._job_counter,
+            "queues": queues,
+            "jobs": {
+                str(j.job_id): {
+                    "job_id": j.job_id, "model": j.model,
+                    "requester": j.requester,
+                    "total_queries": j.total_queries,
+                    "pending_batches": j.pending_batches,
+                    "done": j.done,
+                    "completed_batches": sorted(j.completed_batches),
+                }
+                for j in self.jobs.values()
+            },
+            "query_counts": dict(self.query_counts),
+            "costs": {
+                m: {
+                    "load_time": c.load_time, "first_query": c.first_query,
+                    "per_query": c.per_query,
+                    "download_time": c.download_time,
+                    "batch_size": c.batch_size, "resident": c.resident,
+                }
+                for m, c in self.costs.items()
+            },
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        """Load a snapshot(). Replaces queues/jobs/counters; metrics
+        samples start fresh (rates are meaningless across a restart)."""
+        self._job_counter = max(self._job_counter, int(snap["job_counter"]))
+        for m, c in snap.get("costs", {}).items():
+            self.costs[m] = ModelCost(**c)
+        self.queues = {
+            m: deque(Batch(**b) for b in batches)
+            for m, batches in snap.get("queues", {}).items()
+        }
+        self.in_progress = {}
+        self.jobs = {}
+        for j in snap.get("jobs", {}).values():
+            completed = set(j.pop("completed_batches", []))
+            state = JobState(**j)
+            state.completed_batches = completed
+            self.jobs[state.job_id] = state
+        self.query_counts = dict(snap.get("query_counts", {}))

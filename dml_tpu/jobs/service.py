@@ -33,6 +33,7 @@ import json
 import logging
 import os
 import time
+import uuid
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ..config import NodeId
@@ -767,6 +768,50 @@ class JobService:
         # engine.load_model keeps the serving batch size across a
         # reload (a C3 set_batch_size survives a weight rollout)
         await asyncio.to_thread(eng.load_model, name, variables)
+
+    JOBS_CKPT_NAME = "coordinator_jobs.ckpt"
+
+    async def checkpoint_jobs(self) -> Dict[str, Any]:
+        """Coordinator-only: snapshot the scheduler (queues, in-flight
+        folded to queue fronts, job states, counters, measured costs)
+        into the replicated store. Survives a FULL cluster restart —
+        the hot-standby relay (reference worker.py:887-919) only
+        survives single-leader failover."""
+        if self._me != self.node.leader_unique:
+            raise RuntimeError("checkpoint-jobs runs on the coordinator")
+        snap = self.scheduler.snapshot()
+        return await self.store.put_bytes(
+            self.JOBS_CKPT_NAME, json.dumps(snap).encode()
+        )
+
+    async def restore_jobs(
+        self, version: Optional[int] = None, force: bool = False
+    ) -> Dict[str, Any]:
+        """Coordinator-only: restore a checkpoint_jobs() snapshot and
+        resume scheduling the recovered queues.
+
+        Refuses while jobs are live unless `force=True`: restore()
+        replaces scheduler state wholesale, so a job submitted after
+        the snapshot would vanish and its client would hang."""
+        if self._me != self.node.leader_unique:
+            raise RuntimeError("restore-jobs runs on the coordinator")
+        if self.scheduler.jobs and not force:
+            raise RuntimeError(
+                f"{len(self.scheduler.jobs)} job(s) in flight would be "
+                "dropped by the restore; pass force to override"
+            )
+        snap = json.loads(
+            await self.store.get_bytes(self.JOBS_CKPT_NAME, version=version)
+        )
+        self.scheduler.restore(snap)
+        stats = {
+            "jobs": len(self.scheduler.jobs),
+            "queued_batches": sum(
+                len(q) for q in self.scheduler.queues.values()
+            ),
+        }
+        self._run_schedule()
+        return stats
 
     def _ensure_engine(self):
         if self._engine is None:

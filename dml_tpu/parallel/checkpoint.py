@@ -1,0 +1,119 @@
+"""Training checkpoint/resume.
+
+The reference has no checkpointing of any kind (SURVEY §5: "No model
+or job checkpointing"); its only persistence is SDFS files on disk.
+Here training state — params, batch_stats, optimizer state, step —
+round-trips through flax msgpack bytes, so the same blob can go to
+local disk (this module) or into the 4-way-replicated store
+(inference/weights.py `publish_weights` uses the identical
+serialization), and a restore lands the leaves back on device with
+the trainer's sharding layout (device_put with the step's
+NamedShardings — each chip reloads only its shard's bytes).
+
+Layout: `<dir>/step_<N>.msgpack` plus `<dir>/manifest.json`
+({"steps": [...]}); `keep` bounds retained checkpoints. Writes are
+atomic (tmp + rename) so a crash mid-save never corrupts the latest
+good checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+def _state_to_bytes(state: Any) -> bytes:
+    from flax import serialization
+
+    return serialization.to_bytes(
+        jax.tree_util.tree_map(np.asarray, state)
+    )
+
+
+def _state_from_bytes(data: bytes, like: Any) -> Any:
+    from flax import serialization
+
+    return serialization.from_bytes(like, data)
+
+
+class CheckpointManager:
+    """Step-indexed checkpoints in one directory.
+
+    >>> mgr = CheckpointManager(dir, keep=3)
+    >>> mgr.save(step=100, state)
+    >>> state = mgr.restore(like=template)          # latest
+    >>> state = mgr.restore(like=template, step=50) # pinned
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.dir = os.path.abspath(os.path.expanduser(directory))
+        self.keep = keep
+        os.makedirs(self.dir, exist_ok=True)
+
+    # ---- manifest ----
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, "manifest.json")
+
+    def steps(self) -> List[int]:
+        try:
+            with open(self._manifest_path()) as f:
+                return sorted(json.load(f)["steps"])
+        except (OSError, ValueError, KeyError):
+            return []
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def _write_manifest(self, steps: List[int]) -> None:
+        tmp = self._manifest_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"steps": sorted(steps)}, f)
+        os.replace(tmp, self._manifest_path())
+
+    def _blob_path(self, step: int) -> str:
+        return os.path.join(self.dir, f"step_{step}.msgpack")
+
+    # ---- save / restore ----
+
+    def save(self, step: int, state: Any) -> str:
+        """Atomic write + manifest update + retention sweep."""
+        path = self._blob_path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_state_to_bytes(state))
+        os.replace(tmp, path)
+        steps = [s for s in self.steps() if s != step] + [step]
+        steps.sort()
+        evicted, steps = steps[: -self.keep], steps[-self.keep :]
+        self._write_manifest(steps)
+        for s in evicted:
+            try:
+                os.unlink(self._blob_path(s))
+            except OSError:
+                pass
+        return path
+
+    def restore(
+        self,
+        like: Any,
+        step: Optional[int] = None,
+        shardings: Any = None,
+    ) -> Any:
+        """Load a checkpoint into `like`'s tree structure; when
+        `shardings` (a matching pytree of NamedShardings) is given the
+        leaves go straight to their device placement."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.dir}")
+        with open(self._blob_path(step), "rb") as f:
+            state = _state_from_bytes(f.read(), like)
+        if shardings is not None:
+            state = jax.device_put(state, shardings)
+        return state

@@ -177,3 +177,17 @@ class LongContextLM:
     def train_step(self, tokens: np.ndarray) -> float:
         self.state, loss = self._train_step(self.state, jnp.asarray(tokens))
         return float(jax.device_get(loss))
+
+    def save_checkpoint(self, directory: str, keep: int = 3) -> str:
+        from .checkpoint import CheckpointManager
+
+        step = int(jax.device_get(self.state["step"]))
+        return CheckpointManager(directory, keep=keep).save(step, self.state)
+
+    def restore_checkpoint(self, directory: str, step=None) -> int:
+        from .checkpoint import CheckpointManager
+
+        self.state = CheckpointManager(directory).restore(
+            jax.device_get(self.state), step=step, shardings=self._state_sh
+        )
+        return int(jax.device_get(self.state["step"]))

@@ -86,6 +86,11 @@ class MoEMLP(nn.Module):
     num_experts: int
     d_ff: int
     capacity_factor: float = 1.25
+    # routing group size (GShard "groups"): dispatch/combine tensors
+    # are [g, E, C] per group with C ~ g/E, so routing cost stays
+    # LINEAR in total tokens instead of quadratic. Groups also align
+    # with the dp sharding of the batch axis, keeping routing local.
+    group_size: int = 1024
     mesh: Optional[Mesh] = None
     dtype: Any = jnp.bfloat16
 
@@ -94,14 +99,23 @@ class MoEMLP(nn.Module):
         b, t, d = x.shape
         n = b * t
         e = self.num_experts
-        capacity = max(1, math.ceil(n / e * self.capacity_factor))
-        tokens = x.reshape(n, d)
+        # G groups of g tokens each; largest divisor of n that keeps
+        # g <= ~group_size (n is static, so this runs at trace time)
+        groups = max(1, n // self.group_size)
+        while n % groups:
+            groups -= 1
+        g = n // groups
+        capacity = max(1, math.ceil(g / e * self.capacity_factor))
+        tokens = x.reshape(groups, g, d)
 
         # router in f32 regardless of model dtype
         logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
                           name="router")(tokens.astype(jnp.float32))
-        gates = jax.nn.softmax(logits, axis=-1)
-        dispatch, combine, aux = top2_dispatch(gates, capacity)
+        gates = jax.nn.softmax(logits, axis=-1)  # [G, g, E]
+        dispatch, combine, aux = jax.vmap(
+            lambda gg: top2_dispatch(gg, capacity)
+        )(gates)
+        aux = aux.mean()
         self.sow("losses", "moe_aux", aux)
 
         w_up = self.param(
@@ -116,25 +130,27 @@ class MoEMLP(nn.Module):
         ).astype(self.dtype)
 
         def constrain_ep(arr):
+            # expert axis is dim 1 ([G, E, ...]); groups ride dp
             if self.mesh is not None and self.mesh.shape.get("ep", 1) > 1:
-                spec = P("ep", *([None] * (arr.ndim - 1)))
+                spec = P(None, "ep", *([None] * (arr.ndim - 2)))
                 return jax.lax.with_sharding_constraint(
                     arr, NamedSharding(self.mesh, spec)
                 )
             return arr
 
-        # [n,d] -> [E,C,d]: the all_to_all point (tokens leave their
-        # dp shard for their expert's ep shard)
+        # [G,g,d] -> [G,E,C,d]: the all_to_all point (tokens leave
+        # their dp shard for their expert's ep shard)
         expert_in = jnp.einsum(
-            "nec,nd->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
+            "gnec,gnd->gecd",
+            dispatch.astype(self.dtype), tokens.astype(self.dtype),
         )
         expert_in = constrain_ep(expert_in)
-        h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_up))
+        h = nn.silu(jnp.einsum("gecd,edf->gecf", expert_in, w_up))
         h = constrain_ep(h)
-        out_e = jnp.einsum("ecf,efd->ecd", h, w_down)
+        out_e = jnp.einsum("gecf,efd->gecd", h, w_down)
         out_e = constrain_ep(out_e)
-        # [E,C,d] -> [n,d]: the return all_to_all + weighted combine
-        out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), out_e)
+        # [G,E,C,d] -> [G,g,d]: the return all_to_all + weighted combine
+        out = jnp.einsum("gnec,gecd->gnd", combine.astype(self.dtype), out_e)
         return out.reshape(b, t, d)
 
 

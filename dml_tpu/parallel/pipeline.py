@@ -94,7 +94,8 @@ def pipeline_apply(
         # pp-sharded axis of size 1
         params = jax.tree_util.tree_map(lambda p: p[0], params)
         stage = jax.lax.axis_index(axis)
-        zero = jnp.zeros((mb, *xm_local.shape[2:]), xm_local.dtype)
+        # xm_local is [M, mb_local, ...] — mb_local may be a dp shard
+        zero = jnp.zeros(xm_local.shape[1:], xm_local.dtype)
 
         def tick(carry, t):
             state = carry  # activation arriving from the previous stage
@@ -119,14 +120,19 @@ def pipeline_apply(
         out = out.at[jnp.clip(idxs, 0, m - 1)].add(emits)
         return jax.lax.psum(out, axis)
 
+    # the microbatch's example dim shards over dp when it divides —
+    # each dp row then pipelines its own slice of the batch (pp and dp
+    # compose); otherwise replicate (identical redundant compute)
+    dp = mesh.shape.get("dp", 1)
+    x_spec = P(None, "dp") if dp > 1 and mb % dp == 0 else P()
     ym = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(
             jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
-            P(),  # microbatches replicated; stage 0 injects
+            x_spec,  # stage 0 injects its dp-row's microbatch slice
         ),
-        out_specs=P(),
+        out_specs=x_spec,
         check_vma=False,
     )(stacked_params, xm)
     return ym.reshape(b, *x.shape[1:])

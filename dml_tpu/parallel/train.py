@@ -149,6 +149,28 @@ class Trainer:
     def params(self):
         return self.state["params"]
 
+    def save_checkpoint(self, directory: str, keep: int = 3) -> str:
+        """Write the full training state (params, batch_stats,
+        opt_state, step) — resume-exact, not just weights."""
+        from .checkpoint import CheckpointManager
+
+        step = int(jax.device_get(self.state["step"]))
+        return CheckpointManager(directory, keep=keep).save(step, self.state)
+
+    def restore_checkpoint(
+        self, directory: str, step: Optional[int] = None
+    ) -> int:
+        """Load latest (or pinned) checkpoint back into the trainer's
+        sharded device layout; returns the restored step."""
+        from .checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(directory)
+        like = jax.device_get(self.state)
+        self.state = mgr.restore(
+            like, step=step, shardings=self._state_shardings
+        )
+        return int(jax.device_get(self.state["step"]))
+
     def export_variables(self) -> Dict[str, Any]:
         """Gather a replicated copy, e.g. to hand to the inference
         engine or checkpoint through the replicated store."""

@@ -333,3 +333,56 @@ async def test_coordinator_failover_resumes_from_shadow(tmp_path):
         new_coord = sim.jobs[standby]
         assert new_coord.node.is_leader
         assert new_coord.scheduler.job_state(job_id).done
+
+
+async def test_jobs_checkpoint_restore_through_store(tmp_path):
+    """checkpoint-jobs -> (simulated scheduler wipe) -> restore-jobs:
+    the snapshot in the replicated store carries everything needed to
+    finish the job — net-new vs the reference, whose scheduler state
+    survives only via the live standby relay (SURVEY §5)."""
+    async with cluster(4, tmp_path, 22700) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 3)
+        client = sim.jobs[client_u]
+
+        # hold every backend so no batch can complete yet
+        gate = asyncio.Event()
+        for be in sim.backends.values():
+            be.gate = gate
+
+        job_id = await client.submit_job("ResNet50", 96)  # 3 batches
+        coord = sim.coordinator_jobs()
+        await sim.wait_for(
+            lambda: job_id in coord.scheduler.jobs, what="job intake"
+        )
+        ck = await coord.checkpoint_jobs()
+        assert ck["replicas"]
+
+        # restore refuses while the job is live (it would drop it)
+        try:
+            await coord.restore_jobs()
+            assert False, "expected RuntimeError without force"
+        except RuntimeError:
+            pass
+
+        # simulate a coordinator restart losing all scheduler state
+        coord.scheduler.queues.clear()
+        coord.scheduler.in_progress.clear()
+        coord.scheduler.jobs.clear()
+
+        r = await coord.restore_jobs()
+        assert r["jobs"] == 1
+        assert r["queued_batches"] == 3  # in-flight folded back to queue
+
+        gate.set()
+        done = await client.wait_job(job_id, timeout=30.0)
+        assert done["total_queries"] == 96
+        # non-coordinator refuses the verbs
+        other = sim.jobs[client_u]
+        if other is not coord:
+            try:
+                await other.checkpoint_jobs()
+                assert False, "expected RuntimeError"
+            except RuntimeError:
+                pass

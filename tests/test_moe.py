@@ -79,6 +79,19 @@ def test_moe_mlp_matches_naive_reference():
     )
 
 
+def test_moe_grouped_routing_matches_naive():
+    # group_size < n forces multiple routing groups (G=3 here); with
+    # ample capacity the result must equal ungrouped top-2 routing
+    b, t, d, e = 2, 6, 8, 4
+    model = MoEMLP(num_experts=e, d_ff=16, capacity_factor=16.0,
+                   group_size=4, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(7).randn(b, t, d), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y = model.apply(variables, x)
+    ref = _naive_moe(variables["params"], np.asarray(x).reshape(-1, d), e)
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, d), ref, atol=1e-4)
+
+
 def test_moe_aux_loss_sown():
     model = MoEMLP(num_experts=4, d_ff=16, dtype=jnp.float32)
     x = jnp.zeros((1, 8, 8), jnp.float32)
