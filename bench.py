@@ -16,6 +16,12 @@ import time
 
 
 def main() -> None:
+    import os
+
+    # persistent XLA compile cache: re-runs skip the ~30s ResNet compile
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dml_tpu_jax_cache_tpu")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
     import jax
     import numpy as np
 

@@ -82,6 +82,9 @@ class MsgType(enum.IntEnum):
     WORKER_TASK_FAIL = 71
     JOB_STATUS_REQUEST = 72
     JOB_STATUS_ACK = 73
+    # coordinator restored a scheduler snapshot: tells the standby to
+    # pull the same pinned version from the store so its shadow matches
+    JOBS_RESTORE_RELAY = 74
 
 
 @dataclass(frozen=True)

@@ -201,7 +201,19 @@ class InferenceEngine:
         if n == 0:
             return np.zeros((0, lm.num_classes), np.float32)
         bs = lm.batch_size
+        # JAX's async dispatch pipelines the chunks: forwards are
+        # enqueued ahead of the blocking host readbacks (one sync per
+        # chunk would serialize transfer and compute). The in-flight
+        # window is bounded so device memory stays O(window), not O(n):
+        # each pending chunk pins its input (+output) buffers in HBM.
+        window = 4
+        pending: List[Any] = []
         out: List[np.ndarray] = []
+
+        def drain_one() -> None:
+            probs, valid = pending.pop(0)
+            out.append(np.asarray(probs[:valid]))
+
         for start in range(0, n, bs):
             chunk = images_u8[start : start + bs]
             pad = bs - chunk.shape[0]
@@ -210,7 +222,11 @@ class InferenceEngine:
                     [chunk, np.zeros((pad, *chunk.shape[1:]), np.uint8)]
                 )
             probs = lm.forward(lm.variables, jax.device_put(chunk, self.device))
-            out.append(np.asarray(probs[: bs - pad if pad else bs]))
+            pending.append((probs, bs - pad))
+            if len(pending) >= window:
+                drain_one()
+        while pending:
+            drain_one()
         return np.concatenate(out)[:n]
 
     def infer_files(self, name: str, files: Sequence[str], top: int = 5) -> InferenceResult:

@@ -285,6 +285,7 @@ class JobService:
         n.register(MsgType.SUBMIT_JOB_REQUEST, self._h_submit_job)
         n.register(MsgType.SUBMIT_JOB_REQUEST_SUCCESS, self._h_job_success)
         n.register(MsgType.SUBMIT_JOB_RELAY, self._h_submit_relay)
+        n.register(MsgType.JOBS_RESTORE_RELAY, self._h_restore_relay)
         n.register(MsgType.WORKER_TASK_REQUEST, self._h_task_request)
         n.register(MsgType.WORKER_TASK_REQUEST_ACK, self._h_task_ack)
         n.register(MsgType.WORKER_TASK_FAIL, self._h_task_fail)
@@ -601,6 +602,36 @@ class JobService:
             int(msg.data.get("n_images", 0)),
         )
 
+    async def _h_restore_relay(self, msg: Message, addr) -> None:
+        """Standby side of restore-jobs: pull the same pinned snapshot
+        from the store and make it the shadow state, so a failover
+        right after a restore loses nothing. The fetch runs as a task —
+        awaiting a store GET inline would block this node's receive
+        loop on a reply that loop itself must process (self-deadlock
+        until timeout, plus a suspicion storm from unanswered pings)."""
+        if msg.sender != self.node.leader_unique or self.node.is_leader:
+            return
+        asyncio.create_task(
+            self._restore_shadow(int(msg.data["version"])),
+            name=f"{self._me}-shadow-restore",
+        )
+
+    async def _restore_shadow(self, version: int) -> None:
+        try:
+            snap = json.loads(
+                await self.store.get_bytes(self.JOBS_CKPT_NAME, version=version)
+            )
+        except Exception:
+            log.exception("%s: standby snapshot restore failed", self._me)
+            return
+        if self.node.is_leader:  # promoted while fetching: don't clobber
+            return
+        self.scheduler.restore(snap)
+        log.info(
+            "%s: shadow restored from snapshot v%d (%d jobs)",
+            self._me, version, len(self.scheduler.jobs),
+        )
+
     # ------------------------------------------------------------------
     # worker side (reference handle_worker_task_request,
     # worker.py:518-537, 940-962)
@@ -800,6 +831,10 @@ class JobService:
                 f"{len(self.scheduler.jobs)} job(s) in flight would be "
                 "dropped by the restore; pass force to override"
             )
+        if version is None:
+            # pin the version now so the standby relay below restores
+            # the exact same snapshot
+            version = self.store.metadata.latest_version(self.JOBS_CKPT_NAME)
         snap = json.loads(
             await self.store.get_bytes(self.JOBS_CKPT_NAME, version=version)
         )
@@ -810,6 +845,14 @@ class JobService:
                 len(q) for q in self.scheduler.queues.values()
             ),
         }
+        # bring the hot-standby's shadow up to the restored state —
+        # without this, a failover right after a restore would promote
+        # an empty shadow and drop every restored job
+        sb = self.store.standby_node()
+        if sb is not None and sb.unique_name != self._me:
+            self.node.send(
+                sb, MsgType.JOBS_RESTORE_RELAY, {"version": version}
+            )
         self._run_schedule()
         return stats
 

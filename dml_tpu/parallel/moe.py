@@ -99,11 +99,14 @@ class MoEMLP(nn.Module):
         b, t, d = x.shape
         n = b * t
         e = self.num_experts
-        # G groups of g tokens each; largest divisor of n that keeps
-        # g <= ~group_size (n is static, so this runs at trace time)
-        groups = max(1, n // self.group_size)
+        # G groups of g tokens each: smallest divisor of n with
+        # G >= n/group_size, so g = n/G <= group_size and routing cost
+        # stays bounded per group (n is static => trace-time search).
+        # Awkward n (sparse divisors) yields more, smaller groups —
+        # never one giant group.
+        groups = max(1, -(-n // self.group_size))
         while n % groups:
-            groups -= 1
+            groups += 1
         g = n // groups
         capacity = max(1, math.ceil(g / e * self.capacity_factor))
         tokens = x.reshape(groups, g, d)
@@ -130,9 +133,12 @@ class MoEMLP(nn.Module):
         ).astype(self.dtype)
 
         def constrain_ep(arr):
-            # expert axis is dim 1 ([G, E, ...]); groups ride dp
+            # [G, E, ...]: groups ride dp (GSPMD pads uneven cases),
+            # experts ride ep — P(None, 'ep') here would force an
+            # all-gather of the groups and redundant compute per dp row
             if self.mesh is not None and self.mesh.shape.get("ep", 1) > 1:
-                spec = P(None, "ep", *([None] * (arr.ndim - 2)))
+                dp_axis = "dp" if self.mesh.shape.get("dp", 1) > 1 else None
+                spec = P(dp_axis, "ep", *([None] * (arr.ndim - 2)))
                 return jax.lax.with_sharding_constraint(
                     arr, NamedSharding(self.mesh, spec)
                 )

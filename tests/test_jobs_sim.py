@@ -386,3 +386,49 @@ async def test_jobs_checkpoint_restore_through_store(tmp_path):
                 assert False, "expected RuntimeError"
             except RuntimeError:
                 pass
+
+
+async def test_restore_relays_to_standby_failover(tmp_path):
+    """After restore-jobs, the standby's shadow matches the restored
+    snapshot, so a coordinator death right after a restore still
+    finishes the job (review finding: restore used to leave the shadow
+    empty and failover dropped every restored job)."""
+    async with cluster(4, tmp_path, 22800) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 3)
+        client = sim.jobs[client_u]
+
+        gate = asyncio.Event()
+        for be in sim.backends.values():
+            be.gate = gate
+
+        job_id = await client.submit_job("ResNet50", 96)  # 3 batches
+        coord = sim.coordinator_jobs()
+        coord_u = next(iter(sim.nodes.values())).leader_unique
+        standby_u = sim.stores[coord_u].standby_node().unique_name
+        await sim.wait_for(
+            lambda: job_id in coord.scheduler.jobs, what="job intake"
+        )
+        await coord.checkpoint_jobs()
+
+        coord.scheduler.queues.clear()
+        coord.scheduler.in_progress.clear()
+        coord.scheduler.jobs.clear()
+        # also wipe the standby's relay-built shadow: the restore relay
+        # must rebuild it from the store snapshot
+        sb_jobs = sim.jobs[standby_u]
+        sb_jobs.scheduler.queues.clear()
+        sb_jobs.scheduler.jobs.clear()
+
+        await coord.restore_jobs()
+        await sim.wait_for(
+            lambda: job_id in sb_jobs.scheduler.jobs,
+            what="standby shadow rebuilt from snapshot",
+        )
+
+        await sim.stop_node(coord_u)
+        gate.set()
+        done = await client.wait_job(job_id, timeout=30.0)
+        assert done["total_queries"] == 96
+        assert sb_jobs.node.is_leader
