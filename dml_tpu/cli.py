@@ -64,6 +64,8 @@ job commands (ML inference):
   C5                                current worker->batch assignments
 observability:
   profile spans                     wall-clock span stats (store/job hot paths)
+  profile trace start [dir]         capture a jax.profiler (XLA) trace
+  profile trace stop                stop + write the trace
 other: help, quit
 """
 
@@ -188,13 +190,25 @@ class NodeApp:
             ver = next((int(x) for x in a if x.isdigit()), None)
             r = await j.restore_jobs(ver, force="force" in a)
             print(f"ok jobs={r['jobs']} queued_batches={r['queued_batches']}")
-        elif cmd == "profile" and len(a) == 1:
+        elif cmd == "profile" and a:
             from .observability import SPANS
 
             if a[0] == "spans":
                 print(json.dumps(SPANS.summary(), indent=2))
+            elif a[0] == "trace" and len(a) >= 2 and a[1] == "start":
+                import jax
+
+                logdir = a[2] if len(a) > 2 else "/tmp/dml_tpu_trace"
+                jax.profiler.start_trace(logdir)
+                print(f"tracing XLA to {logdir} ('profile trace stop' to end)")
+            elif a[0] == "trace" and len(a) >= 2 and a[1] == "stop":
+                import jax
+
+                jax.profiler.stop_trace()
+                print("trace written (view with TensorBoard profile/Perfetto)")
             else:
-                print("usage: profile spans")
+                print("usage: profile spans | profile trace start [dir] | "
+                      "profile trace stop")
         elif cmd == "C1":
             for m, stats in j.c1_stats().items():
                 print(f"{m}: total={stats['total_queries']:.0f} "

@@ -85,6 +85,9 @@ class MsgType(enum.IntEnum):
     # coordinator restored a scheduler snapshot: tells the standby to
     # pull the same pinned version from the store so its shadow matches
     JOBS_RESTORE_RELAY = 74
+    # standby ack (echoes rid) once its shadow restore completed;
+    # unregistered on purpose: the dispatcher's rid fallback resolves it
+    JOBS_RESTORE_RELAY_ACK = 75
 
 
 @dataclass(frozen=True)

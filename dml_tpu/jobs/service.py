@@ -91,6 +91,12 @@ class JobService:
         )
         # submit idempotency tokens -> job id
         self._submit_tokens: BoundedDict = BoundedDict(1000)
+        # standby shadow-restore state: relays arriving while a
+        # snapshot fetch is in flight are buffered and replayed after
+        # restore(); _shadow_version dedups relay retries
+        self._shadow_restoring = False
+        self._buffered_relays: List[Tuple[Any, Message]] = []
+        self._shadow_version: Optional[int] = None
         self._register()
         node.on_node_failed_cbs.append(self._on_node_failed)
         node.on_became_leader_cbs.append(self._on_became_leader)
@@ -585,6 +591,11 @@ class JobService:
     async def _h_submit_relay(self, msg: Message, addr) -> None:
         if msg.sender != self.node.leader_unique:
             return
+        if self._shadow_restoring:
+            # a snapshot fetch is in flight: applying now would be
+            # erased by restore() — buffer and replay after it lands
+            self._buffered_relays.append((self._h_submit_relay, msg))
+            return
         d = msg.data
         job_id = int(d["job"])
         if self.scheduler.job_state(job_id) is not None:
@@ -597,6 +608,9 @@ class JobService:
     async def _h_ack_relay(self, msg: Message, addr) -> None:
         if msg.sender != self.node.leader_unique:
             return
+        if self._shadow_restoring:
+            self._buffered_relays.append((self._h_ack_relay, msg))
+            return
         self.scheduler.shadow_prune(
             int(msg.data["job"]), int(msg.data["batch"]),
             int(msg.data.get("n_images", 0)),
@@ -608,28 +622,64 @@ class JobService:
         right after a restore loses nothing. The fetch runs as a task —
         awaiting a store GET inline would block this node's receive
         loop on a reply that loop itself must process (self-deadlock
-        until timeout, plus a suspicion storm from unanswered pings)."""
+        until timeout, plus a suspicion storm from unanswered pings).
+        ACKs (echoing rid) only after the restore lands, so the
+        coordinator's retry loop covers lost datagrams AND failed
+        fetches."""
         if msg.sender != self.node.leader_unique or self.node.is_leader:
             return
+        version = int(msg.data["version"])
+        rid = msg.data.get("rid")
+        if self._shadow_version == version:  # duplicate/retry: ack only
+            if rid:
+                self.node.send_unique(
+                    msg.sender, MsgType.JOBS_RESTORE_RELAY_ACK,
+                    {"rid": rid, "ok": True},
+                )
+            return
+        if self._shadow_restoring:
+            return  # a fetch is already in flight; the retry re-asks
         asyncio.create_task(
-            self._restore_shadow(int(msg.data["version"])),
+            self._restore_shadow(version, rid, msg.sender),
             name=f"{self._me}-shadow-restore",
         )
 
-    async def _restore_shadow(self, version: int) -> None:
+    async def _restore_shadow(
+        self, version: int, rid: Optional[str], reply_to: str
+    ) -> None:
+        """Fetch + apply the snapshot. Relays arriving while the fetch
+        is in flight are buffered (see _h_submit_relay/_h_ack_relay)
+        and replayed after restore() — otherwise a job submitted during
+        the fetch, or a batch-done prune, would be erased when the
+        snapshot replaces the shadow wholesale."""
+        self._shadow_restoring = True
+        snap = None
         try:
             snap = json.loads(
                 await self.store.get_bytes(self.JOBS_CKPT_NAME, version=version)
             )
         except Exception:
             log.exception("%s: standby snapshot restore failed", self._me)
-            return
-        if self.node.is_leader:  # promoted while fetching: don't clobber
-            return
-        self.scheduler.restore(snap)
+        finally:
+            self._shadow_restoring = False
+            buffered, self._buffered_relays = self._buffered_relays, []
+        # apply the snapshot only on success AND while still standby
+        # (promoted mid-fetch: the live state must not be clobbered)
+        if snap is not None and not self.node.is_leader:
+            self.scheduler.restore(snap)
+            self._shadow_version = version
+        for handler, m in buffered:  # replay what arrived mid-fetch
+            await handler(m, None)
+        if snap is None:
+            return  # no ack -> coordinator retries
+        if rid:
+            self.node.send_unique(
+                reply_to, MsgType.JOBS_RESTORE_RELAY_ACK,
+                {"rid": rid, "ok": True},
+            )
         log.info(
-            "%s: shadow restored from snapshot v%d (%d jobs)",
-            self._me, version, len(self.scheduler.jobs),
+            "%s: shadow restored from snapshot v%d (%d jobs, %d relays replayed)",
+            self._me, version, len(self.scheduler.jobs), len(buffered),
         )
 
     # ------------------------------------------------------------------
@@ -847,14 +897,36 @@ class JobService:
         }
         # bring the hot-standby's shadow up to the restored state —
         # without this, a failover right after a restore would promote
-        # an empty shadow and drop every restored job
-        sb = self.store.standby_node()
-        if sb is not None and sb.unique_name != self._me:
-            self.node.send(
-                sb, MsgType.JOBS_RESTORE_RELAY, {"version": version}
-            )
+        # an empty shadow and drop every restored job. Retried until
+        # the standby ACKs: one lost datagram must not silently void
+        # the failover guarantee.
+        asyncio.create_task(
+            self._relay_restore_to_standby(version),
+            name=f"{self._me}-restore-relay",
+        )
         self._run_schedule()
         return stats
+
+    async def _relay_restore_to_standby(self, version: int) -> None:
+        for _ in range(5):
+            sb = self.store.standby_node()
+            if sb is None or sb.unique_name == self._me:
+                return
+            try:
+                reply = await self.node.request(
+                    sb, MsgType.JOBS_RESTORE_RELAY, {"version": version},
+                    timeout=10.0,
+                )
+                if reply.get("ok"):
+                    return
+            except (TimeoutError, asyncio.TimeoutError):
+                continue
+            except asyncio.CancelledError:
+                raise
+        log.warning(
+            "%s: standby never acked snapshot v%d — its shadow may be "
+            "stale until the next checkpoint", self._me, version,
+        )
 
     def _ensure_engine(self):
         if self._engine is None:

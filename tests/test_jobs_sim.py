@@ -432,3 +432,52 @@ async def test_restore_relays_to_standby_failover(tmp_path):
         done = await client.wait_job(job_id, timeout=30.0)
         assert done["total_queries"] == 96
         assert sb_jobs.node.is_leader
+
+
+async def test_relays_buffered_during_shadow_restore(tmp_path):
+    """A job submitted while the standby's snapshot fetch is in flight
+    must survive the restore (review finding: restore() used to replace
+    the shadow wholesale, erasing relays that raced the fetch)."""
+    async with cluster(4, tmp_path, 22900) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 3)
+        client = sim.jobs[client_u]
+        gate = asyncio.Event()
+        for be in sim.backends.values():
+            be.gate = gate
+
+        j1 = await client.submit_job("ResNet50", 96)
+        coord = sim.coordinator_jobs()
+        coord_u = next(iter(sim.nodes.values())).leader_unique
+        standby_u = sim.stores[coord_u].standby_node().unique_name
+        sb = sim.jobs[standby_u]
+        await sim.wait_for(lambda: j1 in coord.scheduler.jobs, what="intake")
+        await coord.checkpoint_jobs()
+        coord.scheduler.queues.clear()
+        coord.scheduler.in_progress.clear()
+        coord.scheduler.jobs.clear()
+        sb.scheduler.queues.clear()
+        sb.scheduler.jobs.clear()
+
+        # slow the standby's snapshot fetch so relays can race it
+        orig_get = sb.store.get_bytes
+
+        async def slow_get(*a, **k):
+            await asyncio.sleep(0.6)
+            return await orig_get(*a, **k)
+
+        sb.store.get_bytes = slow_get
+        await coord.restore_jobs()
+        await sim.wait_for(lambda: sb._shadow_restoring,
+                           what="standby fetch in flight")
+        j2 = await client.submit_job("InceptionV3", 32)  # races the fetch
+        await sim.wait_for(
+            lambda: j1 in sb.scheduler.jobs and j2 in sb.scheduler.jobs,
+            what="shadow holds restored AND raced job",
+        )
+        assert sb._shadow_version is not None
+        gate.set()
+        r1 = await client.wait_job(j1, timeout=30.0)
+        r2 = await client.wait_job(j2, timeout=30.0)
+        assert r1["total_queries"] == 96 and r2["total_queries"] == 32
