@@ -1,0 +1,160 @@
+"""Input pipeline: file-backed datasets with background prefetch.
+
+The reference has no training and its inference path decodes images
+inline on the event loop thread (reference worker.py:1361-1386 pulls
+each image then calls perform_inference). On TPU the rule is: the chip
+must never wait for the host. This module keeps the device fed:
+
+- `ImageDataset`: deterministic per-epoch shuffle, fixed batch shapes
+  (drop_remainder by default — static shapes mean one XLA program),
+  decode via `models.preprocess.load_images` (native C++ libjpeg
+  loader when available, PIL otherwise).
+- `Prefetcher`: a background thread decodes batch k+1..k+depth while
+  the device runs batch k, so host JPEG decode overlaps device
+  compute. Optionally lands batches on device (`jax.device_put`)
+  from the producer thread, overlapping the H2D transfer too.
+
+Typical loop:
+
+    ds = ImageDataset(samples, image_size=(224, 224), batch_size=32)
+    for epoch in range(3):
+        for images, labels in Prefetcher(ds, epoch=epoch):
+            trainer.step(images, labels)
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Sample = Tuple[str, int]  # (image path, class label)
+
+
+class ImageDataset:
+    """Deterministically shuffled, fixed-shape image batches."""
+
+    def __init__(
+        self,
+        samples: Sequence[Sample],
+        image_size: Tuple[int, int],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+    ):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.samples = list(samples)
+        self.image_size = image_size
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+
+    def __len__(self) -> int:
+        """Number of batches per epoch."""
+        n = len(self.samples)
+        full, rem = divmod(n, self.batch_size)
+        return full + (1 if rem and not self.drop_remainder else 0)
+
+    def batch_plan(self, epoch: int = 0) -> List[List[Sample]]:
+        """The epoch's batches as (path, label) lists — decode-free, so
+        tests and schedulers can inspect order cheaply. Shuffle is
+        keyed by (seed, epoch): every worker that agrees on those sees
+        the same order (the dp-sharded training contract)."""
+        order = np.arange(len(self.samples))
+        if self.shuffle:
+            np.random.RandomState((self.seed * 1_000_003 + epoch) & 0x7FFFFFFF
+                                  ).shuffle(order)
+        out: List[List[Sample]] = []
+        for start in range(0, len(order), self.batch_size):
+            idx = order[start : start + self.batch_size]
+            if len(idx) < self.batch_size and self.drop_remainder:
+                break
+            out.append([self.samples[i] for i in idx])
+        return out
+
+    def load_batch(self, batch: Sequence[Sample]) -> Tuple[np.ndarray, np.ndarray]:
+        """Decode one batch -> (uint8 [B,H,W,3], int32 [B])."""
+        from .models.preprocess import load_images
+
+        files = [p for p, _ in batch]
+        labels = np.asarray([l for _, l in batch], np.int32)
+        return load_images(files, self.image_size), labels
+
+    def epoch(self, epoch: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        for batch in self.batch_plan(epoch):
+            yield self.load_batch(batch)
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+class Prefetcher:
+    """Iterate a dataset epoch with `depth` batches decoded ahead in a
+    background thread. With `device` set, batches are also transferred
+    from the producer thread (H2D overlaps compute as well)."""
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        dataset: ImageDataset,
+        epoch: int = 0,
+        depth: int = 2,
+        device=None,
+    ):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self.dataset = dataset
+        self.epoch_idx = epoch
+        self.depth = depth
+        self.device = device
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+
+    def _produce(self) -> None:
+        try:
+            for batch in self.dataset.batch_plan(self.epoch_idx):
+                if self._stop.is_set():
+                    return
+                images, labels = self.dataset.load_batch(batch)
+                if self.device is not None:
+                    import jax
+
+                    images = jax.device_put(images, self.device)
+                    labels = jax.device_put(labels, self.device)
+                self._q.put((images, labels))
+        except BaseException as e:  # surfaced on the consumer side
+            self._error = e
+        finally:
+            self._q.put(self._DONE)
+
+    def __iter__(self):
+        self._thread = threading.Thread(
+            target=self._produce, name="dml-prefetch", daemon=True
+        )
+        self._thread.start()
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._DONE:
+                    if self._error is not None:
+                        raise self._error
+                    return
+                yield item
+        finally:
+            # consumer done or bailed early: unblock + retire the
+            # producer (it may be parked on a full queue)
+            self._stop.set()
+            while self._thread.is_alive():
+                try:
+                    self._q.get(timeout=0.05)
+                except queue.Empty:
+                    pass
+            self._thread.join(timeout=5)

@@ -33,8 +33,8 @@ import json
 import logging
 import os
 import time
-import uuid
-from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Awaitable, Callable, Deque, Dict, List, Optional, Tuple
 
 from ..config import NodeId
 from ..cluster.node import Node
@@ -91,12 +91,20 @@ class JobService:
         )
         # submit idempotency tokens -> job id
         self._submit_tokens: BoundedDict = BoundedDict(1000)
-        # standby shadow-restore state: relays arriving while a
-        # snapshot fetch is in flight are buffered and replayed after
-        # restore(); _shadow_version dedups relay retries
+        # --- shadow-restore relay protocol state ---
+        # coordinator: every relay carries a generation; restore-jobs
+        # bumps it, so "sent after the restore" is observable on the
+        # standby regardless of datagram arrival order
+        self._relay_gen = 0
+        # standby: recent relays (sender, gen, apply-fn, msg), kept so
+        # a snapshot restore can replay everything sent at/after its
+        # generation — relays race the snapshot fetch arbitrarily and
+        # apply-fns are idempotent, so apply-now + replay-later is safe
+        self._relay_log: Deque[Tuple[str, int, Any, Message]] = deque(maxlen=500)
         self._shadow_restoring = False
-        self._buffered_relays: List[Tuple[Any, Message]] = []
-        self._shadow_version: Optional[int] = None
+        self._shadow_gen: Optional[int] = None  # last restored generation
+        self._shadow_gen_leader: Optional[str] = None
+        self._restored_keys: BoundedDict = BoundedDict(50)  # (leader, ver, gen)
         self._register()
         node.on_node_failed_cbs.append(self._on_node_failed)
         node.on_became_leader_cbs.append(self._on_became_leader)
@@ -427,7 +435,8 @@ class JobService:
                     sb,
                     MsgType.SUBMIT_JOB_RELAY,
                     {"job": job_id, "model": model, "n": n, "files": files,
-                     "batch_size": bs, "requester": msg.sender},
+                     "batch_size": bs, "requester": msg.sender,
+                     "gen": self._relay_gen},
                 )
             except Exception:
                 log.exception("%s: standby relay of job %d failed", self._me, job_id)
@@ -456,7 +465,8 @@ class JobService:
                 sb,
                 MsgType.WORKER_TASK_ACK_RELAY,
                 {"job": job_id, "batch": batch_id,
-                 "n_images": int(d.get("n_images", 0))},
+                 "n_images": int(d.get("n_images", 0)),
+                 "gen": self._relay_gen},
             )
         if done is not None:
             self.node.send_unique(
@@ -588,14 +598,32 @@ class JobService:
     # standby side (reference worker.py:887-897, 965-986)
     # ------------------------------------------------------------------
 
+    def _gen_of(self, msg: Message) -> int:
+        return int(msg.data.get("gen", 0))
+
+    def _gen_stale(self, msg: Message) -> bool:
+        """A relay from the current leader with a generation below the
+        last restored one reflects pre-restore state the coordinator
+        deliberately wiped — drop it."""
+        return (
+            self._shadow_gen is not None
+            and msg.sender == self._shadow_gen_leader
+            and self._gen_of(msg) < self._shadow_gen
+        )
+
     async def _h_submit_relay(self, msg: Message, addr) -> None:
-        if msg.sender != self.node.leader_unique:
+        if msg.sender != self.node.leader_unique or self._gen_stale(msg):
             return
-        if self._shadow_restoring:
-            # a snapshot fetch is in flight: applying now would be
-            # erased by restore() — buffer and replay after it lands
-            self._buffered_relays.append((self._h_submit_relay, msg))
-            return
+        # log first, then apply: if a snapshot restore is (or gets)
+        # in flight, replaying the log after restore() re-applies
+        # everything sent at/after the restore generation. Apply-fns
+        # are idempotent, so apply-now + replay-later is always safe.
+        self._relay_log.append(
+            (msg.sender, self._gen_of(msg), self._apply_submit_relay, msg)
+        )
+        self._apply_submit_relay(msg)
+
+    def _apply_submit_relay(self, msg: Message) -> None:
         d = msg.data
         job_id = int(d["job"])
         if self.scheduler.job_state(job_id) is not None:
@@ -606,11 +634,14 @@ class JobService:
         )
 
     async def _h_ack_relay(self, msg: Message, addr) -> None:
-        if msg.sender != self.node.leader_unique:
+        if msg.sender != self.node.leader_unique or self._gen_stale(msg):
             return
-        if self._shadow_restoring:
-            self._buffered_relays.append((self._h_ack_relay, msg))
-            return
+        self._relay_log.append(
+            (msg.sender, self._gen_of(msg), self._apply_ack_relay, msg)
+        )
+        self._apply_ack_relay(msg)
+
+    def _apply_ack_relay(self, msg: Message) -> None:
         self.scheduler.shadow_prune(
             int(msg.data["job"]), int(msg.data["batch"]),
             int(msg.data.get("n_images", 0)),
@@ -619,19 +650,23 @@ class JobService:
     async def _h_restore_relay(self, msg: Message, addr) -> None:
         """Standby side of restore-jobs: pull the same pinned snapshot
         from the store and make it the shadow state, so a failover
-        right after a restore loses nothing. The fetch runs as a task —
-        awaiting a store GET inline would block this node's receive
-        loop on a reply that loop itself must process (self-deadlock
-        until timeout, plus a suspicion storm from unanswered pings).
-        ACKs (echoing rid) only after the restore lands, so the
-        coordinator's retry loop covers lost datagrams AND failed
-        fetches."""
+        right after a restore loses nothing.
+
+        The fetch runs as a task — awaiting a store GET inline would
+        block this node's receive loop on a reply that loop itself must
+        process (self-deadlock until timeout, plus a suspicion storm
+        from unanswered pings). ACKs (echoing rid) go back only after a
+        restore lands, so the coordinator's retry loop covers lost
+        datagrams AND failed fetches. Duplicate restores are keyed by
+        (leader, version, generation): a deliberate re-restore to the
+        same version bumps the generation, so it re-applies."""
         if msg.sender != self.node.leader_unique or self.node.is_leader:
             return
         version = int(msg.data["version"])
+        gen = self._gen_of(msg)
         rid = msg.data.get("rid")
-        if self._shadow_version == version:  # duplicate/retry: ack only
-            if rid:
+        if self._restored_keys.get((msg.sender, version, gen)):
+            if rid:  # duplicate/retry of a landed restore: ack only
                 self.node.send_unique(
                     msg.sender, MsgType.JOBS_RESTORE_RELAY_ACK,
                     {"rid": rid, "ok": True},
@@ -639,47 +674,64 @@ class JobService:
             return
         if self._shadow_restoring:
             return  # a fetch is already in flight; the retry re-asks
+        # set the latch HERE (not inside the task): a second restore
+        # relay queued right behind this one must not spawn a
+        # concurrent fetch
+        self._shadow_restoring = True
         asyncio.create_task(
-            self._restore_shadow(version, rid, msg.sender),
+            self._restore_shadow(version, gen, rid, msg.sender),
             name=f"{self._me}-shadow-restore",
         )
 
     async def _restore_shadow(
-        self, version: int, rid: Optional[str], reply_to: str
+        self, version: int, gen: int, rid: Optional[str], reply_to: str
     ) -> None:
-        """Fetch + apply the snapshot. Relays arriving while the fetch
-        is in flight are buffered (see _h_submit_relay/_h_ack_relay)
-        and replayed after restore() — otherwise a job submitted during
-        the fetch, or a batch-done prune, would be erased when the
-        snapshot replaces the shadow wholesale."""
-        self._shadow_restoring = True
+        """Fetch + apply the snapshot, then replay every logged relay
+        sent at/after the restore generation — relays race the fetch
+        (and even the restore relay itself) arbitrarily over UDP, and
+        restore() replaces the shadow wholesale, so anything the
+        coordinator sent after bumping the generation must be
+        re-applied on top."""
         snap = None
         try:
-            snap = json.loads(
-                await self.store.get_bytes(self.JOBS_CKPT_NAME, version=version)
-            )
-        except Exception:
-            log.exception("%s: standby snapshot restore failed", self._me)
+            for attempt in range(3):  # local retry before the 10s resend
+                try:
+                    snap = json.loads(await self.store.get_bytes(
+                        self.JOBS_CKPT_NAME, version=version
+                    ))
+                    break
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception(
+                        "%s: standby snapshot fetch failed (attempt %d)",
+                        self._me, attempt + 1,
+                    )
+                    await asyncio.sleep(0.2 * (attempt + 1))
         finally:
             self._shadow_restoring = False
-            buffered, self._buffered_relays = self._buffered_relays, []
-        # apply the snapshot only on success AND while still standby
-        # (promoted mid-fetch: the live state must not be clobbered)
-        if snap is not None and not self.node.is_leader:
-            self.scheduler.restore(snap)
-            self._shadow_version = version
-        for handler, m in buffered:  # replay what arrived mid-fetch
-            await handler(m, None)
         if snap is None:
-            return  # no ack -> coordinator retries
+            return  # no ack -> coordinator retries the relay
+        if self.node.is_leader:
+            return  # promoted mid-fetch: the live state must not be clobbered
+        self.scheduler.restore(snap)
+        self._shadow_gen = gen
+        self._shadow_gen_leader = reply_to
+        replayed = 0
+        for sender, g, apply_fn, m in list(self._relay_log):
+            if sender == reply_to and g >= gen:
+                apply_fn(m)
+                replayed += 1
+        self._restored_keys[(reply_to, version, gen)] = True
         if rid:
             self.node.send_unique(
                 reply_to, MsgType.JOBS_RESTORE_RELAY_ACK,
                 {"rid": rid, "ok": True},
             )
         log.info(
-            "%s: shadow restored from snapshot v%d (%d jobs, %d relays replayed)",
-            self._me, version, len(self.scheduler.jobs), len(buffered),
+            "%s: shadow restored from snapshot v%d gen %d (%d jobs, "
+            "%d relays replayed)",
+            self._me, version, gen, len(self.scheduler.jobs), replayed,
         )
 
     # ------------------------------------------------------------------
@@ -895,26 +947,32 @@ class JobService:
                 len(q) for q in self.scheduler.queues.values()
             ),
         }
+        # bump the relay generation FIRST: every relay sent from here
+        # on (job submits, batch acks) carries gen >= this restore's,
+        # so the standby can tell post-restore relays from pre-restore
+        # ones regardless of UDP arrival order
+        self._relay_gen += 1
         # bring the hot-standby's shadow up to the restored state —
         # without this, a failover right after a restore would promote
         # an empty shadow and drop every restored job. Retried until
         # the standby ACKs: one lost datagram must not silently void
         # the failover guarantee.
         asyncio.create_task(
-            self._relay_restore_to_standby(version),
+            self._relay_restore_to_standby(version, self._relay_gen),
             name=f"{self._me}-restore-relay",
         )
         self._run_schedule()
         return stats
 
-    async def _relay_restore_to_standby(self, version: int) -> None:
+    async def _relay_restore_to_standby(self, version: int, gen: int) -> None:
         for _ in range(5):
             sb = self.store.standby_node()
             if sb is None or sb.unique_name == self._me:
                 return
             try:
                 reply = await self.node.request(
-                    sb, MsgType.JOBS_RESTORE_RELAY, {"version": version},
+                    sb, MsgType.JOBS_RESTORE_RELAY,
+                    {"version": version, "gen": gen},
                     timeout=10.0,
                 )
                 if reply.get("ok"):

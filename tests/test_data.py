@@ -1,0 +1,110 @@
+"""Input pipeline: dataset determinism, batching, prefetch overlap."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dml_tpu.data import ImageDataset, Prefetcher
+
+
+@pytest.fixture(scope="module")
+def samples(tmp_path_factory):
+    from PIL import Image
+
+    d = tmp_path_factory.mktemp("imgs")
+    rng = np.random.default_rng(0)
+    out = []
+    for i in range(10):
+        p = d / f"img_{i}.jpeg"
+        Image.fromarray(
+            rng.integers(0, 255, (40, 40, 3), dtype=np.uint8)
+        ).save(p)
+        out.append((str(p), i % 3))
+    return out
+
+
+def test_batch_plan_deterministic_and_epoch_varying(samples):
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=4, seed=7)
+    assert len(ds) == 2  # 10 samples, bs 4, drop_remainder
+    p0a = ds.batch_plan(epoch=0)
+    p0b = ds.batch_plan(epoch=0)
+    p1 = ds.batch_plan(epoch=1)
+    assert p0a == p0b  # same (seed, epoch) -> same order everywhere
+    assert p0a != p1  # different epoch -> reshuffled
+    flat = [s for b in p0a for s in b]
+    assert len(set(flat)) == 8  # no duplicates within an epoch
+
+
+def test_no_shuffle_keeps_order_and_tail(samples):
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=4,
+                      shuffle=False, drop_remainder=False)
+    plan = ds.batch_plan()
+    assert len(ds) == 3 and len(plan) == 3
+    assert plan[2] == samples[8:]  # natural-length tail kept
+    assert [s for b in plan for s in b] == list(samples)
+
+
+def test_load_batch_shapes(samples):
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=4)
+    images, labels = ds.load_batch(ds.batch_plan()[0])
+    assert images.shape == (4, 32, 32, 3) and images.dtype == np.uint8
+    assert labels.shape == (4,) and labels.dtype == np.int32
+
+
+def test_prefetcher_yields_all_batches_in_order(samples):
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=2, seed=1)
+    direct = [(i.tobytes(), l.tobytes()) for i, l in ds.epoch(3)]
+    fetched = [
+        (i.tobytes(), l.tobytes()) for i, l in Prefetcher(ds, epoch=3)
+    ]
+    assert fetched == direct and len(fetched) == 5
+
+
+def test_prefetcher_overlaps_consumer_work(samples):
+    # with depth=2 the producer decodes ahead: consumer never waits
+    # for more than ~1 decode even when it is slower than the producer
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=2)
+    seen = 0
+    for _ in Prefetcher(ds, depth=2):
+        time.sleep(0.02)  # simulate device step
+        seen += 1
+    assert seen == 5
+
+
+def test_prefetcher_early_exit_stops_producer(samples):
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=1)
+    pf = Prefetcher(ds, depth=1)
+    for i, _ in enumerate(pf):
+        if i == 1:
+            break
+    # producer thread must not be left alive
+    deadline = time.monotonic() + 2
+    while pf._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not pf._thread.is_alive()
+    assert threading.active_count() < 20
+
+
+def test_prefetcher_propagates_decode_errors(samples):
+    bad = samples[:2] + [("/nonexistent/file.jpeg", 0)]
+    ds = ImageDataset(bad, image_size=(32, 32), batch_size=3, shuffle=False)
+    with pytest.raises(Exception):
+        list(Prefetcher(ds))
+
+
+def test_dataset_feeds_trainer(samples):
+    from _tinynet import ensure_tinynet
+
+    ensure_tinynet()
+    import jax.numpy as jnp
+
+    from dml_tpu.parallel.mesh import local_mesh
+    from dml_tpu.parallel.train import Trainer
+
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=8, seed=2)
+    tr = Trainer("TinyNet", local_mesh(dp=8), batch_size=8, dtype=jnp.float32)
+    for images, labels in Prefetcher(ds):
+        m = tr.step(images, labels)
+        assert np.isfinite(m["loss"])

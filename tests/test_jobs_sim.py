@@ -476,8 +476,52 @@ async def test_relays_buffered_during_shadow_restore(tmp_path):
             lambda: j1 in sb.scheduler.jobs and j2 in sb.scheduler.jobs,
             what="shadow holds restored AND raced job",
         )
-        assert sb._shadow_version is not None
+        assert sb._shadow_gen is not None
         gate.set()
         r1 = await client.wait_job(j1, timeout=30.0)
         r2 = await client.wait_job(j2, timeout=30.0)
         assert r1["total_queries"] == 96 and r2["total_queries"] == 32
+
+
+async def test_post_restore_relay_arriving_before_restore_relay(tmp_path):
+    """UDP gives no ordering: a relay SENT after the restore (higher
+    generation) can ARRIVE before the restore relay. The gen-stamped
+    relay log must re-apply it on top of the restored snapshot."""
+    from dml_tpu.cluster.wire import Message, MsgType
+
+    async with cluster(3, tmp_path, 23000) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        names = await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+        coord_u = next(iter(sim.nodes.values())).leader_unique
+        standby_u = sim.stores[coord_u].standby_node().unique_name
+        sb = sim.jobs[standby_u]
+
+        await coord.checkpoint_jobs()  # snapshot: no jobs
+
+        # post-restore submit relay (gen 1) arrives FIRST
+        await sb._h_submit_relay(Message(
+            sender=coord_u, type=MsgType.SUBMIT_JOB_RELAY,
+            data={"job": 7, "model": "ResNet50", "n": 4, "files": names,
+                  "batch_size": 4, "requester": client_u, "gen": 1},
+        ), None)
+        assert 7 in sb.scheduler.jobs
+        # then the restore relay (same generation) arrives
+        await sb._h_restore_relay(Message(
+            sender=coord_u, type=MsgType.JOBS_RESTORE_RELAY,
+            data={"version": 1, "gen": 1, "rid": "r1"},
+        ), None)
+        await sim.wait_for(lambda: not sb._shadow_restoring,
+                           what="shadow restore settles")
+        # snapshot had no jobs, but the gen-1 relay was replayed on top
+        assert 7 in sb.scheduler.jobs
+        assert sb._shadow_gen == 1
+
+        # a PRE-restore relay (gen 0) arriving late is stale: dropped
+        await sb._h_submit_relay(Message(
+            sender=coord_u, type=MsgType.SUBMIT_JOB_RELAY,
+            data={"job": 3, "model": "ResNet50", "n": 4, "files": names,
+                  "batch_size": 4, "requester": client_u, "gen": 0},
+        ), None)
+        assert 3 not in sb.scheduler.jobs
