@@ -136,6 +136,14 @@ class Prefetcher:
             self._q.put(self._DONE)
 
     def __iter__(self):
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("Prefetcher is already being iterated")
+        # fresh per-iteration state: a Prefetcher is reusable across
+        # epochs (stale _stop/_error/queue from a prior pass must not
+        # leak into the next one)
+        self._q = queue.Queue(maxsize=self.depth)
+        self._error = None
+        self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._produce, name="dml-prefetch", daemon=True
         )

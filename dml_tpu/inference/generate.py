@@ -1,0 +1,177 @@
+"""Autoregressive decoding with a KV cache for TransformerLM.
+
+Net-new vs the reference (SURVEY §0: no sequence models at all) — the
+serving half of the framework's LM path. Written TPU-first:
+
+- The whole generate loop is ONE `lax.scan` inside one jit: a single
+  compilation serves any prompt in the batch, and the chip never
+  returns to the host between tokens.
+- The KV cache is a plain pytree argument (functional — no mutable
+  module state), pre-allocated at `max_len` so every step has static
+  shapes; attention masks positions beyond the current index instead
+  of slicing dynamically.
+- Per-step attention is one [B,H,1,T] matvec against the cached keys —
+  bandwidth-bound, exactly what HBM is for; the MXU path (prefill)
+  reuses the same step function under scan.
+
+The decode math mirrors `models/transformer.py` layer-for-layer and
+consumes the SAME params tree (`TransformerLM.init(...)["params"]`),
+so trained/published weights serve directly. MoE blocks are not yet
+supported in the decode path (dense FFN blocks only).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import rope
+
+RMS_EPS = 1e-6  # flax nn.RMSNorm default, as used by TransformerLM
+
+
+@dataclass(frozen=True)
+class LMConfig:
+    """Shape config mirroring TransformerLM's fields."""
+
+    vocab_size: int
+    d_model: int
+    n_heads: int
+    n_layers: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
+    """Pre-allocated KV cache: one [B, max_len, H, D] pair per layer."""
+    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {
+        f"block_{i}": {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+        }
+        for i in range(cfg.n_layers)
+    }
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    # flax RMSNorm: reduce in f32, scale, cast back to module dtype
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + RMS_EPS)
+    return (y * scale.astype(jnp.float32)).astype(dtype)
+
+
+def decode_step(
+    params: Dict[str, Any],
+    cfg: LMConfig,
+    cache: Dict[str, Any],
+    tokens: jax.Array,  # [B] int32 — the tokens at position `idx`
+    idx: jax.Array,  # scalar int32 position being written
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """One decode step: logits for position `idx` + updated cache.
+
+    Matches TransformerLM.apply on the prefix up to `idx` exactly
+    (same layer math, same dtypes).
+    """
+    b = tokens.shape[0]
+    h, hd = cfg.n_heads, cfg.head_dim
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)  # [B, d]
+    x = x[:, None, :]  # [B, 1, d]
+    positions = idx[None]  # [1]
+    max_len = next(iter(cache.values()))["k"].shape[1]
+    # mask over cached positions: only <= idx are valid
+    valid = jnp.arange(max_len) <= idx  # [T]
+
+    new_cache: Dict[str, Any] = {}
+    for i in range(cfg.n_layers):
+        blk = params[f"block_{i}"]
+        if "moe" in blk:
+            raise NotImplementedError(
+                "decode path supports dense FFN blocks only (no MoE yet)"
+            )
+        y = _rms_norm(x, blk["ln_attn"]["scale"], cfg.dtype)
+        qkv = y @ blk["qkv"]["kernel"].astype(cfg.dtype)  # [B, 1, 3d]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = rope(q.reshape(b, 1, h, hd), positions)
+        k = rope(k.reshape(b, 1, h, hd), positions)
+        v = v.reshape(b, 1, h, hd)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            cache[f"block_{i}"]["k"], k.astype(cfg.dtype), idx, axis=1
+        )
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cache[f"block_{i}"]["v"], v.astype(cfg.dtype), idx, axis=1
+        )
+        new_cache[f"block_{i}"] = {"k": ck, "v": cv}
+        # attention of the single query against the whole cache (masked)
+        s = jnp.einsum("bqhd,bthd->bhqt", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) * (hd**-0.5)
+        s = jnp.where(valid[None, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhqt,bthd->bqhd", p, cv.astype(jnp.float32))
+        attn = attn.reshape(b, 1, cfg.d_model).astype(cfg.dtype)
+        x = x + attn @ blk["proj"]["kernel"].astype(cfg.dtype)
+        y = _rms_norm(x, blk["ln_mlp"]["scale"], cfg.dtype)
+        y = y @ blk["up"]["kernel"].astype(cfg.dtype)
+        y = jax.nn.silu(y)
+        x = x + y @ blk["down"]["kernel"].astype(cfg.dtype)
+
+    x = _rms_norm(x, params["ln_out"]["scale"], cfg.dtype)
+    logits = x.astype(jnp.float32) @ params["lm_head"]["kernel"].astype(
+        jnp.float32
+    )
+    return logits[:, 0, :], new_cache
+
+
+def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    params: Dict[str, Any],
+    cfg: LMConfig,
+    prompt: jax.Array,  # [B, Tp] int32
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    seed: int = 0,
+) -> jax.Array:
+    """Greedy/temperature/top-k decoding; returns [B, max_new_tokens].
+
+    Prefill and decode share one scanned step function: positions
+    < Tp teacher-force the prompt token, later positions feed back the
+    sample. One jit compilation per (shape, config).
+    """
+    b, tp = prompt.shape
+    total = tp + max_new_tokens
+    cache = init_cache(cfg, b, total)
+
+    def step(carry, t):
+        cache, cur, rng = carry
+        logits, cache = decode_step(params, cfg, cache, cur, t)
+        rng, sub = jax.random.split(rng)
+        sampled = _sample(logits, sub, temperature, top_k)
+        # next input: prompt token while still prefilling, else sample
+        nxt = jnp.where(t + 1 < tp, prompt[:, jnp.minimum(t + 1, tp - 1)], sampled)
+        return (cache, nxt, rng), sampled
+
+    (_, _, _), samples = jax.lax.scan(
+        step,
+        (cache, prompt[:, 0], jax.random.PRNGKey(seed)),
+        jnp.arange(total),
+    )
+    # samples[t] is the model's prediction FOR position t+1; the new
+    # tokens are the predictions from position tp-1 onward
+    return samples.T[:, tp - 1 : total - 1]

@@ -94,8 +94,12 @@ class JobService:
         # --- shadow-restore relay protocol state ---
         # coordinator: every relay carries a generation; restore-jobs
         # bumps it, so "sent after the restore" is observable on the
-        # standby regardless of datagram arrival order
-        self._relay_gen = 0
+        # standby regardless of datagram arrival order. Seeded from the
+        # incarnation timestamp so a RESTARTED coordinator (same
+        # host:port identity) starts above every generation it ever
+        # sent before — otherwise the standby's _gen_stale would
+        # silently drop all of the new incarnation's relays.
+        self._relay_gen = self._incarnation
         # standby: recent relays (sender, gen, apply-fn, msg), kept so
         # a snapshot restore can replay everything sent at/after its
         # generation — relays race the snapshot fetch arbitrarily and
@@ -667,6 +671,20 @@ class JobService:
         rid = msg.data.get("rid")
         if self._restored_keys.get((msg.sender, version, gen)):
             if rid:  # duplicate/retry of a landed restore: ack only
+                self.node.send_unique(
+                    msg.sender, MsgType.JOBS_RESTORE_RELAY_ACK,
+                    {"rid": rid, "ok": True},
+                )
+            return
+        # monotonicity: a delayed/retried relay from an OLDER restore
+        # must not roll the shadow back to an older snapshot. Ack it
+        # (so its retry loop stops) without applying.
+        if (
+            self._shadow_gen is not None
+            and msg.sender == self._shadow_gen_leader
+            and gen < self._shadow_gen
+        ):
+            if rid:
                 self.node.send_unique(
                     msg.sender, MsgType.JOBS_RESTORE_RELAY_ACK,
                     {"rid": rid, "ok": True},

@@ -178,6 +178,32 @@ class LongContextLM:
         self.state, loss = self._train_step(self.state, jnp.asarray(tokens))
         return float(jax.device_get(loss))
 
+    def generate(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """Autoregressive decoding with the trained weights (KV-cache
+        path, inference/generate.py). Dense-FFN configs only."""
+        from ..inference.generate import LMConfig, generate as _generate
+
+        m = self.model
+        cfg = LMConfig(
+            vocab_size=m.vocab_size, d_model=m.d_model, n_heads=m.n_heads,
+            n_layers=m.n_layers, d_ff=m.d_ff, dtype=m.dtype,
+        )
+        # params pass through with their training shardings — decoding
+        # works on sharded arrays (XLA gathers what each op needs);
+        # force-replicating here would double parameter HBM and defeat
+        # the tp sharding for models that only fit partitioned
+        return np.asarray(_generate(
+            self.state["params"], cfg, jnp.asarray(prompt.astype(np.int32)),
+            max_new_tokens, temperature=temperature, top_k=top_k, seed=seed,
+        ))
+
     def save_checkpoint(self, directory: str, keep: int = 3) -> str:
         from .checkpoint import CheckpointManager
 

@@ -108,3 +108,21 @@ def test_dataset_feeds_trainer(samples):
     for images, labels in Prefetcher(ds):
         m = tr.step(images, labels)
         assert np.isfinite(m["loss"])
+
+
+def test_prefetcher_is_reusable_across_epochs(samples):
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=2, seed=3)
+    pf = Prefetcher(ds)
+    first = sum(1 for _ in pf)
+    second = sum(1 for _ in pf)  # stale _stop/_error must not leak
+    assert first == second == 5
+
+
+def test_prefetcher_rejects_concurrent_iteration(samples):
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=2)
+    pf = Prefetcher(ds, depth=1)
+    it = iter(pf)
+    next(it)
+    with pytest.raises(RuntimeError):
+        next(iter(pf))
+    it.close()

@@ -525,3 +525,13 @@ async def test_post_restore_relay_arriving_before_restore_relay(tmp_path):
                   "batch_size": 4, "requester": client_u, "gen": 0},
         ), None)
         assert 3 not in sb.scheduler.jobs
+
+        # a delayed restore relay from an OLDER restore (gen 0) must
+        # not roll the shadow back: acked but not applied
+        await sb._h_restore_relay(Message(
+            sender=coord_u, type=MsgType.JOBS_RESTORE_RELAY,
+            data={"version": 1, "gen": 0, "rid": "r0"},
+        ), None)
+        await asyncio.sleep(0.1)
+        assert 7 in sb.scheduler.jobs  # survived, no rollback
+        assert sb._shadow_gen == 1
