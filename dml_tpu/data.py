@@ -118,10 +118,13 @@ class Prefetcher:
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
 
-    def _produce(self) -> None:
+    def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
+        # q/stop arrive as arguments (not self attributes): this thread
+        # must stay bound to ITS iteration's channel even after a later
+        # __iter__ replaces the instance state
         try:
             for batch in self.dataset.batch_plan(self.epoch_idx):
-                if self._stop.is_set():
+                if stop.is_set():
                     return
                 images, labels = self.dataset.load_batch(batch)
                 if self.device is not None:
@@ -129,28 +132,32 @@ class Prefetcher:
 
                     images = jax.device_put(images, self.device)
                     labels = jax.device_put(labels, self.device)
-                self._q.put((images, labels))
+                q.put((images, labels))
         except BaseException as e:  # surfaced on the consumer side
             self._error = e
         finally:
-            self._q.put(self._DONE)
+            q.put(self._DONE)
 
     def __iter__(self):
         if self._thread is not None and self._thread.is_alive():
             raise RuntimeError("Prefetcher is already being iterated")
         # fresh per-iteration state: a Prefetcher is reusable across
         # epochs (stale _stop/_error/queue from a prior pass must not
-        # leak into the next one)
-        self._q = queue.Queue(maxsize=self.depth)
+        # leak into the next one). The generator body uses ONLY these
+        # locals — an abandoned earlier iterator's cleanup must tear
+        # down its own producer, never a later iteration's (the self.*
+        # attributes get replaced on the next __iter__).
+        q = self._q = queue.Queue(maxsize=self.depth)
         self._error = None
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._produce, name="dml-prefetch", daemon=True
+        stop = self._stop = threading.Event()
+        thread = self._thread = threading.Thread(
+            target=self._produce, args=(q, stop),
+            name="dml-prefetch", daemon=True,
         )
-        self._thread.start()
+        thread.start()
         try:
             while True:
-                item = self._q.get()
+                item = q.get()
                 if item is self._DONE:
                     if self._error is not None:
                         raise self._error
@@ -159,10 +166,10 @@ class Prefetcher:
         finally:
             # consumer done or bailed early: unblock + retire the
             # producer (it may be parked on a full queue)
-            self._stop.set()
-            while self._thread.is_alive():
+            stop.set()
+            while thread.is_alive():
                 try:
-                    self._q.get(timeout=0.05)
+                    q.get(timeout=0.05)
                 except queue.Empty:
                     pass
-            self._thread.join(timeout=5)
+            thread.join(timeout=5)

@@ -16,8 +16,8 @@ serving half of the framework's LM path. Written TPU-first:
 
 The decode math mirrors `models/transformer.py` layer-for-layer and
 consumes the SAME params tree (`TransformerLM.init(...)["params"]`),
-so trained/published weights serve directly. MoE blocks are not yet
-supported in the decode path (dense FFN blocks only).
+so trained/published weights serve directly — including MoE blocks
+(per-token top-2 routing, exact at decode time).
 """
 
 from __future__ import annotations
@@ -68,6 +68,38 @@ def _rms_norm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     return (y * scale.astype(jnp.float32)).astype(dtype)
 
 
+def _moe_ffn(moe: Dict[str, Any], y: jax.Array, dtype) -> jax.Array:
+    """Single-position MoE FFN (parallel/moe.py MoEMLP at decode time).
+
+    Per-token top-2 routing is EXACT here — with one token per
+    sequence there is no batch-wide capacity competition, so no
+    dropped tokens (training-time capacity drops are a batching
+    artifact, not part of the learned function). Computes all experts
+    and combines with the gate weights: at decode batch sizes the
+    [B, E, d_ff] intermediate is small and the static shapes keep the
+    whole step in one compiled program."""
+    b = y.shape[0] * y.shape[1]
+    d = y.shape[-1]
+    tok = y.reshape(b, d)
+    logits = tok.astype(jnp.float32) @ moe["router"]["kernel"]  # [B, E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    e = gates.shape[-1]
+    i1 = jnp.argmax(gates, axis=-1)
+    m1 = jax.nn.one_hot(i1, e, dtype=gates.dtype)
+    i2 = jnp.argmax(gates * (1.0 - m1), axis=-1)
+    m2 = jax.nn.one_hot(i2, e, dtype=gates.dtype)
+    g1 = (gates * m1).sum(-1)
+    g2 = (gates * m2).sum(-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    w = (m1 * (g1 / denom)[:, None] + m2 * (g2 / denom)[:, None])  # [B, E]
+    w_up = moe["w_up"].astype(dtype)
+    w_down = moe["w_down"].astype(dtype)
+    h = jax.nn.silu(jnp.einsum("bd,edf->bef", tok, w_up))
+    o = jnp.einsum("bef,efd->bed", h, w_down)
+    out = jnp.einsum("bed,be->bd", o, w.astype(dtype))
+    return out.reshape(*y.shape)
+
+
 def decode_step(
     params: Dict[str, Any],
     cfg: LMConfig,
@@ -92,10 +124,6 @@ def decode_step(
     new_cache: Dict[str, Any] = {}
     for i in range(cfg.n_layers):
         blk = params[f"block_{i}"]
-        if "moe" in blk:
-            raise NotImplementedError(
-                "decode path supports dense FFN blocks only (no MoE yet)"
-            )
         y = _rms_norm(x, blk["ln_attn"]["scale"], cfg.dtype)
         qkv = y @ blk["qkv"]["kernel"].astype(cfg.dtype)  # [B, 1, 3d]
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -118,9 +146,12 @@ def decode_step(
         attn = attn.reshape(b, 1, cfg.d_model).astype(cfg.dtype)
         x = x + attn @ blk["proj"]["kernel"].astype(cfg.dtype)
         y = _rms_norm(x, blk["ln_mlp"]["scale"], cfg.dtype)
-        y = y @ blk["up"]["kernel"].astype(cfg.dtype)
-        y = jax.nn.silu(y)
-        x = x + y @ blk["down"]["kernel"].astype(cfg.dtype)
+        if "moe" in blk:
+            x = x + _moe_ffn(blk["moe"], y, cfg.dtype)
+        else:
+            y = y @ blk["up"]["kernel"].astype(cfg.dtype)
+            y = jax.nn.silu(y)
+            x = x + y @ blk["down"]["kernel"].astype(cfg.dtype)
 
     x = _rms_norm(x, params["ln_out"]["scale"], cfg.dtype)
     logits = x.astype(jnp.float32) @ params["lm_head"]["kernel"].astype(
@@ -147,16 +178,21 @@ def generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     seed: int = 0,
+    rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Greedy/temperature/top-k decoding; returns [B, max_new_tokens].
 
     Prefill and decode share one scanned step function: positions
     < Tp teacher-force the prompt token, later positions feed back the
-    sample. One jit compilation per (shape, config).
+    sample. One jit compilation per (shape, config). Pass `rng` (a
+    PRNGKey) instead of `seed` when calling under jit — a traced key
+    doesn't force a retrace per seed.
     """
     b, tp = prompt.shape
     total = tp + max_new_tokens
     cache = init_cache(cfg, b, total)
+    if rng is None:
+        rng = jax.random.PRNGKey(seed)
 
     def step(carry, t):
         cache, cur, rng = carry
@@ -167,11 +203,13 @@ def generate(
         nxt = jnp.where(t + 1 < tp, prompt[:, jnp.minimum(t + 1, tp - 1)], sampled)
         return (cache, nxt, rng), sampled
 
+    # the prediction at position total-1 would index past the output,
+    # so the scan stops one step short of the cache length
     (_, _, _), samples = jax.lax.scan(
         step,
-        (cache, prompt[:, 0], jax.random.PRNGKey(seed)),
-        jnp.arange(total),
+        (cache, prompt[:, 0], rng),
+        jnp.arange(total - 1),
     )
     # samples[t] is the model's prediction FOR position t+1; the new
     # tokens are the predictions from position tp-1 onward
-    return samples.T[:, tp - 1 : total - 1]
+    return samples.T[:, tp - 1 :]

@@ -679,11 +679,7 @@ class JobService:
         # monotonicity: a delayed/retried relay from an OLDER restore
         # must not roll the shadow back to an older snapshot. Ack it
         # (so its retry loop stops) without applying.
-        if (
-            self._shadow_gen is not None
-            and msg.sender == self._shadow_gen_leader
-            and gen < self._shadow_gen
-        ):
+        if self._gen_stale(msg):
             if rid:
                 self.node.send_unique(
                     msg.sender, MsgType.JOBS_RESTORE_RELAY_ACK,

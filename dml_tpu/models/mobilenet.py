@@ -1,0 +1,131 @@
+"""MobileNetV2 in Flax (Keras-graph-compatible).
+
+Fourth CNN family in the registry (reference hardwires two,
+models.py:23-71). Architecture and layer naming follow
+keras.applications.MobileNetV2 exactly — `Conv1`/`bn_Conv1` stem with
+correct_pad zero padding, inverted-residual blocks named
+`expanded_conv_*` / `block_N_*`, ReLU6 activations, BN epsilon 1e-3 —
+so `params_io.from_keras_model` maps pretrained weights name-for-name
+(the exact-name fast path; parity validated in test_keras_parity).
+
+TPU notes: NHWC, depthwise convs as grouped `nn.Conv`
+(feature_group_count = channels; XLA lowers these to the vector units,
+the 1x1 expand/project matmuls to the MXU), bf16-ready via `dtype`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+BN_EPS = 1e-3
+
+# (expansion, out_channels, repeats, first_stride) per stage — the
+# MobileNetV2 paper's table 2 (alpha=1.0 channels, all multiples of 8)
+STAGES = (
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _relu6(x):
+    return jnp.minimum(jnp.maximum(x, 0.0), 6.0)
+
+
+def _correct_pad(x):
+    """keras imagenet_utils.correct_pad for a 3x3 kernel: ((0,1),(0,1))
+    on even spatial sizes, ((1,1),(1,1)) on odd — shapes are static
+    under jit, so this resolves at trace time."""
+    h, w = x.shape[1], x.shape[2]
+    return jnp.pad(
+        x,
+        ((0, 0), (1 - h % 2, 1), (1 - w % 2, 1), (0, 0)),
+    )
+
+
+def _inverted_res(mdl, x, expansion, filters, stride, block_id, train):
+    """One inverted-residual block with Keras layer names (block 0 is
+    `expanded_conv` with no expand conv; the rest are `block_N`)."""
+    conv = partial(nn.Conv, use_bias=False, dtype=mdl.dtype)
+    bn = partial(
+        nn.BatchNorm,
+        use_running_average=not train,
+        epsilon=BN_EPS,
+        momentum=0.999,
+        dtype=mdl.dtype,
+    )
+    prefix = "expanded_conv" if block_id == 0 else f"block_{block_id}"
+    in_c = x.shape[-1]
+    inputs = x
+    if block_id:
+        x = conv(in_c * expansion, (1, 1), name=f"{prefix}_expand")(x)
+        x = bn(name=f"{prefix}_expand_BN")(x)
+        x = _relu6(x)
+    ch = x.shape[-1]
+    if stride == 2:
+        x = _correct_pad(x)
+        padding = "VALID"
+    else:
+        padding = "SAME"
+    x = conv(
+        ch, (3, 3), strides=stride, padding=padding,
+        feature_group_count=ch, name=f"{prefix}_depthwise",
+    )(x)
+    x = bn(name=f"{prefix}_depthwise_BN")(x)
+    x = _relu6(x)
+    x = conv(filters, (1, 1), name=f"{prefix}_project")(x)
+    x = bn(name=f"{prefix}_project_BN")(x)
+    if in_c == filters and stride == 1:
+        x = inputs + x
+    return x
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        bn = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            epsilon=BN_EPS,
+            momentum=0.999,
+            dtype=self.dtype,
+        )
+        # stem: Conv1_pad (keras correct_pad) + 3x3/2 valid
+        x = _correct_pad(x)
+        x = nn.Conv(
+            32, (3, 3), strides=2, padding="VALID", use_bias=False,
+            dtype=self.dtype, name="Conv1",
+        )(x)
+        x = bn(name="bn_Conv1")(x)
+        x = _relu6(x)
+
+        x = _inverted_res(self, x, 1, 16, 1, 0, train)
+        block_id = 1
+        for expansion, filters, repeats, first_stride in STAGES:
+            for r in range(repeats):
+                x = _inverted_res(
+                    self, x, expansion, filters,
+                    first_stride if r == 0 else 1, block_id, train,
+                )
+                block_id += 1
+
+        x = nn.Conv(
+            1280, (1, 1), use_bias=False, dtype=self.dtype, name="Conv_1"
+        )(x)
+        x = bn(name="Conv_1_bn")(x)
+        x = _relu6(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = x.astype(jnp.float32)
+        x = nn.Dense(self.num_classes, name="predictions")(x)
+        return nn.softmax(x, axis=-1)

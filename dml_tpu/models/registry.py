@@ -105,6 +105,23 @@ def _register_builtin() -> None:
                 aliases=(f"efficientnet-{variant}", f"effnet{variant}"),
             )
         )
+    def _build_mobilenet(num_classes=1000, dtype=jnp.bfloat16):
+        from .mobilenet import MobileNetV2
+
+        return MobileNetV2(num_classes=num_classes, dtype=dtype)
+
+    register(
+        ModelSpec(
+            name="MobileNetV2",
+            builder=_build_mobilenet,
+            input_size=(224, 224),
+            preprocess="tf",  # keras mobilenet_v2 preprocess = [-1, 1]
+            # light model: priors scaled well under the ResNet numbers
+            cost=CostDefaults(load_time=2.0, first_query=0.5, per_query=0.08),
+            aliases=("mobilenet", "mobilenet-v2", "mobilenetv2"),
+        )
+    )
+
     def _build_vit(variant, num_classes=1000, dtype=jnp.bfloat16):
         from . import vit
 

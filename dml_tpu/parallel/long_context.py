@@ -126,6 +126,7 @@ class LongContextLM:
         }
         self._state_sh = partition_params(state, mesh)
         self.state = jax.device_put(state, self._state_sh)
+        self._gen_cache: Dict[Any, Any] = {}  # decode-config -> jitted fn
         tok_sh = NamedSharding(mesh, P("dp", "sp"))
         logits_sh = NamedSharding(mesh, P("dp", "sp", None))
         repl = NamedSharding(mesh, P())
@@ -187,7 +188,8 @@ class LongContextLM:
         seed: int = 0,
     ) -> np.ndarray:
         """Autoregressive decoding with the trained weights (KV-cache
-        path, inference/generate.py). Dense-FFN configs only."""
+        path, inference/generate.py); MoE blocks decode with exact
+        per-token top-2 routing."""
         from ..inference.generate import LMConfig, generate as _generate
 
         m = self.model
@@ -195,13 +197,25 @@ class LongContextLM:
             vocab_size=m.vocab_size, d_model=m.d_model, n_heads=m.n_heads,
             n_layers=m.n_layers, d_ff=m.d_ff, dtype=m.dtype,
         )
+        # one jitted closure per decode config, cached — repeated
+        # serving calls must not re-trace the n_layers decode graph
+        key = (prompt.shape, max_new_tokens, temperature, top_k)
+        fn = self._gen_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, pr, r: _generate(
+                    p, cfg, pr, max_new_tokens,
+                    temperature=temperature, top_k=top_k, rng=r,
+                )
+            )
+            self._gen_cache[key] = fn
         # params pass through with their training shardings — decoding
         # works on sharded arrays (XLA gathers what each op needs);
         # force-replicating here would double parameter HBM and defeat
         # the tp sharding for models that only fit partitioned
-        return np.asarray(_generate(
-            self.state["params"], cfg, jnp.asarray(prompt.astype(np.int32)),
-            max_new_tokens, temperature=temperature, top_k=top_k, seed=seed,
+        return np.asarray(fn(
+            self.state["params"], jnp.asarray(prompt.astype(np.int32)),
+            jax.random.PRNGKey(seed),
         ))
 
     def save_checkpoint(self, directory: str, keep: int = 3) -> str:

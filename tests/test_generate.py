@@ -79,15 +79,26 @@ def test_sampling_temperature_and_topk(lm):
     np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
 
 
-def test_moe_blocks_rejected(lm):
+def test_moe_decode_matches_full_forward():
+    # ample capacity_factor: the full forward drops nothing, so the
+    # (exact) per-token decode routing must match it position-by-position
     lm_moe = TransformerLM(
         vocab_size=CFG.vocab_size, d_model=32, n_heads=2, n_layers=2,
-        d_ff=64, num_experts=4, moe_every=2, dtype=jnp.float32,
+        d_ff=64, num_experts=4, moe_every=2, capacity_factor=16.0,
+        dtype=jnp.float32,
     )
-    tokens = jnp.zeros((1, 4), jnp.int32)
+    tokens = jnp.asarray(
+        np.random.RandomState(5).randint(0, CFG.vocab_size, (2, 6)), jnp.int32
+    )
     variables = lm_moe.init(jax.random.PRNGKey(0), tokens)
-    with pytest.raises(NotImplementedError):
-        generate(variables["params"], CFG, tokens, 2)
+    params = variables["params"]
+    full = np.asarray(lm_moe.apply({"params": params}, tokens))
+    cache = init_cache(CFG, 2, 6)
+    for t in range(6):
+        logits, cache = decode_step(params, CFG, cache, tokens[:, t], jnp.int32(t))
+        np.testing.assert_allclose(
+            np.asarray(logits), full[:, t], atol=3e-4, err_msg=f"pos {t}"
+        )
 
 
 def test_longcontext_lm_generate_end_to_end():

@@ -32,6 +32,7 @@ def _keras():
     [
         ("ResNet50", lambda tf: tf.keras.applications.ResNet50(weights=None)),
         ("InceptionV3", lambda tf: tf.keras.applications.InceptionV3(weights=None)),
+        ("MobileNetV2", lambda tf: tf.keras.applications.MobileNetV2(weights=None)),
     ],
 )
 def test_keras_parity(name, keras_builder):
