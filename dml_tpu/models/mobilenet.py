@@ -39,15 +39,15 @@ def _relu6(x):
     return jnp.minimum(jnp.maximum(x, 0.0), 6.0)
 
 
-def _correct_pad(x):
-    """keras imagenet_utils.correct_pad for a 3x3 kernel: ((0,1),(0,1))
-    on even spatial sizes, ((1,1),(1,1)) on odd — shapes are static
-    under jit, so this resolves at trace time."""
-    h, w = x.shape[1], x.shape[2]
-    return jnp.pad(
-        x,
-        ((0, 0), (1 - h % 2, 1), (1 - w % 2, 1), (0, 0)),
-    )
+def _pad_for_stride2(x):
+    """Keras imagenet_utils.correct_pad for the 3x3 stride-2 convs —
+    delegates to efficientnet's `_correct_pad`, the one tested copy of
+    the rule (even sizes pad (0,1), odd (1,1); easy to invert, and an
+    inversion silently shifts every downstream activation)."""
+    from .efficientnet import _correct_pad
+
+    pads = _correct_pad(3, (x.shape[1], x.shape[2]))
+    return jnp.pad(x, ((0, 0), *pads, (0, 0)))
 
 
 def _inverted_res(mdl, x, expansion, filters, stride, block_id, train):
@@ -70,7 +70,7 @@ def _inverted_res(mdl, x, expansion, filters, stride, block_id, train):
         x = _relu6(x)
     ch = x.shape[-1]
     if stride == 2:
-        x = _correct_pad(x)
+        x = _pad_for_stride2(x)
         padding = "VALID"
     else:
         padding = "SAME"
@@ -102,7 +102,7 @@ class MobileNetV2(nn.Module):
             dtype=self.dtype,
         )
         # stem: Conv1_pad (keras correct_pad) + 3x3/2 valid
-        x = _correct_pad(x)
+        x = _pad_for_stride2(x)
         x = nn.Conv(
             32, (3, 3), strides=2, padding="VALID", use_bias=False,
             dtype=self.dtype, name="Conv1",

@@ -535,3 +535,44 @@ async def test_post_restore_relay_arriving_before_restore_relay(tmp_path):
         await asyncio.sleep(0.1)
         assert 7 in sb.scheduler.jobs  # survived, no rollback
         assert sb._shadow_gen == 1
+
+
+async def test_node_joining_midjob_takes_work(tmp_path):
+    """Elasticity: a node that (re)joins while a job is running gets
+    scheduled batches (the reference's worker pool is a hardcoded
+    H3..H10 slice, worker.py:52 — ours is the live membership)."""
+    async with cluster(4, tmp_path, 23100) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        late_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 3)
+        client = sim.jobs[client_u]
+
+        # take H4 down before the job starts
+        late_id = sim.spec.node_by_name("H4")
+        await sim.stop_node(late_u)
+        await sim.wait_for(
+            lambda: all(
+                len(n.membership.alive_nodes()) == 3
+                for n in sim.nodes.values()
+            ),
+            what="cluster settles at 3 nodes",
+        )
+
+        # slow batches so the job outlives the rejoin
+        for be in sim.backends.values():
+            be.per_model_delay["ResNet50"] = 0.25
+
+        job_id = await client.submit_job("ResNet50", 320)  # 10 batches
+
+        # H4 comes back mid-job
+        await sim.start_node(late_id)
+        sim.backends[late_u].per_model_delay["ResNet50"] = 0.25
+        await sim.wait_for(
+            lambda: sim.nodes[late_u].joined, what="late node joined"
+        )
+
+        done = await client.wait_job(job_id, timeout=40.0)
+        assert done["total_queries"] == 320
+        # the late joiner actually executed batches
+        assert sim.backends[late_u].calls, "late node never got work"
