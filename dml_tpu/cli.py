@@ -56,6 +56,8 @@ job commands (ML inference):
   predict-locally <model> <f...>    single-node inference on local files
   save-model <model>                publish weights into the store
   load-model <model> [version]      load published weights for serving
+  models                            resident models + HBM footprint
+  unload-model <model>              evict a model's weights from HBM
   checkpoint-jobs                   snapshot scheduler state into the store
   restore-jobs [version] [force]    restore scheduler state (coordinator)
   C1                                per-model query counts + rates
@@ -183,6 +185,20 @@ class NodeApp:
         elif cmd == "load-model" and a:
             await j.load_model_weights(a[0], int(a[1]) if len(a) > 1 else None)
             print("ok loaded")
+        elif cmd == "models":
+            eng = j._engine
+            if eng is None:
+                print("(engine not started — no models resident)")
+            else:
+                for m, st in sorted(eng.memory_stats().items()):
+                    print(f"{m}: {st['param_mb']} MB in HBM, "
+                          f"batch_size={st['batch_size']:.0f}")
+                if not eng.loaded_models:
+                    print("(no models resident)")
+        elif cmd == "unload-model" and len(a) == 1:
+            eng = j._engine
+            ok = eng is not None and eng.unload_model(a[0])
+            print("ok evicted" if ok else "not resident")
         elif cmd == "checkpoint-jobs":
             r = await j.checkpoint_jobs()
             print(f"ok version={r['version']} replicas={r['replicas']}")

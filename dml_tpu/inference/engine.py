@@ -75,6 +75,7 @@ class _LoadedModel:
     load_time: float = 0.0
     first_query: float = 0.0
     per_query: float = 0.0
+    explicit_weights: bool = False  # loaded from a checkpoint/the store
 
 
 class InferenceEngine:
@@ -88,6 +89,9 @@ class InferenceEngine:
         self.dtype = dtype
         self.device = device or jax.devices()[0]
         self._models: Dict[str, _LoadedModel] = {}
+        # models evicted while serving EXPLICIT weights: a later lazy
+        # load must not silently fall back to random init
+        self._evicted_explicit: set = set()
 
     # ---- loading ----
 
@@ -122,8 +126,17 @@ class InferenceEngine:
                 batch_size = cached.batch_size
             del self._models[key]
         t0 = time.monotonic()
+        explicit = variables is not None
         if variables is None:
+            if key in self._evicted_explicit:
+                raise RuntimeError(
+                    f"{key} was evicted while serving explicit weights; "
+                    "reload them (load-model) — refusing to silently "
+                    "serve random init"
+                )
             variables = init_variables(spec, seed=seed, dtype=self.dtype)
+        else:
+            self._evicted_explicit.discard(key)
         variables = jax.device_put(variables, self.device)
         model = spec.build(dtype=self.dtype)
 
@@ -149,6 +162,7 @@ class InferenceEngine:
             batch_size=batch_size or spec.cost.default_batch_size,
             num_classes=int(pred.shape[-1]),
             seed=seed,
+            explicit_weights=explicit,
         )
         lm.load_time = time.monotonic() - t0
         self._models[key] = lm
@@ -168,6 +182,29 @@ class InferenceEngine:
         jax.block_until_ready(lm.forward(lm.variables, dummy))
         steady_batch = time.monotonic() - t0
         lm.per_query = steady_batch / lm.batch_size
+
+    def unload_model(self, name: str) -> bool:
+        """Evict a model's weights from HBM (the reference has no
+        notion of this — its 'models' are Keras objects re-created per
+        process). Returns True if it was resident."""
+        key = get_model(name).name
+        lm = self._models.pop(key, None)
+        if lm is not None and lm.explicit_weights:
+            self._evicted_explicit.add(key)
+        return lm is not None
+
+    def memory_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-resident-model parameter footprint (HBM bytes)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for key, lm in self._models.items():
+            n_bytes = sum(
+                leaf.nbytes for leaf in jax.tree_util.tree_leaves(lm.variables)
+            )
+            out[key] = {
+                "param_mb": round(n_bytes / 1e6, 2),
+                "batch_size": lm.batch_size,
+            }
+        return out
 
     def set_batch_size(self, name: str, batch_size: int) -> None:
         """C3 verb (reference SET_BATCH_SIZE, worker.py:1028-1037).

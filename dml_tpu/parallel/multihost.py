@@ -1,0 +1,116 @@
+"""Multi-host execution: JAX distributed runtime wired to the cluster spec.
+
+The reference scales across VMs with hand-rolled UDP + scp
+(SURVEY §2 "Distributed communication backend"); the TPU-native
+equivalent is one JAX process per TPU host, all submitting the SAME
+jitted program over a global mesh — XLA runs collectives over ICI
+within a slice and DCN across slices. The control plane (membership,
+store, scheduler) stays on the asyncio stack; THIS module is the
+compute-plane bootstrap:
+
+- `initialize_from_spec(spec, me)`: derive coordinator address and
+  process id from the shared ClusterSpec (the same file every role
+  already loads) and call `jax.distributed.initialize` — after which
+  `jax.devices()` spans every host's chips.
+- `global_mesh(...)`: build the framework Mesh over the global device
+  set (same axes dp/tp/sp/pp/ep as single-host).
+- `global_batch(...)`: assemble each host's local shard of a batch
+  into one global jax.Array laid out per the mesh sharding
+  (`jax.make_array_from_process_local_data`), which is how per-host
+  input pipelines (data.Prefetcher on each host's local files) feed a
+  globally-sharded train step.
+
+Single-host degenerates cleanly: num_processes=1 skips distributed
+init, and every helper works unchanged on the local mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ClusterSpec, MeshSpec, NodeId
+from .mesh import make_mesh
+
+log = logging.getLogger(__name__)
+
+# jax.distributed's coordinator listens on its own port, offset from
+# the node's control-plane UDP port (like the store's data plane)
+JAX_COORD_PORT_OFFSET = 20_000
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+) -> None:
+    """Idempotent `jax.distributed.initialize` wrapper. No-op for a
+    single process (local jax works without the distributed runtime)."""
+    global _initialized
+    if _initialized or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "jax.distributed up: process %d/%d, %d global / %d local devices",
+        process_id, num_processes, len(jax.devices()),
+        len(jax.local_devices()),
+    )
+
+
+def initialize_from_spec(spec: ClusterSpec, me: NodeId) -> int:
+    """Derive the distributed-runtime wiring from the cluster spec:
+    coordinator = the spec's first node (stable, like the introducer
+    bootstrap), process_id = this node's index in the node table.
+    Returns the process id."""
+    nodes: List[NodeId] = list(spec.nodes)
+    try:
+        process_id = next(
+            i for i, n in enumerate(nodes)
+            if n.unique_name == me.unique_name
+        )
+    except StopIteration:
+        raise ValueError(f"{me.unique_name} not in cluster spec") from None
+    head = nodes[0]
+    initialize(
+        f"{head.host}:{head.port + JAX_COORD_PORT_OFFSET}",
+        num_processes=len(nodes),
+        process_id=process_id,
+    )
+    return process_id
+
+
+def global_mesh(
+    mesh_spec: Optional[MeshSpec] = None,
+) -> Mesh:
+    """The framework mesh over the GLOBAL device set (all hosts).
+    After initialize(), jax.devices() includes remote hosts' chips;
+    axis semantics are identical to the single-host mesh."""
+    return make_mesh(mesh_spec, devices=jax.devices())
+
+
+def global_batch(
+    local_data: np.ndarray,
+    mesh: Mesh,
+    spec: P = P("dp"),
+) -> jax.Array:
+    """Assemble per-process local batch shards into one global array.
+
+    Each host passes its own shard (e.g. from its local Prefetcher);
+    the result is a global jax.Array sharded per `spec`, ready for a
+    jitted step with matching in_shardings — no host ever materializes
+    the full global batch.
+    """
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_data
+    )

@@ -45,6 +45,11 @@ def main() -> None:
     ]
     spec = get_model(args.model)
     ds = ImageDataset(samples, spec.input_size, args.batch_size)
+    if len(ds) == 0:
+        raise SystemExit(
+            f"dataset has {len(samples)} samples — fewer than "
+            f"--batch-size {args.batch_size} (full batches are dropped)"
+        )
 
     mesh = local_mesh(dp=args.dp, tp=args.tp)
     tr = Trainer(

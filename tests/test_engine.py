@@ -65,3 +65,29 @@ def test_set_batch_size(engine):
 def test_unloaded_model_raises(engine):
     with pytest.raises(KeyError):
         engine.cost_constants("InceptionV3")
+
+
+def test_unload_and_memory_stats(engine):
+    stats = engine.memory_stats()
+    assert "TinyNet" in stats and stats["TinyNet"]["param_mb"] > 0
+    assert engine.unload_model("TinyNet")
+    assert "TinyNet" not in engine.loaded_models
+    assert not engine.unload_model("TinyNet")  # already gone
+    # reload works after eviction
+    engine.load_model("TinyNet", batch_size=4, warmup=False)
+    assert engine.loaded_models == ["TinyNet"]
+
+
+def test_evicted_explicit_weights_refuse_silent_reinit(engine):
+    import jax
+
+    # load explicit weights, evict, then a lazy load must refuse
+    lm = engine.load_model("TinyNet", batch_size=4, warmup=False)
+    explicit = jax.device_get(lm.variables)
+    engine.load_model("TinyNet", variables=explicit, warmup=False)
+    assert engine.unload_model("TinyNet")
+    with pytest.raises(RuntimeError, match="explicit weights"):
+        engine.load_model("TinyNet", warmup=False)
+    # reloading explicit weights clears the guard
+    engine.load_model("TinyNet", variables=explicit, warmup=False)
+    assert engine.loaded_models == ["TinyNet"]
