@@ -186,19 +186,14 @@ class NodeApp:
             await j.load_model_weights(a[0], int(a[1]) if len(a) > 1 else None)
             print("ok loaded")
         elif cmd == "models":
-            eng = j._engine
-            if eng is None:
-                print("(engine not started — no models resident)")
-            else:
-                for m, st in sorted(eng.memory_stats().items()):
-                    print(f"{m}: {st['param_mb']} MB in HBM, "
-                          f"batch_size={st['batch_size']:.0f}")
-                if not eng.loaded_models:
-                    print("(no models resident)")
+            stats = j.engine_memory_stats()
+            for m, st in sorted(stats.items()):
+                print(f"{m}: {st['param_mb']} MB in HBM, "
+                      f"batch_size={st['batch_size']:.0f}")
+            if not stats:
+                print("(no models resident)")
         elif cmd == "unload-model" and len(a) == 1:
-            eng = j._engine
-            ok = eng is not None and eng.unload_model(a[0])
-            print("ok evicted" if ok else "not resident")
+            print("ok evicted" if j.unload_model(a[0]) else "not resident")
         elif cmd == "checkpoint-jobs":
             r = await j.checkpoint_jobs()
             print(f"ok version={r['version']} replicas={r['replicas']}")

@@ -137,6 +137,10 @@ class ClusterSpec:
     mesh: MeshSpec = field(default_factory=MeshSpec)
     testing: bool = False
     packet_drop_pct: float = 0.0  # loss-injection seam (reference protocol.py:10)
+    # >0: the coordinator snapshots scheduler state into the store
+    # every N seconds while jobs are in flight (full-restart survival
+    # without operator-driven checkpoint-jobs); 0 disables
+    jobs_checkpoint_interval: float = 0.0
 
     # ---- lookups (reference Config.get_node*, config.py:116-144) ----
     # The node universe is static (like the reference's H1..H10 table),

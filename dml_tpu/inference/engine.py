@@ -121,9 +121,13 @@ class InferenceEngine:
             # explicit new weights or batch size: rebuild, don't silently
             # serve the stale entry — but a reload without an explicit
             # batch size keeps the serving one (a C3 set_batch_size must
-            # survive a weight rollout)
+            # survive a weight rollout), and a reshape/reseed reload of
+            # a model serving EXPLICIT weights keeps those weights (a
+            # silent fall-through to random init would serve garbage)
             if batch_size is None:
                 batch_size = cached.batch_size
+            if variables is None and cached.explicit_weights:
+                variables = cached.variables
             del self._models[key]
         t0 = time.monotonic()
         explicit = variables is not None

@@ -58,6 +58,9 @@ class Batch:
     # file -> version pinned at assignment time, so a re-PUT during the
     # job can't make workers serve mixed generations of an input
     versions: Dict[str, int] = field(default_factory=dict)
+    # times a live worker reported failure for this batch (deterministic
+    # failures must eventually fail the JOB, not requeue forever)
+    failures: int = 0
 
     @property
     def key(self) -> Tuple[int, int]:
@@ -75,6 +78,7 @@ class JobState:
     total_queries: int
     pending_batches: int
     done: bool = False
+    error: Optional[str] = None  # set when the job FAILED (batch cap)
     # batch ids already counted done — guards double-decrement when a
     # falsely-suspected worker's ACK races the reassigned copy's ACK
     completed_batches: set = field(default_factory=set)
@@ -109,6 +113,10 @@ class Scheduler:
         # ACKs without growing with coordinator lifetime
         self.done_jobs: Dict[int, JobState] = {}
         self.max_done_jobs = 1000
+        # a batch failing this many times on LIVE workers fails its job
+        # loudly instead of front-requeuing forever
+        self.max_batch_failures = 5
+        self._newly_failed: List[JobState] = []
         self._job_counter = 0
         # metrics (reference worker.py:485-495, 1000-1001); bounded
         # deques so a long-lived coordinator doesn't grow forever
@@ -386,8 +394,30 @@ class Scheduler:
             # worker but never requeue (a deterministically-failing
             # orphan batch would loop forever)
             return None
+        cur.failures += 1
+        if cur.failures >= self.max_batch_failures:
+            # deterministic failure: fail the JOB loudly; an infinite
+            # fail/requeue loop would pin a worker forever while the
+            # client waits
+            st.error = (
+                f"batch {batch_id} failed {cur.failures} times on live "
+                "workers"
+            )
+            st.done = True
+            # drop this job's other queued batches too
+            q = self._queue(cur.model)
+            for b in [b for b in q if b.job_id == job_id]:
+                q.remove(b)
+            self._retire_job(job_id)
+            self._newly_failed.append(st)
+            return None
         self._queue(cur.model).appendleft(cur)
         return cur
+
+    def pop_failed_jobs(self) -> List[JobState]:
+        """Jobs failed since the last call (service notifies clients)."""
+        out, self._newly_failed = self._newly_failed, []
+        return out
 
     def on_worker_failed(self, worker: str) -> Optional[Batch]:
         """Worker died: requeue its in-flight batch at the FRONT
@@ -507,6 +537,7 @@ class Scheduler:
                 "model": b.model, "files": list(b.files),
                 "replicas": {f: list(r) for f, r in b.replicas.items()},
                 "versions": dict(b.versions),
+                "failures": b.failures,
             }
 
         queues: Dict[str, List[Dict[str, Any]]] = {
@@ -524,6 +555,7 @@ class Scheduler:
                     "total_queries": j.total_queries,
                     "pending_batches": j.pending_batches,
                     "done": j.done,
+                    "error": j.error,
                     "completed_batches": sorted(j.completed_batches),
                 }
                 for j in self.jobs.values()

@@ -133,8 +133,43 @@ class JobService:
         self._sched_task = asyncio.create_task(
             self._schedule_loop(), name=f"{self.node.me}-sched"
         )
+        interval = getattr(self.node.spec, "jobs_checkpoint_interval", 0.0)
+        if interval and interval > 0:
+            self._ckpt_task = asyncio.create_task(
+                self._auto_checkpoint_loop(interval),
+                name=f"{self.node.me}-autockpt",
+            )
+
+    async def _auto_checkpoint_loop(self, interval: float) -> None:
+        """Periodic coordinator snapshots while work is in flight —
+        the automated version of the checkpoint-jobs verb, so a full
+        cluster restart can always restore the latest queues."""
+        was_busy = False
+        while True:
+            await asyncio.sleep(interval)
+            if self._me != self.node.leader_unique:
+                continue
+            busy = bool(self.scheduler.jobs or self.scheduler.queue_depths())
+            if not busy and not was_busy:
+                continue  # steady idle: latest snapshot already drained
+            # snapshot while busy AND once more on the busy->idle edge —
+            # otherwise the newest snapshot forever shows the last busy
+            # state and a restore would resurrect completed jobs
+            try:
+                await self.checkpoint_jobs()
+                was_busy = busy
+            except Exception:
+                log.exception("%s: auto checkpoint failed", self._me)
 
     async def stop(self) -> None:
+        ct = getattr(self, "_ckpt_task", None)
+        if ct is not None:
+            ct.cancel()
+            try:
+                await ct
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._ckpt_task = None
         for t in (self._sched_task, self._current[1] if self._current else None):
             if t is not None:
                 t.cancel()
@@ -236,7 +271,12 @@ class JobService:
             return fut.result()
 
         try:
-            return await asyncio.wait_for(waiter(), timeout)
+            result = await asyncio.wait_for(waiter(), timeout)
+            if result.get("error"):
+                raise RuntimeError(
+                    f"job {job_id} failed: {result['error']}"
+                )
+            return result
         finally:
             if fut.done():
                 self._job_done.pop(job_id, None)
@@ -544,6 +584,7 @@ class JobService:
                 "job_id": st.job_id if st else None,
                 "model": st.model if st else None,
                 "total_queries": st.total_queries if st else 0,
+                "error": st.error if st else None,
             },
         )
 
@@ -572,6 +613,17 @@ class JobService:
             log.info(
                 "%s: batch %s failed on %s (%s); requeued",
                 self._me, b.key, msg.sender, msg.data.get("error"),
+            )
+        for st in self.scheduler.pop_failed_jobs():
+            # the batch hit the failure cap: fail the JOB loudly (the
+            # alternative is an infinite fail/requeue loop pinning a
+            # worker while the client waits forever)
+            log.error("%s: job %d FAILED: %s", self._me, st.job_id, st.error)
+            self.node.send_unique(
+                st.requester,
+                MsgType.SUBMIT_JOB_REQUEST_SUCCESS,
+                {"job_id": st.job_id, "model": st.model,
+                 "total_queries": st.total_queries, "error": st.error},
             )
         self._run_schedule()
 
@@ -1000,6 +1052,15 @@ class JobService:
             "stale until the next checkpoint", self._me, version,
         )
 
+    def engine_memory_stats(self) -> Dict[str, Dict[str, float]]:
+        """Resident models + HBM footprint (empty if the engine never
+        started — don't boot jax just to report nothing)."""
+        return self._engine.memory_stats() if self._engine else {}
+
+    def unload_model(self, model: str) -> bool:
+        """Evict a model's weights from HBM on this node."""
+        return bool(self._engine) and self._engine.unload_model(model)
+
     def _ensure_engine(self):
         if self._engine is None:
             from ..inference.engine import InferenceEngine
@@ -1016,6 +1077,16 @@ class JobService:
     ) -> Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]:
         eng = self._ensure_engine()
         if model not in eng.loaded_models:
-            await asyncio.to_thread(eng.load_model, model)
+            try:
+                await asyncio.to_thread(eng.load_model, model)
+            except RuntimeError:
+                # the model was evicted while serving explicit weights:
+                # recover them from the store instead of failing the
+                # batch (load_model refuses silent random re-init)
+                log.warning(
+                    "%s: %s evicted with explicit weights; refetching "
+                    "from the store", self._me, model,
+                )
+                await self.load_model_weights(model)
         res = await eng.infer_files_async(model, paths)
         return res.to_json_dict(), res.infer_time, eng.cost_constants(model)

@@ -91,3 +91,19 @@ def test_evicted_explicit_weights_refuse_silent_reinit(engine):
     # reloading explicit weights clears the guard
     engine.load_model("TinyNet", variables=explicit, warmup=False)
     assert engine.loaded_models == ["TinyNet"]
+
+
+def test_reload_with_new_batch_size_keeps_explicit_weights(engine):
+    import jax
+    import numpy as np
+
+    lm = engine.load_model("TinyNet", batch_size=4, warmup=False)
+    explicit = jax.device_get(lm.variables)
+    engine.load_model("TinyNet", variables=explicit, warmup=False)
+    # reshape reload without passing weights: must keep the explicit ones
+    lm2 = engine.load_model("TinyNet", batch_size=2, warmup=False)
+    assert lm2.batch_size == 2 and lm2.explicit_weights
+    a = jax.tree_util.tree_leaves(jax.device_get(lm2.variables))
+    b = jax.tree_util.tree_leaves(explicit)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -576,3 +576,62 @@ async def test_node_joining_midjob_takes_work(tmp_path):
         assert done["total_queries"] == 320
         # the late joiner actually executed batches
         assert sim.backends[late_u].calls, "late node never got work"
+
+
+async def test_auto_checkpoint_loop(tmp_path):
+    """With jobs_checkpoint_interval set, the coordinator snapshots
+    in-flight work into the store without operator action."""
+    async with cluster(3, tmp_path, 23200,
+                       jobs_checkpoint_interval=0.2) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        await sim.seed_images(client_u, 2)
+        client = sim.jobs[client_u]
+        gate = asyncio.Event()
+        for be in sim.backends.values():
+            be.gate = gate
+        job_id = await client.submit_job("ResNet50", 64)
+        coord = sim.coordinator_jobs()
+        # within a few intervals the snapshot appears in the store
+        from dml_tpu.jobs.service import JobService
+
+        async def snapshot_exists():
+            files = await client.store.ls_all(JobService.JOBS_CKPT_NAME)
+            return bool(files)
+
+        deadline = asyncio.get_running_loop().time() + 5
+        found = False
+        while asyncio.get_running_loop().time() < deadline:
+            if await snapshot_exists():
+                found = True
+                break
+            await asyncio.sleep(0.1)
+        assert found, "auto checkpoint never landed in the store"
+        gate.set()
+        done = await client.wait_job(job_id, timeout=20.0)
+        assert done["total_queries"] == 64
+
+
+async def test_deterministic_batch_failure_fails_job_loudly(tmp_path):
+    """A batch failing max_batch_failures times on live workers fails
+    the JOB with an error surfaced to the client — not an infinite
+    front-requeue loop (reference has no such cap)."""
+    async with cluster(3, tmp_path, 23300) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        await sim.seed_images(client_u, 2)
+        client = sim.jobs[client_u]
+        for be in sim.backends.values():
+            be.fail_times = 1000  # deterministic failure everywhere
+
+        job_id = await client.submit_job("ResNet50", 8)
+        try:
+            await client.wait_job(job_id, timeout=20.0)
+            assert False, "expected job failure"
+        except RuntimeError as e:
+            assert "failed" in str(e)
+        coord = sim.coordinator_jobs()
+        st = coord.scheduler.job_state(job_id)
+        assert st.done and st.error
+        # workers are all free again (no pinned batch)
+        assert not coord.scheduler.in_progress
