@@ -88,6 +88,10 @@ class MsgType(enum.IntEnum):
     # standby ack (echoes rid) once its shadow restore completed;
     # unregistered on purpose: the dispatcher's rid fallback resolves it
     JOBS_RESTORE_RELAY_ACK = 75
+    # coordinator -> standby: a job hit the batch-failure cap and was
+    # retired with an error; the shadow must drop it too or a failover
+    # resurrects work the client was already told failed
+    JOB_FAILED_RELAY = 76
 
 
 @dataclass(frozen=True)

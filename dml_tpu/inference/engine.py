@@ -197,6 +197,11 @@ class InferenceEngine:
             self._evicted_explicit.add(key)
         return lm is not None
 
+    def evicted_with_explicit_weights(self, name: str) -> bool:
+        """True when `name` was unloaded while serving explicit weights
+        (a lazy load would refuse; callers should refetch instead)."""
+        return get_model(name).name in self._evicted_explicit
+
     def memory_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-resident-model parameter footprint (HBM bytes)."""
         out: Dict[str, Dict[str, float]] = {}

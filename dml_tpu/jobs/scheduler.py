@@ -399,20 +399,31 @@ class Scheduler:
             # deterministic failure: fail the JOB loudly; an infinite
             # fail/requeue loop would pin a worker forever while the
             # client waits
-            st.error = (
+            self.fail_job(
+                job_id,
                 f"batch {batch_id} failed {cur.failures} times on live "
-                "workers"
+                "workers",
             )
-            st.done = True
-            # drop this job's other queued batches too
-            q = self._queue(cur.model)
-            for b in [b for b in q if b.job_id == job_id]:
-                q.remove(b)
-            self._retire_job(job_id)
-            self._newly_failed.append(st)
             return None
         self._queue(cur.model).appendleft(cur)
         return cur
+
+    def fail_job(self, job_id: int, error: str) -> Optional[JobState]:
+        """Retire a job as FAILED: record the error, purge its queued
+        batches, notify path via pop_failed_jobs. Used by the
+        coordinator (batch cap) and by the standby applying a
+        JOB_FAILED_RELAY so failover can't resurrect the job."""
+        st = self.jobs.get(job_id)
+        if st is None:
+            return None
+        st.error = error
+        st.done = True
+        q = self._queue(st.model)
+        for b in [b for b in q if b.job_id == job_id]:
+            q.remove(b)
+        self._retire_job(job_id)
+        self._newly_failed.append(st)
+        return st
 
     def pop_failed_jobs(self) -> List[JobState]:
         """Jobs failed since the last call (service notifies clients)."""

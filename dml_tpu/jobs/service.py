@@ -91,6 +91,9 @@ class JobService:
         )
         # submit idempotency tokens -> job id
         self._submit_tokens: BoundedDict = BoundedDict(1000)
+        # model -> pinned store version currently served (for recovery
+        # after an eviction; "latest" is resolved at load time)
+        self._served_weight_version: Dict[str, Optional[int]] = {}
         # --- shadow-restore relay protocol state ---
         # coordinator: every relay carries a generation; restore-jobs
         # bumps it, so "sent after the restore" is observable on the
@@ -145,19 +148,27 @@ class JobService:
         the automated version of the checkpoint-jobs verb, so a full
         cluster restart can always restore the latest queues."""
         was_busy = False
+        edge_pending = False
         while True:
             await asyncio.sleep(interval)
             if self._me != self.node.leader_unique:
                 continue
             busy = bool(self.scheduler.jobs or self.scheduler.queue_depths())
-            if not busy and not was_busy:
+            # busy-state observation is independent of snapshot success:
+            # a failed tick must not suppress the busy->idle edge
+            # snapshot (the drained state has to land eventually, or a
+            # post-restart restore resurrects completed jobs)
+            if busy:
+                was_busy = True
+            elif was_busy:
+                was_busy = False
+                edge_pending = True
+            if not busy and not edge_pending:
                 continue  # steady idle: latest snapshot already drained
-            # snapshot while busy AND once more on the busy->idle edge —
-            # otherwise the newest snapshot forever shows the last busy
-            # state and a restore would resurrect completed jobs
             try:
                 await self.checkpoint_jobs()
-                was_busy = busy
+                if not busy:
+                    edge_pending = False
             except Exception:
                 log.exception("%s: auto checkpoint failed", self._me)
 
@@ -344,6 +355,7 @@ class JobService:
         n.register(MsgType.SUBMIT_JOB_REQUEST_SUCCESS, self._h_job_success)
         n.register(MsgType.SUBMIT_JOB_RELAY, self._h_submit_relay)
         n.register(MsgType.JOBS_RESTORE_RELAY, self._h_restore_relay)
+        n.register(MsgType.JOB_FAILED_RELAY, self._h_job_failed_relay)
         n.register(MsgType.WORKER_TASK_REQUEST, self._h_task_request)
         n.register(MsgType.WORKER_TASK_REQUEST_ACK, self._h_task_ack)
         n.register(MsgType.WORKER_TASK_FAIL, self._h_task_fail)
@@ -625,6 +637,15 @@ class JobService:
                 {"job_id": st.job_id, "model": st.model,
                  "total_queries": st.total_queries, "error": st.error},
             )
+            # the standby's shadow must drop the job too, or a
+            # failover resurrects work the client was told failed
+            sb = self.store.standby_node()
+            if sb is not None and sb.unique_name != self._me:
+                self.node.send(
+                    sb, MsgType.JOB_FAILED_RELAY,
+                    {"job": st.job_id, "error": st.error,
+                     "gen": self._relay_gen},
+                )
         self._run_schedule()
 
     def _on_node_failed(self, uname: str) -> None:
@@ -702,6 +723,24 @@ class JobService:
             int(msg.data["job"]), int(msg.data["batch"]),
             int(msg.data.get("n_images", 0)),
         )
+
+    async def _h_job_failed_relay(self, msg: Message, addr) -> None:
+        if msg.sender != self.node.leader_unique or self._gen_stale(msg):
+            return
+        self._relay_log.append(
+            (msg.sender, self._gen_of(msg), self._apply_job_failed_relay, msg)
+        )
+        self._apply_job_failed_relay(msg)
+
+    def _apply_job_failed_relay(self, msg: Message) -> None:
+        st = self.scheduler.fail_job(
+            int(msg.data["job"]), str(msg.data.get("error", "failed"))
+        )
+        self.scheduler.pop_failed_jobs()  # shadow doesn't notify clients
+        if st is not None:
+            log.info(
+                "%s: shadow dropped failed job %d", self._me, st.job_id
+            )
 
     async def _h_restore_relay(self, msg: Message, addr) -> None:
         """Standby side of restore-jobs: pull the same pinned snapshot
@@ -961,12 +1000,21 @@ class JobService:
         serving engine with them."""
         from ..inference.weights import fetch_weights
 
+        from ..inference.weights import weights_name
+
         eng = self._ensure_engine()
         name = get_model(model).name
+        if version is None:
+            # pin "latest" NOW: the served version must be recoverable
+            # later even if newer versions get published in between
+            listing = await self.store.ls_all(weights_name(name))
+            vs = listing.get(weights_name(name))
+            version = max(vs) if vs else None
         variables = await fetch_weights(self.store, name, version=version)
         # engine.load_model keeps the serving batch size across a
         # reload (a C3 set_batch_size survives a weight rollout)
         await asyncio.to_thread(eng.load_model, name, variables)
+        self._served_weight_version[name] = version
 
     JOBS_CKPT_NAME = "coordinator_jobs.ckpt"
 
@@ -1077,16 +1125,20 @@ class JobService:
     ) -> Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]:
         eng = self._ensure_engine()
         if model not in eng.loaded_models:
-            try:
-                await asyncio.to_thread(eng.load_model, model)
-            except RuntimeError:
-                # the model was evicted while serving explicit weights:
-                # recover them from the store instead of failing the
-                # batch (load_model refuses silent random re-init)
+            if eng.evicted_with_explicit_weights(model):
+                # recover the SAME weights the node was serving before
+                # the eviction (pinned version — "latest" may since
+                # have moved past a deliberate rollback); any other
+                # load failure (OOM etc.) propagates untouched
+                pinned = self._served_weight_version.get(
+                    get_model(model).name
+                )
                 log.warning(
                     "%s: %s evicted with explicit weights; refetching "
-                    "from the store", self._me, model,
+                    "v%s from the store", self._me, model, pinned,
                 )
-                await self.load_model_weights(model)
+                await self.load_model_weights(model, version=pinned)
+            else:
+                await asyncio.to_thread(eng.load_model, model)
         res = await eng.infer_files_async(model, paths)
         return res.to_json_dict(), res.infer_time, eng.cost_constants(model)

@@ -635,3 +635,35 @@ async def test_deterministic_batch_failure_fails_job_loudly(tmp_path):
         assert st.done and st.error
         # workers are all free again (no pinned batch)
         assert not coord.scheduler.in_progress
+
+
+async def test_job_failure_relayed_to_standby(tmp_path):
+    """A capped-out job is dropped from the standby's shadow too — a
+    failover must not resurrect work the client was told failed."""
+    async with cluster(4, tmp_path, 23400) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 2)
+        client = sim.jobs[client_u]
+        coord_u = next(iter(sim.nodes.values())).leader_unique
+        standby_u = sim.stores[coord_u].standby_node().unique_name
+        for be in sim.backends.values():
+            be.fail_times = 1000
+
+        job_id = await client.submit_job("ResNet50", 8)
+        try:
+            await client.wait_job(job_id, timeout=20.0)
+            assert False, "expected failure"
+        except RuntimeError:
+            pass
+        sb = sim.jobs[standby_u]
+        await sim.wait_for(
+            lambda: job_id not in sb.scheduler.jobs
+            and not any(
+                b.job_id == job_id
+                for q in sb.scheduler.queues.values() for b in q
+            ),
+            what="standby shadow dropped the failed job",
+        )
+        st = sb.scheduler.job_state(job_id)
+        assert st is not None and st.error
