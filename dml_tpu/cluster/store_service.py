@@ -93,6 +93,9 @@ class StoreService:
         self._recent_deletes: BoundedDict = BoundedDict(200)
         self._resend_task: Optional[asyncio.Task] = None
         self.resend_after = max(1.0, 4 * node.spec.timing.ping_interval)
+        # (file, target) -> ask time for outstanding REPLICATE_FILEs
+        # (sweeps must not duplicate in-flight transfers)
+        self._repairs_inflight: Dict[Tuple[str, str], float] = {}
 
     async def start(self) -> None:
         await self.data_plane.start()
@@ -115,10 +118,20 @@ class StoreService:
         (covers a dropped DOWNLOAD_FILE/DELETE_FILE or a dropped ACK;
         replica handlers are idempotent so re-delivery is safe)."""
         interval = max(self.node.spec.timing.ping_interval, 0.05)
+        tick = 0
         while True:
             await asyncio.sleep(interval)
             if not self.node.is_leader:
                 continue
+            tick += 1
+            if tick % 10 == 0:
+                # periodic under-replication sweep: joins/deaths whose
+                # event-time repair raced membership convergence heal
+                # here (plan is cheap: one metadata scan, idempotent)
+                try:
+                    self._on_replication_needed([])
+                except Exception:
+                    log.exception("%s: replication sweep failed", self._me)
             now = time.monotonic()
             try:
                 for req_id, st in list(self.metadata.requests.items()):
@@ -399,6 +412,11 @@ class StoreService:
         self._relay_to_standby(
             MsgType.ALL_LOCAL_FILES_RELAY, {"node": msg.sender, "files": files}
         )
+        # a JOIN can also end under-replication: files PUT while the
+        # cluster was smaller than replication_factor gain copies the
+        # moment capacity exists (the reference repairs only on deaths,
+        # worker.py:1308-1321, so its early files stay thin forever)
+        self._on_replication_needed([msg.sender])
 
     async def _h_all_local_files_relay(self, msg: Message, addr) -> None:
         if msg.sender != self.node.leader_unique:
@@ -681,9 +699,21 @@ class StoreService:
     async def _h_replicate_result(self, msg: Message, addr) -> None:
         if not self.node.is_leader:
             return
+        file = msg.data.get("file", "")
+        self._repairs_inflight.pop((file, msg.sender), None)
         if msg.type == MsgType.REPLICATE_FILE_SUCCESS:
+            if file not in self.metadata.all_files():
+                # the file was DELETEd while the repair was in flight:
+                # recording the replica would resurrect it (and a later
+                # re-PUT's version counter would collide with the stale
+                # copy) — instead tell the holder to drop the bytes
+                self.node.send_unique(
+                    msg.sender, MsgType.DELETE_FILE,
+                    {"file": file, "rid": self.node.new_rid()},
+                )
+                return
             for v in msg.data.get("versions", []):
-                self.metadata.record_replica(msg.sender, msg.data["file"], int(v))
+                self.metadata.record_replica(msg.sender, file, int(v))
 
     # ------------------------------------------------------------------
     # failure handling (reference worker.py:1247-1321, leader.py:147-181)
@@ -741,16 +771,34 @@ class StoreService:
                     )
 
     def _on_replication_needed(self, cleaned: List[str]) -> None:
-        """Enough nodes died: bring every file back to
-        `replication_factor` copies (reference worker.py:1308-1321)."""
+        """Bring every under-replicated file back to
+        `replication_factor` copies (reference worker.py:1308-1321).
+        Runs on deaths, joins, and a periodic sweep, so it must not
+        fight in-flight work: files with an active PUT/DELETE are
+        skipped (their fan-out will finish or repair on its own), and
+        (file, target) pairs already asked to replicate are not
+        re-asked until the prior ask resolves or times out."""
         if not self.node.is_leader:
             return
         live = self._live_node_names()
+        busy = {st.file for st in self.metadata.requests.values()}
+        now = time.monotonic()
+        ttl = max(30.0, 10 * self.resend_after)
+        self._repairs_inflight = {
+            k: t for k, t in self._repairs_inflight.items() if now - t < ttl
+        }
         plan = self.metadata.replication_plan(live)
+        sent = 0
         for file, source, targets in plan:
+            if file in busy:
+                continue
             for t in targets:
+                if (file, t) in self._repairs_inflight:
+                    continue
+                self._repairs_inflight[(file, t)] = now
                 self.node.send_unique(
                     t, MsgType.REPLICATE_FILE, {"file": file, "source": source}
                 )
-        if plan:
-            log.info("%s: re-replication plan: %d files", self._me, len(plan))
+                sent += 1
+        if sent:
+            log.info("%s: re-replication: %d transfers asked", self._me, sent)

@@ -249,3 +249,39 @@ async def test_voluntary_leave_rejoin(tmp_path):
 
         node.rejoin()
         await sim.wait_converged(timeout=15.0)
+
+
+async def test_join_repairs_under_replication(tmp_path):
+    """A file PUT while the cluster is smaller than the replication
+    factor gains copies when nodes JOIN (the reference repairs only on
+    deaths, worker.py:1308-1321, so early files stay thin forever)."""
+    spec = ClusterSpec.localhost(
+        4, base_port=21900, introducer_port=21899, timing=FAST,
+        store=StoreConfig(root=str(tmp_path / "roots")),
+    )
+    sim = Sim(spec, tmp_path)
+    try:
+        await sim.dns.start()
+        first = spec.nodes[0]
+        await sim.start_node(first)
+        await sim.wait_for(
+            lambda: sim.nodes[first.unique_name].is_leader, what="solo leader"
+        )
+        u1 = first.unique_name
+        # PUT with only one node up: 1 replica
+        p = tmp_path / "thin.bin"
+        p.write_bytes(b"thin-file-data")
+        store = sim.stores[u1]
+        r = await store.put(str(p), "thin.bin")
+        assert len(r["replicas"]) == 1
+
+        # the rest join; repair must bring the file to factor copies
+        for n in spec.nodes[1:]:
+            await sim.start_node(n)
+        want = min(spec.store.replication_factor, 4)
+        await sim.wait_for(
+            lambda: len(store.metadata.replicas_of("thin.bin")) >= want,
+            timeout=15.0, what="join-time re-replication",
+        )
+    finally:
+        await sim.stop_all()
