@@ -118,10 +118,17 @@ class Prefetcher:
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
 
-    def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
-        # q/stop arrive as arguments (not self attributes): this thread
-        # must stay bound to ITS iteration's channel even after a later
-        # __iter__ replaces the instance state
+    def _produce(
+        self,
+        q: "queue.Queue",
+        stop: threading.Event,
+        error: list,
+    ) -> None:
+        # q/stop/error arrive as arguments (not self attributes): this
+        # thread must stay bound to ITS iteration's channels even after
+        # a later __iter__ replaces the instance state (a dying
+        # abandoned producer must never clobber a newer iteration's
+        # error slot)
         try:
             for batch in self.dataset.batch_plan(self.epoch_idx):
                 if stop.is_set():
@@ -134,7 +141,7 @@ class Prefetcher:
                     labels = jax.device_put(labels, self.device)
                 q.put((images, labels))
         except BaseException as e:  # surfaced on the consumer side
-            self._error = e
+            error.append(e)
         finally:
             q.put(self._DONE)
 
@@ -148,10 +155,11 @@ class Prefetcher:
         # down its own producer, never a later iteration's (the self.*
         # attributes get replaced on the next __iter__).
         q = self._q = queue.Queue(maxsize=self.depth)
+        error: list = []  # one-slot channel owned by THIS iteration
         self._error = None
         stop = self._stop = threading.Event()
         thread = self._thread = threading.Thread(
-            target=self._produce, args=(q, stop),
+            target=self._produce, args=(q, stop, error),
             name="dml-prefetch", daemon=True,
         )
         thread.start()
@@ -159,8 +167,9 @@ class Prefetcher:
             while True:
                 item = q.get()
                 if item is self._DONE:
-                    if self._error is not None:
-                        raise self._error
+                    if error:
+                        self._error = error[0]
+                        raise error[0]
                     return
                 yield item
         finally:

@@ -108,6 +108,17 @@ class JobService:
         # generation — relays race the snapshot fetch arbitrarily and
         # apply-fns are idempotent, so apply-now + replay-later is safe
         self._relay_log: Deque[Tuple[str, int, Any, Message]] = deque(maxlen=500)
+        # while a restore is pending the bounded log is not enough:
+        # >500 relays arriving before the snapshot replay runs would
+        # evict entries the replay depends on. This side buffer holds
+        # every relay from the FIRST fetch attempt of a generation
+        # until that generation's replay succeeds (NOT per-fetch: the
+        # coordinator retries failed fetches, and relays landing
+        # between attempts need the same protection). Unbounded, but
+        # its lifetime is one restore (seconds); replaying a relay
+        # twice is safe because apply-fns are idempotent.
+        self._restore_buffer: list = []
+        self._restore_buffer_gen: Optional[int] = None
         self._shadow_restoring = False
         self._shadow_gen: Optional[int] = None  # last restored generation
         self._shadow_gen_leader: Optional[str] = None
@@ -678,6 +689,16 @@ class JobService:
     def _gen_of(self, msg: Message) -> int:
         return int(msg.data.get("gen", 0))
 
+    def _log_relay(self, entry: Tuple[str, int, Any, Message]) -> None:
+        """Record a relay for post-restore replay. The bounded deque
+        covers normal operation; while a restore is pending (across
+        fetch retries) the unbounded side buffer guarantees nothing
+        sent at/after the restore generation can be evicted before
+        the replay runs."""
+        self._relay_log.append(entry)
+        if self._restore_buffer_gen is not None:
+            self._restore_buffer.append(entry)
+
     def _gen_stale(self, msg: Message) -> bool:
         """A relay from the current leader with a generation below the
         last restored one reflects pre-restore state the coordinator
@@ -695,7 +716,7 @@ class JobService:
         # in flight, replaying the log after restore() re-applies
         # everything sent at/after the restore generation. Apply-fns
         # are idempotent, so apply-now + replay-later is always safe.
-        self._relay_log.append(
+        self._log_relay(
             (msg.sender, self._gen_of(msg), self._apply_submit_relay, msg)
         )
         self._apply_submit_relay(msg)
@@ -713,7 +734,7 @@ class JobService:
     async def _h_ack_relay(self, msg: Message, addr) -> None:
         if msg.sender != self.node.leader_unique or self._gen_stale(msg):
             return
-        self._relay_log.append(
+        self._log_relay(
             (msg.sender, self._gen_of(msg), self._apply_ack_relay, msg)
         )
         self._apply_ack_relay(msg)
@@ -727,7 +748,7 @@ class JobService:
     async def _h_job_failed_relay(self, msg: Message, addr) -> None:
         if msg.sender != self.node.leader_unique or self._gen_stale(msg):
             return
-        self._relay_log.append(
+        self._log_relay(
             (msg.sender, self._gen_of(msg), self._apply_job_failed_relay, msg)
         )
         self._apply_job_failed_relay(msg)
@@ -783,6 +804,14 @@ class JobService:
         # relay queued right behind this one must not spawn a
         # concurrent fetch
         self._shadow_restoring = True
+        # buffer scope = the whole restore of this generation: opened
+        # at the FIRST fetch attempt, surviving failed attempts (the
+        # coordinator's resend re-enters here with the same gen), and
+        # closed only by a successful replay / promotion. A newer
+        # generation supersedes the old buffer.
+        if self._restore_buffer_gen is None or gen > self._restore_buffer_gen:
+            self._restore_buffer.clear()
+            self._restore_buffer_gen = gen
         asyncio.create_task(
             self._restore_shadow(version, gen, rid, msg.sender),
             name=f"{self._me}-shadow-restore",
@@ -816,17 +845,34 @@ class JobService:
         finally:
             self._shadow_restoring = False
         if snap is None:
-            return  # no ack -> coordinator retries the relay
+            # no ack -> coordinator retries the relay; keep the side
+            # buffer OPEN so relays landing between fetch attempts
+            # stay protected from log eviction
+            return
         if self.node.is_leader:
-            return  # promoted mid-fetch: the live state must not be clobbered
+            # promoted mid-fetch: the live state must not be clobbered,
+            # and a leader never restores a shadow — retire the buffer
+            self._restore_buffer.clear()
+            self._restore_buffer_gen = None
+            return
         self.scheduler.restore(snap)
         self._shadow_gen = gen
         self._shadow_gen_leader = reply_to
         replayed = 0
-        for sender, g, apply_fn, m in list(self._relay_log):
+        # bounded log first, then the in-flight side buffer: overlap
+        # applies twice, which is safe (idempotent apply-fns) and
+        # guarantees no eviction gap under relay floods
+        for sender, g, apply_fn, m in (
+            list(self._relay_log) + self._restore_buffer
+        ):
             if sender == reply_to and g >= gen:
                 apply_fn(m)
                 replayed += 1
+        # replay succeeded: close the buffer only if no NEWER restore
+        # generation has started accumulating in the meantime
+        if self._restore_buffer_gen is not None and gen >= self._restore_buffer_gen:
+            self._restore_buffer.clear()
+            self._restore_buffer_gen = None
         self._restored_keys[(reply_to, version, gen)] = True
         if rid:
             self.node.send_unique(
@@ -1092,9 +1138,22 @@ class JobService:
                 if reply.get("ok"):
                     return
             except (TimeoutError, asyncio.TimeoutError):
-                continue
+                continue  # request() already waited out its timeout
             except asyncio.CancelledError:
                 raise
+            except Exception:
+                # not just timeouts: ANY failure (encode error, socket
+                # down, ...) must keep the retry loop alive so the
+                # final "never acked" warning below is always reached
+                # instead of the task dying silently. Fast-failing
+                # errors need real spacing or all 5 attempts burn in
+                # microseconds.
+                log.exception(
+                    "%s: restore relay attempt failed", self._me
+                )
+                await asyncio.sleep(1.0)
+                continue
+            await asyncio.sleep(1.0)  # replied but not ok: space retries
         log.warning(
             "%s: standby never acked snapshot v%d — its shadow may be "
             "stale until the next checkpoint", self._me, version,

@@ -64,10 +64,11 @@ def _ring_attention_local(
     b, t_local, h, d = q.shape
     q_pos = my_idx * t_local + jnp.arange(t_local)  # global query positions
 
-    # pvary: the scan carry must be device-varying like q/k/v are, or
-    # shard_map's type checker rejects the loop (jax >= 0.9)
+    # pcast-to-varying: the scan carry must be device-varying like
+    # q/k/v are, or shard_map's type checker rejects the loop
+    # (jax >= 0.9; pvary spelling deprecated)
     def varying(x):
-        return jax.lax.pvary(x, (batch_axis, axis_name))
+        return jax.lax.pcast(x, (batch_axis, axis_name), to="varying")
 
     o = varying(jnp.zeros((b, h, t_local, d), jnp.float32))
     m = varying(jnp.full((b, h, t_local), NEG_INF, jnp.float32))

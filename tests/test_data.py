@@ -94,6 +94,29 @@ def test_prefetcher_propagates_decode_errors(samples):
         list(Prefetcher(ds))
 
 
+def test_abandoned_producer_error_stays_on_its_channel(samples):
+    """An old iteration's producer dying late must not clobber a newer
+    iteration's error state (advisor finding): errors travel in the
+    per-iteration container passed to _produce, never self._error."""
+    import queue
+
+    ds = ImageDataset(samples, image_size=(32, 32), batch_size=2,
+                      shuffle=False)
+    pf = Prefetcher(ds, depth=1)
+    old_q: "queue.Queue" = queue.Queue()
+    old_err: list = []
+    orig = ds.load_batch
+    ds.load_batch = lambda b: (_ for _ in ()).throw(RuntimeError("stale"))
+    # simulate a prior iteration's producer erroring out late
+    pf._produce(old_q, threading.Event(), old_err)
+    ds.load_batch = orig
+    assert old_err and isinstance(old_err[0], RuntimeError)
+    assert pf._error is None  # instance state untouched
+    # a fresh iteration is unaffected by the stale channel
+    assert len(list(pf)) == len(ds)
+    assert pf._error is None
+
+
 def test_dataset_feeds_trainer(samples):
     from _tinynet import ensure_tinynet
 

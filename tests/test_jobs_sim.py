@@ -483,6 +483,80 @@ async def test_relays_buffered_during_shadow_restore(tmp_path):
         assert r1["total_queries"] == 96 and r2["total_queries"] == 32
 
 
+async def test_relay_flood_overflowing_log_survives_restore(tmp_path):
+    """>500 relays landing while the snapshot fetch is in flight used
+    to evict earlier post-generation relays from the bounded relay log
+    before the replay ran (advisor finding); the unbounded in-flight
+    side buffer must keep them replayable."""
+    from dml_tpu.cluster.wire import Message, MsgType
+
+    async with cluster(3, tmp_path, 23100) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        names = await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+        coord_u = next(iter(sim.nodes.values())).leader_unique
+        standby_u = sim.stores[coord_u].standby_node().unique_name
+        sb = sim.jobs[standby_u]
+
+        await coord.checkpoint_jobs()  # snapshot: no jobs
+
+        # slow the standby's snapshot fetch so the flood races it
+        orig_get = sb.store.get_bytes
+
+        async def slow_get(*a, **k):
+            await asyncio.sleep(0.5)
+            return await orig_get(*a, **k)
+
+        fail_first_fetch = {"left": 3}  # one whole _restore_shadow run
+
+        async def flaky_slow_get(*a, **k):
+            if fail_first_fetch["left"] > 0:
+                fail_first_fetch["left"] -= 1
+                raise OSError("store briefly down")
+            await asyncio.sleep(0.5)
+            return await orig_get(*a, **k)
+
+        sb.store.get_bytes = flaky_slow_get
+        # first restore relay: every fetch attempt fails, no ack —
+        # but the side buffer must OPEN here and stay open
+        await sb._h_restore_relay(Message(
+            sender=coord_u, type=MsgType.JOBS_RESTORE_RELAY,
+            data={"version": 1, "gen": 1, "rid": "r1"},
+        ), None)
+        await sim.wait_for(lambda: not sb._shadow_restoring,
+                           what="first (failing) fetch settles")
+        # post-restore submit relay lands BETWEEN fetch attempts
+        await sb._h_submit_relay(Message(
+            sender=coord_u, type=MsgType.SUBMIT_JOB_RELAY,
+            data={"job": 7, "model": "ResNet50", "n": 4, "files": names,
+                  "batch_size": 4, "requester": client_u, "gen": 1},
+        ), None)
+        # the coordinator's resend re-triggers the restore (same gen):
+        # the buffer must NOT be wiped
+        await sb._h_restore_relay(Message(
+            sender=coord_u, type=MsgType.JOBS_RESTORE_RELAY,
+            data={"version": 1, "gen": 1, "rid": "r1b"},
+        ), None)
+        assert sb._shadow_restoring
+        # ...followed by a flood that evicts the submit from the
+        # bounded log (acks for an unknown job are valid no-op relays)
+        for i in range(600):
+            await sb._h_ack_relay(Message(
+                sender=coord_u, type=MsgType.WORKER_TASK_ACK_RELAY,
+                data={"job": 999, "batch": i, "n_images": 0, "gen": 1},
+            ), None)
+        assert not any(
+            m.data.get("job") == 7 for _, _, _, m in sb._relay_log
+        ), "flood should have evicted the submit from the bounded log"
+        await sim.wait_for(lambda: not sb._shadow_restoring,
+                           what="shadow restore settles")
+        # the side buffer replayed the evicted submit over the snapshot
+        assert 7 in sb.scheduler.jobs
+        assert sb._shadow_gen == 1
+        assert sb._restore_buffer_gen is None  # buffer retired
+
+
 async def test_post_restore_relay_arriving_before_restore_relay(tmp_path):
     """UDP gives no ordering: a relay SENT after the restore (higher
     generation) can ARRIVE before the restore relay. The gen-stamped
