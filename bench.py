@@ -1,100 +1,278 @@
-"""Headline benchmark: ResNet50 batch=32 inference throughput per chip.
+"""Bench matrix for the TPU serving stack. Prints ONE JSON line.
 
-Runs the framework's real serving path (InferenceEngine: jitted
-bfloat16 forward, resident weights, padded static shapes) and prints
-ONE JSON line.
+Headline: ResNet50 batch=32 inference throughput per chip (the
+BASELINE.json north-star). The line also carries the full matrix:
+
+- ResNet50 batch sweep 16..256 with q/s + MFU per point (the headline
+  batch is justified by the sweep, not assumed);
+- InceptionV3 b8 (BASELINE config 2) and b32;
+- EfficientNet-B4 b32 (BASELINE config 5's plug-in model);
+- dual-model C4: ResNet50 + InceptionV3 concurrent jobs through the
+  REAL fair-share scheduler on one chip, with its C1/C2 outputs;
+- Pallas-on-device: flash attention fwd/bwd vs naive XLA attention,
+  fused_normalize vs jnp, numeric parity asserted compiled via Mosaic;
+- imagenet label parity vs the reference goldens when pretrained
+  weights are obtainable, skipped-with-reason when not.
+
+Timing methodology (dml_tpu/benchmarks.py): every throughput number is
+the SLOPE between two on-device fori_loop chain lengths with a
+loop-carried input poke and full-output max consumption — immune to
+the tunnel's ~100 ms round-trip, to block_until_ready not blocking
+through remoting, and to XLA hoisting/slice-pushdown eating the work.
+Numbers are medians across reps (best-of-N overstates; advisor
+finding). Latency numbers are honest end-to-end submit->host-result
+times and INCLUDE the tunnel round-trip.
 
 Baseline (BASELINE.md): the reference's ResNet50 steady-state CPU
-predict is 250 ms/image (test.py:120, worker.py:74) => 4 queries/sec
-per node. `vs_baseline` is the speedup over that.
+predict is 250 ms/image (reference test.py:120, worker.py:74) => 4
+queries/sec per node. `vs_baseline` is the speedup over that.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 
-def main() -> None:
-    import os
+def _bench_models(engine, out):
+    """Model throughput matrix: sweep + secondary models."""
+    import jax
+    import jax.numpy as jnp
 
-    # persistent XLA compile cache: re-runs skip the ~30s ResNet compile
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dml_tpu_jax_cache_tpu")
+    from dml_tpu.benchmarks import (
+        compiled_flops,
+        dispatch_latency,
+        forward_rate,
+        peak_flops,
+    )
+
+    peak = peak_flops()
+    out["peak_flops_assumed"] = peak
+
+    def measure(name, batch_size, chains=(10, 50)):
+        lm = engine.load_model(name, batch_size=batch_size, warmup=False)
+        batch = jnp.zeros(
+            (batch_size, *lm.spec.input_size, 3), jnp.uint8
+        )
+        batch = jax.device_put(batch, engine.device)
+        secs = forward_rate(
+            lm.forward, lm.variables, batch, chains=chains
+        )
+        flops = compiled_flops(lm.forward, lm.variables, batch)
+        return {
+            "batch": batch_size,
+            "qps": round(batch_size / secs, 1),
+            "batch_ms": round(secs * 1e3, 3),
+            "mfu": round(flops / secs / peak, 4) if flops else None,
+        }, lm, batch
+
+    # ResNet50 sweep (BASELINE config 4 family); headline at b32
+    sweep = []
+    for b in (16, 32, 64, 128, 256):
+        point, lm, batch = measure("ResNet50", b)
+        sweep.append(point)
+        if b == 32:
+            p50, p99 = dispatch_latency(lm.forward, lm.variables, batch)
+            out["headline_resnet50_b32"] = {
+                **point,
+                "batch_latency_p50_ms": round(p50 * 1e3, 2),
+                "batch_latency_p99_ms": round(p99 * 1e3, 2),
+                "query_latency_p50_ms": round(p50 / b * 1e3, 4),
+                "query_latency_p99_ms": round(p99 / b * 1e3, 4),
+            }
+    out["resnet50_sweep"] = sweep
+    best = max(sweep, key=lambda p: p["qps"])
+    out["resnet50_throughput_optimal_batch"] = best["batch"]
+
+    i8, _, _ = measure("InceptionV3", 8)      # BASELINE config 2
+    i32, _, _ = measure("InceptionV3", 32)
+    out["inceptionv3"] = [i8, i32]
+    e32, _, _ = measure("EfficientNetB4", 32, chains=(5, 25))
+    out["efficientnet_b4"] = [e32]
+
+
+def _bench_dual_c4(engine, out):
+    """BASELINE config 3: concurrent ResNet50 + InceptionV3 jobs pushed
+    through the real fair-share scheduler; the engine executes every
+    assigned batch on the chip. Wall-clock here includes per-batch
+    dispatch (tunnel) — it demonstrates the C4 capability and the
+    scheduler's fair split, not peak chip rate (see the sweep)."""
+    import numpy as np
+
+    from dml_tpu.jobs.cost_model import ModelCost
+    from dml_tpu.jobs.scheduler import Scheduler
+
+    rng = np.random.RandomState(0)
+    workers = ["W1", "W2", "W3", "W4"]
+    sched = Scheduler()
+    for m, bs in (("ResNet50", 32), ("InceptionV3", 8)):
+        lm = engine.load_model(m, batch_size=bs, warmup=True)
+        sched.set_cost(m, ModelCost(
+            load_time=lm.load_time, first_query=lm.first_query,
+            per_query=lm.per_query, download_time=0.0, batch_size=bs,
+        ))
+    files = [f"img_{i}.jpeg" for i in range(64)]
+    n_r, n_i = 512, 256
+    sched.submit_job(1, "ResNet50", files, n_r, "bench")
+    sched.submit_job(2, "InceptionV3", files, n_i, "bench")
+
+    imgs = {
+        "ResNet50": rng.randint(0, 255, (32, 224, 224, 3), dtype=np.uint8),
+        "InceptionV3": rng.randint(0, 255, (8, 299, 299, 3), dtype=np.uint8),
+    }
+    t0 = time.monotonic()
+    done = 0
+    while sched.jobs:
+        assigns = sched.schedule(workers)
+        if not assigns and not sched.in_progress:
+            break
+        for a in assigns:
+            bt0 = time.monotonic()
+            engine.infer_arrays(a.batch.model, imgs[a.batch.model][: len(a.batch.files)])
+            sched.on_batch_done(
+                a.worker, a.batch.job_id, a.batch.batch_id,
+                time.monotonic() - bt0, len(a.batch.files),
+            )
+            done += 1
+    wall = time.monotonic() - t0
+    out["dual_model_c4"] = {
+        "resnet50_queries": n_r,
+        "inceptionv3_queries": n_i,
+        "batches_executed": done,
+        "wall_s": round(wall, 2),
+        "combined_qps_incl_dispatch": round((n_r + n_i) / wall, 1),
+        "c1": sched.c1_stats(window=wall),
+        "c2_resnet50": sched.c2_stats("ResNet50"),
+        "c2_inceptionv3": sched.c2_stats("InceptionV3"),
+    }
+
+
+def _bench_pallas(out):
+    """Flash-attention + fused_normalize compiled via Mosaic on the
+    real chip: numeric parity vs jnp oracles asserted, then timed."""
+    import jax
+    import jax.numpy as jnp
+
+    from dml_tpu.benchmarks import device_seconds_per_iter, poke
+    from dml_tpu.models.preprocess import normalize_on_device
+    from dml_tpu.ops import flash_attention, fused_normalize
+
+    B, T, H, D = 4, 4096, 8, 128
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (B, T, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, T, H, D), jnp.bfloat16)
+
+    def naive(q, k, v):
+        s = jnp.einsum(
+            "bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * (D ** -0.5)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        return jnp.einsum(
+            "bhts,bshd->bthd", jax.nn.softmax(s, -1), v.astype(jnp.float32)
+        ).astype(q.dtype)
+
+    # parity, compiled on device
+    o_fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    o_nv = jax.jit(naive)(q, k, v)
+    err = float(jnp.max(jnp.abs(
+        o_fa.astype(jnp.float32) - o_nv.astype(jnp.float32)
+    )))
+    assert err < 0.05, f"flash parity {err}"
+
+    def g(fn):
+        return jax.jit(jax.grad(
+            lambda q: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+        ))
+
+    g_fa = g(lambda q, k, v: flash_attention(q, k, v, causal=True))(q)
+    g_nv = g(naive)(q)  # multi-GB naive backward: run exactly once
+    gerr = float(jnp.max(jnp.abs(
+        g_fa.astype(jnp.float32) - g_nv.astype(jnp.float32)
+    ))) / (float(jnp.max(jnp.abs(g_nv))) + 1e-6)
+    assert gerr < 0.08, f"flash bwd parity {gerr}"
+
+    def step_fa(i, acc, q, k, v):
+        return jnp.max(
+            flash_attention(poke(q, acc), k, v, causal=True).astype(jnp.float32)
+        )
+
+    def step_nv(i, acc, q, k, v):
+        return jnp.max(naive(poke(q, acc), k, v).astype(jnp.float32))
+
+    t_fa = device_seconds_per_iter(step_fa, q, k, v, chains=(5, 25))
+    t_nv = device_seconds_per_iter(step_nv, q, k, v, chains=(5, 25))
+
+    x = jax.random.randint(kq, (256, 224, 224, 3), 0, 256, jnp.uint8)
+    err_n = float(jnp.max(jnp.abs(
+        jax.jit(lambda x: fused_normalize(x, "caffe"))(x).astype(jnp.float32)
+        - normalize_on_device(x, "caffe", jnp.bfloat16).astype(jnp.float32)
+    )))
+    assert err_n < 1.0, f"normalize parity {err_n}"
+
+    out["pallas_on_device"] = {
+        "flash_fwd_max_err": round(err, 5),
+        "flash_bwd_rel_err": round(gerr, 5),
+        "normalize_max_err": round(err_n, 5),
+        "flash_fwd_ms": round(t_fa * 1e3, 3),
+        "naive_attn_fwd_ms": round(t_nv * 1e3, 3),
+        "flash_vs_naive_speedup": round(t_nv / t_fa, 3),
+        "shape": f"B{B} T{T} H{H} D{D} bf16 causal",
+    }
+
+
+def main() -> None:
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/dml_tpu_jax_cache_tpu"
+    )
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
     import jax
-    import numpy as np
 
     from dml_tpu.inference.engine import InferenceEngine
 
-    batch_size = 32
+    out = {}
+    t_start = time.monotonic()
     engine = InferenceEngine()  # bfloat16, first visible device
-    t0 = time.monotonic()
-    lm = engine.load_model("ResNet50", batch_size=batch_size, warmup=True)
-    load_and_compile = time.monotonic() - t0
 
-    rng = np.random.RandomState(0)
-    imgs = rng.randint(0, 255, size=(batch_size, 224, 224, 3), dtype=np.uint8)
-    dev_imgs = jax.device_put(imgs, engine.device)
+    _bench_models(engine, out)
+    _bench_dual_c4(engine, out)
+    _bench_pallas(out)
 
-    # NOTE: block_until_ready does not actually block through a
-    # remoted device (tunnel), so all timing below forces completion
-    # with a host readback (np.asarray).
-    for _ in range(3):
-        np.asarray(lm.forward(lm.variables, dev_imgs))  # settle
+    # imagenet parity vs reference goldens (skips with reason in
+    # hermetic environments; full label-match report when weights are
+    # obtainable at bench time)
+    try:
+        import contextlib
+        import sys
 
-    # throughput: the whole chain runs ON DEVICE as one lax.fori_loop
-    # inside one jitted program — one dispatch + one readback total, so
-    # the measurement is the chip's steady batch rate, not the tunnel's
-    # dispatch latency (host-side dispatch through the remoting tunnel
-    # varies 2x between sessions and would swamp the number). The
-    # iteration-dependent input (batch ^ (i & 1)) defeats loop-invariant
-    # hoisting; the scalar accumulator makes every iteration live.
-    import jax.numpy as jnp
+        from dml_tpu.tools.imagenet_parity import run_parity
 
-    chain = 100
+        # keras prints download progress to stdout; keep stdout pure
+        # for the single JSON line
+        with contextlib.redirect_stdout(sys.stderr):
+            out["imagenet_parity"] = run_parity()
+    except Exception as e:  # pragma: no cover
+        out["imagenet_parity"] = {"skipped": True, "reason": repr(e)}
 
-    def chained(vs, batch):
-        def body(i, acc):
-            b = batch ^ (i & 1).astype(jnp.uint8)
-            out = lm.forward(vs, b)
-            return acc + out[0, 0]
-
-        return jax.lax.fori_loop(0, chain, body, jnp.float32(0))
-
-    cfn = jax.jit(chained)
-    np.asarray(cfn(lm.variables, dev_imgs))  # compile + settle
-    rates = []
-    for _ in range(6):  # best-of-6: tunnel jitter only ever slows a rep
-        t0 = time.monotonic()
-        np.asarray(cfn(lm.variables, dev_imgs))
-        rates.append(batch_size * chain / (time.monotonic() - t0))
-    qps = max(rates)
-
-    # latency: submit -> full results on host, per batch
-    lat = []
-    for _ in range(20):
-        t0 = time.monotonic()
-        np.asarray(lm.forward(lm.variables, dev_imgs))
-        lat.append(time.monotonic() - t0)
-    lat.sort()
-    batch_p50 = lat[len(lat) // 2]
-    batch_p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
-
+    hl = out["headline_resnet50_b32"]
     baseline_qps = 4.0  # reference: 250 ms/image CPU steady state
     print(json.dumps({
         "metric": "ResNet50 b32 inference throughput per chip",
-        "value": round(qps, 2),
+        "value": hl["qps"],
         "unit": "queries/sec",
-        "vs_baseline": round(qps / baseline_qps, 2),
-        "batch_latency_p50_ms": round(batch_p50 * 1000, 2),
-        "batch_latency_p99_ms": round(batch_p99 * 1000, 2),
-        "query_latency_p50_ms": round(batch_p50 / batch_size * 1000, 4),
-        "query_latency_p99_ms": round(batch_p99 / batch_size * 1000, 4),
-        "load_and_compile_s": round(load_and_compile, 2),
+        "vs_baseline": round(hl["qps"] / baseline_qps, 2),
+        "mfu": hl["mfu"],
+        "batch_latency_p50_ms": hl["batch_latency_p50_ms"],
+        "batch_latency_p99_ms": hl["batch_latency_p99_ms"],
+        "query_latency_p50_ms": hl["query_latency_p50_ms"],
+        "query_latency_p99_ms": hl["query_latency_p99_ms"],
         "device": str(jax.devices()[0]),
         "dtype": "bfloat16",
-        "batch_size": batch_size,
+        "batch_size": 32,
+        "bench_wall_s": round(time.monotonic() - t_start, 1),
+        "matrix": out,
     }))
 
 

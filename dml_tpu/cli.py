@@ -39,6 +39,9 @@ membership commands:
   2 | self_id                       print this node's id
   3 | join                          (re)join the cluster via the introducer
   4 | leave                         voluntarily leave the cluster
+  6 | files-per-node                global view: every node's files
+  7 | all-files                     every file in the store
+  8 | file-count                    distinct files in the store
   9 | bps                           bytes/sec sent by the control plane
  10 | fp-rate                       failure-detector false-positive stats
 file commands (replicated store):
@@ -48,6 +51,7 @@ file commands (replicated store):
   delete <sdfs>                     delete everywhere
   ls <sdfs>                         replicas holding the file
   ls-all [pattern]                  files in the store (wildcard ok)
+  get-all <pattern> <local_dir>     download every matching file
   store                             files replicated on THIS node
   load-testfiles <dir> [n]          bulk-put *.jpeg from a directory
 job commands (ML inference):
@@ -160,6 +164,22 @@ class NodeApp:
             for f, vs in sorted(files.items()):
                 print(f"{f}  versions={vs}")
             print(f"({len(files)} files)")
+        elif cmd == "get-all" and len(a) == 2:
+            got = await s.get_all(a[0], a[1])
+            for f, v in sorted(got.items()):
+                print(f"  {f} v{v} -> {a[1]}")
+            print(f"ok {len(got)} files ({time.monotonic() - t0:.2f}s)")
+        elif cmd in ("6", "files-per-node"):
+            nodes = await s.files_per_node()
+            for node, inv in sorted(nodes.items()):
+                print(f"{node}: {len(inv)} files")
+                for f, vs in sorted(inv.items()):
+                    print(f"    {f}  versions={vs}")
+        elif cmd in ("7", "all-files"):
+            files = await s.ls_all("*")
+            print("\n".join(sorted(files)) or "(empty store)")
+        elif cmd in ("8", "file-count"):
+            print(len(await s.ls_all("*")))
         elif cmd == "store":
             for f, vs in sorted(s.local_files().items()):
                 print(f"{f}  versions={vs}")

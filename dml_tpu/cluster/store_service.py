@@ -26,12 +26,13 @@ Differences (intent over accident, SURVEY §7):
 - the standby's file table stays warm via ALL_LOCAL_FILES_RELAY, and
   COORDINATE_ACK reconciliation rebuilds it authoritatively on failover
 
-Known limitation: the PUT/DELETE idempotency caches (`_put_tokens`,
-`_recent_deletes`) are leader-local. A client retry that crosses a
-leader failover may mint one duplicate version of the same content
-(benign in a versioned store) or report "file not found" for a delete
-that committed just before the failover. Relaying these caches to the
-standby would close the window; the cost/benefit hasn't justified it.
+Failover idempotency: resolved PUT tokens and completed deletes are
+relayed to the hot standby (STORE_IDEMPOTENCY_RELAY), so a client
+retry that crosses a leader failover re-fetches the recorded outcome
+instead of minting a duplicate version / reporting "file not found"
+for a delete that committed just before the failover. The relay is a
+single best-effort datagram: losing it merely re-opens the benign
+one-duplicate-version window for that one request.
 """
 
 from __future__ import annotations
@@ -360,6 +361,34 @@ class StoreService:
         """`store` — files replicated on this node (reference CLI)."""
         return self.store.inventory()
 
+    async def files_per_node(self) -> Dict[str, Dict[str, List[int]]]:
+        """`files-per-node` — the leader's whole global table, node ->
+        {file: versions} (reference CLI option 6, worker.py:1711-1714,
+        which prints the leader's global_file_dict)."""
+        reply = await self._leader_retry(
+            MsgType.FILES_PER_NODE_REQUEST, {}, timeout=15.0
+        )
+        return {
+            node: {f: [int(v) for v in vs] for f, vs in inv.items()}
+            for node, inv in reply.get("nodes", {}).items()
+        }
+
+    async def get_all(
+        self, pattern: str, local_dir: str, timeout: float = 60.0
+    ) -> Dict[str, int]:
+        """`get-all <pattern> <dir>` — download the latest version of
+        every matching file into `local_dir` (reference
+        download_all_files, worker.py:1496-1511, CLI worker.py:1939-1954).
+        Returns {file: version fetched}."""
+        local_dir = os.path.abspath(os.path.expanduser(local_dir))
+        os.makedirs(local_dir, exist_ok=True)
+        out: Dict[str, int] = {}
+        for f in sorted(await self.ls_all(pattern)):
+            out[f] = await self.get(
+                f, os.path.join(local_dir, f), timeout=timeout
+            )
+        return out
+
     # ------------------------------------------------------------------
     # handler registration
     # ------------------------------------------------------------------
@@ -372,6 +401,7 @@ class StoreService:
         n.register(MsgType.DELETE_FILE_REQUEST, self._h_delete_file_request)
         n.register(MsgType.LIST_FILE_REQUEST, self._h_list_file_request)
         n.register(MsgType.GET_ALL_MATCHING_FILES, self._h_matching_request)
+        n.register(MsgType.FILES_PER_NODE_REQUEST, self._h_files_per_node)
         n.register(MsgType.DOWNLOAD_FILE_SUCCESS, self._h_download_result)
         n.register(MsgType.DOWNLOAD_FILE_FAIL, self._h_download_result)
         n.register(MsgType.DELETE_FILE_ACK, self._h_delete_result)
@@ -381,6 +411,7 @@ class StoreService:
         n.register(MsgType.ALL_LOCAL_FILES, self._h_all_local_files)
         # standby side
         n.register(MsgType.ALL_LOCAL_FILES_RELAY, self._h_all_local_files_relay)
+        n.register(MsgType.STORE_IDEMPOTENCY_RELAY, self._h_idempotency_relay)
         # replica side
         n.register(MsgType.DOWNLOAD_FILE, self._h_download_file)
         n.register(MsgType.DELETE_FILE, self._h_delete_file)
@@ -487,6 +518,10 @@ class StoreService:
         token = st.fanout_payload.get("token", "")
         if token:
             self._put_tokens[token] = ("done", ok, reply)
+            self._relay_to_standby(
+                MsgType.STORE_IDEMPOTENCY_RELAY,
+                {"kind": "put", "token": token, "ok": ok, "reply": reply},
+            )
         self.node.send_unique(
             st.requester,
             MsgType.PUT_REQUEST_SUCCESS if ok else MsgType.PUT_REQUEST_FAIL,
@@ -598,7 +633,7 @@ class StoreService:
         self.metadata.finish_request(req_id)
         if done_ok:
             self.metadata.remove_file(st.file)
-            self._recent_deletes[st.file] = True
+            self._record_delete_done(st.file)
         self.node.send_unique(
             st.requester,
             MsgType.DELETE_FILE_REQUEST_SUCCESS if done_ok else MsgType.DELETE_FILE_REQUEST_FAIL,
@@ -635,6 +670,46 @@ class StoreService:
             msg.sender,
             MsgType.GET_ALL_MATCHING_FILES_ACK,
             {"rid": msg.data.get("rid"), "ok": True, "files": files},
+        )
+
+    def _record_delete_done(self, file: str) -> None:
+        """A delete committed: remember it (retries converge to
+        success) and keep the standby's memory warm across failover."""
+        self._recent_deletes[file] = True
+        self._relay_to_standby(
+            MsgType.STORE_IDEMPOTENCY_RELAY, {"kind": "delete", "file": file}
+        )
+
+    async def _h_idempotency_relay(self, msg: Message, addr) -> None:
+        """Standby side: mirror the leader's resolved PUT tokens and
+        completed deletes, so a client retry that lands on US after a
+        failover re-fetches the recorded outcome instead of re-running
+        the operation (closing the duplicate-version window the
+        round-1 build documented as open)."""
+        if msg.sender != self.node.leader_unique or self.node.is_leader:
+            return
+        d = msg.data
+        if d.get("kind") == "put" and d.get("token"):
+            self._put_tokens[d["token"]] = (
+                "done", bool(d.get("ok")), dict(d.get("reply", {}))
+            )
+        elif d.get("kind") == "delete" and d.get("file"):
+            self._recent_deletes[d["file"]] = True
+
+    async def _h_files_per_node(self, msg: Message, addr) -> None:
+        if not self.node.is_leader:
+            return
+        self.node.send_unique(
+            msg.sender,
+            MsgType.FILES_PER_NODE_ACK,
+            {
+                "rid": msg.data.get("rid"),
+                "ok": True,
+                "nodes": {
+                    node: dict(inv)
+                    for node, inv in self.metadata.files.items()
+                },
+            },
         )
 
     # ------------------------------------------------------------------
@@ -765,7 +840,7 @@ class StoreService:
                 else:
                     self.metadata.finish_request(req_id)
                     self.metadata.remove_file(st.file)
-                    self._recent_deletes[st.file] = True
+                    self._record_delete_done(st.file)
                     self.node.send_unique(
                         st.requester, MsgType.DELETE_FILE_REQUEST_SUCCESS, ok_reply
                     )

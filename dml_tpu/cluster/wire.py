@@ -67,6 +67,13 @@ class MsgType(enum.IntEnum):
     LIST_FILE_REQUEST_ACK = 43
     GET_ALL_MATCHING_FILES = 44
     GET_ALL_MATCHING_FILES_ACK = 45
+    # global files-per-node view (reference CLI option 6,
+    # worker.py:1711-1714, reads the leader's global_file_dict)
+    FILES_PER_NODE_REQUEST = 46
+    FILES_PER_NODE_ACK = 47
+    # leader -> standby: resolved PUT idempotency tokens + completed
+    # deletes, so client retries crossing a failover stay idempotent
+    STORE_IDEMPOTENCY_RELAY = 48
     # ML job pipeline (L7)
     SUBMIT_JOB_REQUEST = 60
     SUBMIT_JOB_REQUEST_ACK = 61

@@ -38,7 +38,7 @@ import numpy as np
 
 from ..models.labels import decode_predictions
 from ..models.params_io import init_variables
-from ..models.preprocess import load_images, normalize_on_device
+from ..models.preprocess import load_images
 from ..models.registry import ModelSpec, get_model
 
 
@@ -145,7 +145,12 @@ class InferenceEngine:
         model = spec.build(dtype=self.dtype)
 
         def fwd(vs, batch_u8):
-            x = normalize_on_device(batch_u8, spec.preprocess, self.dtype)
+            # ops.preprocess.normalize: Pallas kernel on TPU (measured
+            # ~10% faster end-to-end than letting XLA fuse the jnp
+            # normalize into the stem conv), plain jnp elsewhere
+            from ..ops.preprocess import normalize
+
+            x = normalize(batch_u8, spec.preprocess, self.dtype)
             return model.apply(vs, x, train=False)
 
         forward = jax.jit(fwd)

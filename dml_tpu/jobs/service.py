@@ -798,20 +798,23 @@ class JobService:
                     {"rid": rid, "ok": True},
                 )
             return
+        # buffer scope = the whole restore of this generation: opened
+        # the moment the generation is FIRST seen (even if an older
+        # generation's fetch is still in flight — its replay won't
+        # close a buffer that has moved past it), surviving failed
+        # fetch attempts (the coordinator's resend re-enters here with
+        # the same gen), and closed only by a successful replay of the
+        # current buffer generation / promotion. A newer generation
+        # supersedes the old buffer.
+        if self._restore_buffer_gen is None or gen > self._restore_buffer_gen:
+            self._restore_buffer.clear()
+            self._restore_buffer_gen = gen
         if self._shadow_restoring:
             return  # a fetch is already in flight; the retry re-asks
         # set the latch HERE (not inside the task): a second restore
         # relay queued right behind this one must not spawn a
         # concurrent fetch
         self._shadow_restoring = True
-        # buffer scope = the whole restore of this generation: opened
-        # at the FIRST fetch attempt, surviving failed attempts (the
-        # coordinator's resend re-enters here with the same gen), and
-        # closed only by a successful replay / promotion. A newer
-        # generation supersedes the old buffer.
-        if self._restore_buffer_gen is None or gen > self._restore_buffer_gen:
-            self._restore_buffer.clear()
-            self._restore_buffer_gen = gen
         asyncio.create_task(
             self._restore_shadow(version, gen, rid, msg.sender),
             name=f"{self._me}-shadow-restore",

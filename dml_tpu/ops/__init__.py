@@ -7,16 +7,16 @@ hand-placement beats the compiler's defaults:
   transformer serving/training hot op and the per-device block of the
   sp ring (parallel/ring_attention.py).
 - `fused_normalize`: uint8 image -> normalized bf16/f32 in one VMEM
-  pass — a drop-in Pallas alternative to `normalize_on_device`
-  (models/preprocess.py), which the serving engine uses today (XLA
-  already fuses the elementwise normalize into the first conv; this
-  kernel exists for pipelines that want the ingest op standalone).
+  pass. The serving engine uses it on TPU via `normalize` (measured
+  ~10% faster end-to-end than letting XLA fuse the jnp normalize into
+  the stem conv, which recomputes it across overlapping 7x7 stride-2
+  patches); `normalize` falls back to the jnp path off-TPU.
 
 Every kernel has an `interpret` escape hatch so the same code runs on
 the CPU test mesh (tests/) and compiled on TPU.
 """
 
 from .flash_attention import flash_attention
-from .preprocess import fused_normalize
+from .preprocess import fused_normalize, normalize
 
-__all__ = ["flash_attention", "fused_normalize"]
+__all__ = ["flash_attention", "fused_normalize", "normalize"]

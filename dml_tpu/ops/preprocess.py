@@ -51,6 +51,23 @@ def _normalize_kernel(x_ref, o_ref, *, mode, width3):
     o_ref[:] = x.astype(o_ref.dtype)
 
 
+def normalize(x: jax.Array, mode: str, dtype=jnp.bfloat16) -> jax.Array:
+    """Product entry point for batch normalization-preprocessing: the
+    Mosaic kernel on TPU, XLA-fused jnp elsewhere.
+
+    Measured on v5e (ResNet50 b32 end-to-end forward, slope-timed):
+    2.24 ms with the kernel pre-pass vs 2.50 ms with jnp inlined —
+    ~10% faster, because XLA fuses the inline normalize into the
+    stride-2 7x7 stem conv where overlapping receptive fields
+    recompute it per patch; the kernel materializes the normalized
+    batch once. On CPU the interpreter would lose; jnp fuses fine."""
+    if jax.default_backend() == "tpu":
+        return fused_normalize(x, mode, dtype)
+    from ..models.preprocess import normalize_on_device
+
+    return normalize_on_device(x, mode, dtype)
+
+
 def fused_normalize(
     x: jax.Array,
     mode: str,

@@ -97,29 +97,63 @@ class MoEMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         b, t, d = x.shape
-        n = b * t
         e = self.num_experts
-        # G groups of g tokens each: smallest divisor of n with
-        # G >= n/group_size, so g = n/G <= group_size and routing cost
-        # stays bounded per group (n is static => trace-time search).
-        # Awkward n (sparse divisors) yields more, smaller groups —
-        # never one giant group.
-        groups = max(1, -(-n // self.group_size))
-        while n % groups:
-            groups += 1
-        g = n // groups
+        # Group grid [B, T/g, g]: groups NEVER mix batch rows or cross
+        # sequence-shard boundaries. Flattening b*t (the obvious
+        # alternative) scrambles the (dp, sp) sharding of the token
+        # grid — GSPMD then can't re-shard the routing tensors without
+        # "[SPMD] Involuntary full rematerialization" (observed in the
+        # round-1 multichip dryrun). Keeping the axes separate makes
+        # every constraint below a no-movement annotation.
+        sp = self.mesh.shape.get("sp", 1) if self.mesh is not None else 1
+        if t % sp:
+            sp = 1  # unshardable seq: route as if unsharded
+        # g: largest divisor of the per-shard sequence <= group_size
+        per_shard = t // sp
+        g = min(self.group_size, per_shard)
+        while per_shard % g:
+            g -= 1
+        gt = t // g  # groups per sequence (multiple of sp by choice of g)
         capacity = max(1, math.ceil(g / e * self.capacity_factor))
-        tokens = x.reshape(groups, g, d)
+        tokens = x.reshape(b, gt, g, d)
+
+        # every constraint axis must actually divide its dim, or the
+        # annotation itself raises at trace time — a fallback decision
+        # (like sp=1 above) must translate into None here, never the
+        # mesh axis name
+        def axis_ok(name: str, dim: int) -> Optional[str]:
+            if self.mesh is None:
+                return None
+            size = self.mesh.shape.get(name, 1)
+            return name if size > 1 and dim % size == 0 else None
+
+        dp_ax = axis_ok("dp", b)
+        sp_ax = axis_ok("sp", gt) if sp > 1 else None
+        ep_ax = axis_ok("ep", e)
+
+        def constrain(arr, *axes):
+            """Annotate `arr`'s leading dims (None padding for the
+            rest); no-op off-mesh."""
+            if self.mesh is None:
+                return arr
+            spec = P(*axes, *([None] * (arr.ndim - len(axes))))
+            return jax.lax.with_sharding_constraint(
+                arr, NamedSharding(self.mesh, spec)
+            )
+
+        tokens = constrain(tokens, dp_ax, sp_ax)
 
         # router in f32 regardless of model dtype
         logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
                           name="router")(tokens.astype(jnp.float32))
-        gates = jax.nn.softmax(logits, axis=-1)  # [G, g, E]
-        dispatch, combine, aux = jax.vmap(
+        gates = jax.nn.softmax(logits, axis=-1)  # [B, Gt, g, E]
+        dispatch, combine, aux = jax.vmap(jax.vmap(
             lambda gg: top2_dispatch(gg, capacity)
-        )(gates)
+        ))(gates)
         aux = aux.mean()
         self.sow("losses", "moe_aux", aux)
+        dispatch = constrain(dispatch, dp_ax, sp_ax)
+        combine = constrain(combine, dp_ax, sp_ax)
 
         w_up = self.param(
             "w_up",
@@ -132,31 +166,24 @@ class MoEMLP(nn.Module):
             (e, self.d_ff, d), jnp.float32,
         ).astype(self.dtype)
 
-        def constrain_ep(arr):
-            # [G, E, ...]: groups ride dp (GSPMD pads uneven cases),
-            # experts ride ep — P(None, 'ep') here would force an
-            # all-gather of the groups and redundant compute per dp row
-            if self.mesh is not None and self.mesh.shape.get("ep", 1) > 1:
-                dp_axis = "dp" if self.mesh.shape.get("dp", 1) > 1 else None
-                spec = P(dp_axis, "ep", *([None] * (arr.ndim - 2)))
-                return jax.lax.with_sharding_constraint(
-                    arr, NamedSharding(self.mesh, spec)
-                )
-            return arr
-
-        # [G,g,d] -> [G,E,C,d]: the all_to_all point (tokens leave
-        # their dp shard for their expert's ep shard)
+        # [B,Gt,g,d] -> [B,Gt,E,C,d]: the all_to_all point (tokens
+        # leave their dp/sp shard for their expert's ep shard); expert
+        # FFNs then run fully local (E aligned with the ep-sharded
+        # weights, batch/group dims aligned with dp/sp)
         expert_in = jnp.einsum(
-            "gnec,gnd->gecd",
+            "bgnec,bgnd->bgecd",
             dispatch.astype(self.dtype), tokens.astype(self.dtype),
         )
-        expert_in = constrain_ep(expert_in)
-        h = nn.silu(jnp.einsum("gecd,edf->gecf", expert_in, w_up))
-        h = constrain_ep(h)
-        out_e = jnp.einsum("gecf,efd->gecd", h, w_down)
-        out_e = constrain_ep(out_e)
-        # [G,E,C,d] -> [G,g,d]: the return all_to_all + weighted combine
-        out = jnp.einsum("gnec,gecd->gnd", combine.astype(self.dtype), out_e)
+        expert_in = constrain(expert_in, dp_ax, sp_ax, ep_ax)
+        h = nn.silu(jnp.einsum("bgecd,edf->bgecf", expert_in, w_up))
+        h = constrain(h, dp_ax, sp_ax, ep_ax)
+        out_e = jnp.einsum("bgecf,efd->bgecd", h, w_down)
+        out_e = constrain(out_e, dp_ax, sp_ax, ep_ax)
+        # [B,Gt,E,C,d] -> [B,Gt,g,d]: return all_to_all + combine
+        out = jnp.einsum(
+            "bgnec,bgecd->bgnd", combine.astype(self.dtype), out_e
+        )
+        out = constrain(out, dp_ax, sp_ax)
         return out.reshape(b, t, d)
 
 

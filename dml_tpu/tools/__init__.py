@@ -1,0 +1,1 @@
+"""Operational tools: parity checks, weight acquisition, diagnostics."""

@@ -57,4 +57,5 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test (run via asyncio.run)")
+    config.addinivalue_line("markers", "slow: heavyweight test (keras builds etc.)")
 

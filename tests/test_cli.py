@@ -80,6 +80,28 @@ async def test_nodeapp_commands(tmp_path, capsys):
         out = capsys.readouterr().out
         assert "a.jpeg" in out and "ok version=1" in out
 
+        # global-view + bulk verbs (reference CLI options 6/7/8 and
+        # get-all, worker.py:1711-1722, 1939-1954)
+        src2 = tmp_path / "b.jpeg"
+        src2.write_bytes(b"\xff\xd8more")
+        assert await app.handle(f"put {src2} b.jpeg")
+        capsys.readouterr()
+        assert await app.handle("files-per-node")
+        out = capsys.readouterr().out
+        assert "a.jpeg" in out and "b.jpeg" in out
+        assert any(n.unique_name in out for n in spec.nodes)
+        assert await app.handle("7")
+        out = capsys.readouterr().out
+        assert "a.jpeg" in out and "b.jpeg" in out
+        assert await app.handle("file-count")
+        assert capsys.readouterr().out.strip() == "2"
+        bulk = tmp_path / "bulk"
+        assert await app.handle(f"get-all *.jpeg {bulk}")
+        out = capsys.readouterr().out
+        assert "ok 2 files" in out
+        assert (bulk / "a.jpeg").read_bytes() == b"\xff\xd8data"
+        assert (bulk / "b.jpeg").read_bytes() == b"\xff\xd8more"
+
         # job verbs (fake backend)
         assert await app.handle("submit-job ResNet50 4")
         out = capsys.readouterr().out

@@ -231,6 +231,104 @@ async def test_leader_failover(tmp_path):
         assert sim.dns.current_introducer == h2.unique_name
 
 
+async def test_put_retry_across_failover_is_idempotent(tmp_path):
+    """A client PUT retry crossing a leader failover must NOT mint a
+    duplicate version: the resolved idempotency token is relayed to
+    the standby, which answers the retry from the recorded outcome
+    (round-1 documented this window as open; now closed)."""
+    from dml_tpu.cluster.store_service import data_addr
+    from dml_tpu.cluster.wire import MsgType
+
+    async with cluster(4, tmp_path, 21700) as sim:
+        h1 = sim.spec.node_by_name("H1")
+        await sim.wait_converged(expect_leader=h1.unique_name)
+        client_u = sim.spec.node_by_name("H4").unique_name
+        cstore = sim.stores[client_u]
+        cnode = sim.nodes[client_u]
+
+        src = tmp_path / "idem.txt"
+        src.write_bytes(b"exactly once")
+        # PUT through the normal client path but with a hand-held
+        # token, so the post-failover retry can reuse it exactly
+        token = cstore.data_plane.expose(str(src))
+        reply = await cnode.leader_request(
+            MsgType.PUT_REQUEST,
+            {
+                "file": "idem.txt",
+                "token": token,
+                "data_addr": list(data_addr(cnode.me)),
+            },
+            timeout=10.0,
+        )
+        assert reply["ok"] and reply["version"] == 1
+
+        standby_u = sim.stores[h1.unique_name].standby_node().unique_name
+        sb_store = sim.stores[standby_u]
+        await sim.wait_for(
+            lambda: token in sb_store._put_tokens,
+            what="idempotency token relayed to standby",
+        )
+
+        await sim.stop_node(h1.unique_name)
+        await sim.wait_for(
+            lambda: all(
+                n.leader_unique == standby_u for n in sim.nodes.values()
+            ),
+            what="failover to standby",
+        )
+        # the client's reply datagram "was lost": it retries the same
+        # PUT (same token) against the new leader
+        retry = await cnode.leader_request(
+            MsgType.PUT_REQUEST,
+            {
+                "file": "idem.txt",
+                "token": token,
+                "data_addr": list(data_addr(cnode.me)),
+            },
+            timeout=10.0,
+        )
+        cstore.data_plane.unexpose(token)
+        assert retry["ok"] and retry["version"] == 1  # SAME version
+        files = await cstore.ls_all("idem.txt")
+        assert files["idem.txt"] == [1]  # exactly one version exists
+
+
+async def test_delete_retry_across_failover_converges(tmp_path):
+    """A DELETE retry crossing a failover converges to success (the
+    completed-delete marker is relayed), not 'file not found'."""
+    from dml_tpu.cluster.wire import MsgType
+
+    async with cluster(4, tmp_path, 21800) as sim:
+        h1 = sim.spec.node_by_name("H1")
+        await sim.wait_converged(expect_leader=h1.unique_name)
+        client_u = sim.spec.node_by_name("H4").unique_name
+        cstore = sim.stores[client_u]
+        cnode = sim.nodes[client_u]
+
+        src = tmp_path / "gone.txt"
+        src.write_bytes(b"bye")
+        await cstore.put(str(src), "gone.txt")
+        await cstore.delete("gone.txt")
+
+        standby_u = sim.stores[h1.unique_name].standby_node().unique_name
+        sb_store = sim.stores[standby_u]
+        await sim.wait_for(
+            lambda: "gone.txt" in sb_store._recent_deletes,
+            what="delete marker relayed to standby",
+        )
+        await sim.stop_node(h1.unique_name)
+        await sim.wait_for(
+            lambda: all(
+                n.leader_unique == standby_u for n in sim.nodes.values()
+            ),
+            what="failover to standby",
+        )
+        retry = await cnode.leader_request(
+            MsgType.DELETE_FILE_REQUEST, {"file": "gone.txt"}, timeout=10.0
+        )
+        assert retry["ok"], retry  # success, not "file not found"
+
+
 async def test_voluntary_leave_rejoin(tmp_path):
     async with cluster(3, tmp_path, 21500) as sim:
         await sim.wait_converged()

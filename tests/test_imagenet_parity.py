@@ -1,0 +1,91 @@
+"""The imagenet-parity tool (dml_tpu/tools/imagenet_parity.py).
+
+Real pretrained weights are unobtainable in the hermetic sandbox, so
+these tests pin (a) the skip-with-reason contract the bench depends
+on, (b) the golden parsing/agreement/assignment logic against the
+REAL reference golden files, and (c) the full engine+keras glue path
+with random weights (structure, not label values — label-level
+numbers appear when the bench runs somewhere with weights)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dml_tpu.tools import imagenet_parity as ip
+
+
+def test_skip_when_no_weights(monkeypatch, tmp_path):
+    monkeypatch.delenv("DML_TPU_KERAS_WEIGHTS_DIR", raising=False)
+    monkeypatch.setattr(
+        ip, "_try_build_keras", lambda m: (None, "weights unobtainable")
+    )
+    rep = ip.run_parity()
+    assert rep["skipped"] is True
+    assert "weights unobtainable" in rep["reason"]
+
+
+def test_skip_when_no_goldens(tmp_path):
+    rep = ip.run_parity(golden_dir=str(tmp_path / "nope"))
+    assert rep["skipped"] is True
+    assert "golden" in rep["reason"]
+
+
+def test_load_goldens_parses_reference_files():
+    goldens = ip.load_goldens()
+    if not goldens:
+        pytest.skip("reference goldens not present")
+    assert set(goldens) == {"output_1_127.json", "output_2_127.json"}
+    for g in goldens.values():
+        assert len(g) == 5
+        for img, rows in g.items():
+            assert img.endswith(".jpeg")
+            assert len(rows) == 5 and len(rows[0]) == 3  # top5 triples
+            assert ip.resolve_image(img), f"{img} missing from testfiles"
+
+
+def test_agreement_math():
+    a = {"x": ["n1", "n2", "n3", "n4", "n5"], "y": ["n9", "n2", "n3", "n4", "n5"]}
+    b = {"x": ["n1", "n5", "n4", "n3", "n2"], "y": ["n1", "n2", "n3", "n4", "n5"]}
+    m = ip._agreement(a, b)
+    assert m["n"] == 2
+    assert m["top1"] == 0.5  # only x agrees at top-1
+    assert m["top5_overlap"] == (5 / 5 + 4 / 5) / 2
+    assert ip._agreement(a, {})["n"] == 0
+
+
+def test_weight_sources_env_dir(monkeypatch, tmp_path):
+    f = tmp_path / "resnet50_weights_tf_dim_ordering_tf_kernels.h5"
+    f.write_bytes(b"x")
+    monkeypatch.setenv("DML_TPU_KERAS_WEIGHTS_DIR", str(tmp_path))
+    assert ip.weight_sources("ResNet50") == [str(f)]
+
+
+@pytest.mark.slow
+def test_full_path_with_random_weights(monkeypatch):
+    """Drives every line of run_parity except the weight download:
+    random-weight Keras ResNet50 through convert -> engine -> goldens.
+    Label agreement is meaningless with random weights; the contract
+    under test is that the report is complete and well-formed."""
+    tf = pytest.importorskip("tensorflow")
+    if not ip.load_goldens():
+        pytest.skip("reference goldens not present")
+    tf.config.set_visible_devices([], "GPU")
+    built = {}
+
+    def fake_build(m):
+        if m not in built:
+            built[m] = tf.keras.applications.ResNet50(weights=None)
+        return built[m], None
+
+    monkeypatch.setattr(ip, "_try_build_keras", fake_build)
+    monkeypatch.setattr(ip, "_ensure_class_index", lambda: None)
+    rep = ip.run_parity(models=("ResNet50",), dtype="float32")
+    assert rep["skipped"] is False
+    m = rep["models"]["ResNet50"]
+    assert m["engine_vs_keras"]["n"] == 10  # both goldens' image sets
+    # both golden files must be assigned to the only candidate model
+    assert set(rep["golden_assignment"].values()) == {"ResNet50"}
+    assert len(m["engine_vs_golden"]) == 2
+    assert json.dumps(rep)  # bench embeds it verbatim

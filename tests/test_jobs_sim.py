@@ -557,6 +557,76 @@ async def test_relay_flood_overflowing_log_survives_restore(tmp_path):
         assert sb._restore_buffer_gen is None  # buffer retired
 
 
+async def test_newer_generation_restore_mid_fetch_keeps_buffering(tmp_path):
+    """A gen-2 restore relay arriving while gen-1's fetch is in flight
+    must advance the side buffer to gen 2 immediately (review finding:
+    the in-flight latch used to drop it before the buffer bookkeeping,
+    so gen-2 relays lost eviction protection until the ~10s resend)."""
+    from dml_tpu.cluster.wire import Message, MsgType
+
+    async with cluster(3, tmp_path, 23200) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        names = await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+        coord_u = next(iter(sim.nodes.values())).leader_unique
+        standby_u = sim.stores[coord_u].standby_node().unique_name
+        sb = sim.jobs[standby_u]
+
+        await coord.checkpoint_jobs()  # snapshot: no jobs
+        orig_get = sb.store.get_bytes
+
+        async def slow_get(*a, **k):
+            await asyncio.sleep(0.4)
+            return await orig_get(*a, **k)
+
+        sb.store.get_bytes = slow_get
+        # gen-1 restore: fetch in flight
+        await sb._h_restore_relay(Message(
+            sender=coord_u, type=MsgType.JOBS_RESTORE_RELAY,
+            data={"version": 1, "gen": 1, "rid": "r1"},
+        ), None)
+        assert sb._shadow_restoring
+        # gen-2 restore arrives mid-fetch: dropped by the latch, but
+        # the buffer must advance to gen 2 NOW
+        await sb._h_restore_relay(Message(
+            sender=coord_u, type=MsgType.JOBS_RESTORE_RELAY,
+            data={"version": 1, "gen": 2, "rid": "r2"},
+        ), None)
+        assert sb._restore_buffer_gen == 2
+        # a gen-2 submit relay + a flood that evicts it from the log
+        await sb._h_submit_relay(Message(
+            sender=coord_u, type=MsgType.SUBMIT_JOB_RELAY,
+            data={"job": 9, "model": "ResNet50", "n": 4, "files": names,
+                  "batch_size": 4, "requester": client_u, "gen": 2},
+        ), None)
+        for i in range(600):
+            await sb._h_ack_relay(Message(
+                sender=coord_u, type=MsgType.WORKER_TASK_ACK_RELAY,
+                data={"job": 999, "batch": i, "n_images": 0, "gen": 2},
+            ), None)
+        assert not any(
+            m.data.get("job") == 9 for _, _, _, m in sb._relay_log
+        )
+        # gen-1 fetch completes: its replay must NOT retire the gen-2
+        # buffer
+        await sim.wait_for(lambda: not sb._shadow_restoring,
+                           what="gen-1 restore settles")
+        assert sb._shadow_gen == 1
+        assert sb._restore_buffer_gen == 2
+        # the coordinator's gen-2 resend: restore wipes the shadow and
+        # replays — job 9 must come back from the side buffer
+        await sb._h_restore_relay(Message(
+            sender=coord_u, type=MsgType.JOBS_RESTORE_RELAY,
+            data={"version": 1, "gen": 2, "rid": "r2b"},
+        ), None)
+        await sim.wait_for(lambda: not sb._shadow_restoring,
+                           what="gen-2 restore settles")
+        assert sb._shadow_gen == 2
+        assert 9 in sb.scheduler.jobs
+        assert sb._restore_buffer_gen is None  # retired
+
+
 async def test_post_restore_relay_arriving_before_restore_relay(tmp_path):
     """UDP gives no ordering: a relay SENT after the restore (higher
     generation) can ARRIVE before the restore relay. The gen-stamped

@@ -128,6 +128,25 @@ def test_moe_ep_sharded_matches_unsharded():
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-4)
 
 
+def test_moe_unshardable_dims_fall_back_to_unconstrained():
+    """Dims the mesh axes don't divide (t % sp, b % dp, e % ep) must
+    downgrade the corresponding sharding constraint to None — the
+    annotation itself raises at trace time otherwise (review finding:
+    the sp fallback used to still constrain the group dim with 'sp')."""
+    mesh = local_mesh(dp=2, sp=2, ep=2)
+    # t=6 not divisible by sp=2 after grouping; b=3 not divisible by
+    # dp=2; e=3 not divisible by ep=2 — all three fallbacks at once
+    b, t, d, e = 3, 5, 8, 3
+    model = MoEMLP(num_experts=e, d_ff=16, capacity_factor=8.0,
+                   mesh=mesh, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(5).randn(b, t, d), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    with mesh:
+        y = jax.jit(model.apply)(variables, x)  # must not raise
+    ref = _naive_moe(variables["params"], np.asarray(x).reshape(-1, d), e)
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, d), ref, atol=1e-4)
+
+
 def test_moe_gradients_flow():
     model = MoEMLP(num_experts=4, d_ff=16, dtype=jnp.float32)
     x = jnp.asarray(np.random.RandomState(3).randn(2, 4, 8), jnp.float32)
