@@ -148,6 +148,106 @@ def _bench_dual_c4(engine, out):
     }
 
 
+def _bench_cluster_serving(engine, out):
+    """BASELINE config 4's shape on available hardware: a real
+    localhost cluster (UDP control plane + TCP data plane + SDFS
+    replication) serving a batch=32 ResNet50 job with THE REAL ENGINE
+    on the chip, inputs = the reference's own testfiles_more JPEGs
+    (synthetic fallback when absent). One chip stands in for the
+    reference's 10-VM ring; the 10-node control plane itself is
+    exercised in tests/test_jobs_sim.py::test_ten_node_ring_full_stack."""
+    import asyncio
+    import glob
+
+    async def run():
+        from dml_tpu.cluster.introducer import IntroducerService
+        from dml_tpu.cluster.node import Node
+        from dml_tpu.cluster.store_service import StoreService
+        from dml_tpu.config import ClusterSpec, StoreConfig, Timing
+        from dml_tpu.jobs.service import JobService
+
+        tmp = "/tmp/dml_tpu_bench_cluster"
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        spec = ClusterSpec.localhost(
+            4, base_port=28801, introducer_port=28800,
+            timing=Timing(ping_interval=0.2, ack_timeout=0.3,
+                          cleanup_time=1.0, leader_rpc_timeout=10.0),
+            store=StoreConfig(root=os.path.join(tmp, "roots"),
+                              download_dir=os.path.join(tmp, "dl")),
+        )
+
+        async def backend(model, paths):
+            res = await engine.infer_files_async(model, paths)
+            return res.to_json_dict(), res.infer_time, engine.cost_constants(model)
+
+        dns = IntroducerService(spec)
+        await dns.start()
+        stack = []
+        for n in spec.nodes:
+            node = Node(spec, n)
+            store = StoreService(node, root=os.path.join(tmp, f"st_{n.port}"))
+            jobs = JobService(node, store, infer_backend=backend)
+            await node.start()
+            await store.start()
+            await jobs.start()
+            stack.append((node, store, jobs))
+        try:
+            for _ in range(100):
+                if all(n.joined and n.leader_unique for n, _, _ in stack):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise RuntimeError(
+                    "bench cluster failed to converge in 10s (stale "
+                    "process on ports 28800-28805?)"
+                )
+            srcs = sorted(glob.glob("/root/reference/testfiles_more/*.jpeg"))[:32]
+            client_store, client_jobs = stack[-1][1], stack[-1][2]
+            if srcs:
+                source = "reference testfiles_more"
+                for p in srcs:
+                    await client_store.put(p, os.path.basename(p))
+            else:  # hermetic fallback
+                source = "synthetic"
+                from PIL import Image
+                import numpy as np
+
+                rng = np.random.RandomState(0)
+                for i in range(32):
+                    p = os.path.join(tmp, f"img_{i}.jpeg")
+                    Image.fromarray(
+                        rng.randint(0, 255, (256, 256, 3), np.uint8)
+                    ).save(p)
+                    await client_store.put(p, f"img_{i}.jpeg")
+            await client_jobs.set_batch_size("ResNet50", 32)
+            n_q = 512
+            t0 = time.monotonic()
+            job_id = await client_jobs.submit_job("ResNet50", n_q)
+            done = await client_jobs.wait_job(job_id, timeout=600.0)
+            wall = time.monotonic() - t0
+            assert done["total_queries"] == n_q
+            out["cluster_serving"] = {
+                "nodes": 4,
+                "input_source": source,
+                "queries": n_q,
+                "wall_s": round(wall, 2),
+                "qps_end_to_end": round(n_q / wall, 1),
+                "note": "full stack: UDP control plane + SDFS-replicated "
+                        "inputs + host JPEG decode + engine on chip",
+            }
+        finally:
+            for node, store, jobs in reversed(stack):
+                await jobs.stop()
+                await store.stop()
+                await node.stop()
+            await dns.stop()
+
+    asyncio.run(run())
+
+
 def _bench_pallas(out):
     """Flash-attention + fused_normalize compiled via Mosaic on the
     real chip: numeric parity vs jnp oracles asserted, then timed."""
@@ -211,6 +311,39 @@ def _bench_pallas(out):
     )))
     assert err_n < 1.0, f"normalize parity {err_n}"
 
+    # ring-attention body: Pallas-flash blocks vs dense-jnp blocks
+    # (1-device sp mesh — the multi-device ring is validated on the
+    # CPU mesh; this measures the per-device block compute that
+    # dominates ring wall-time)
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from dml_tpu.parallel.ring_attention import ring_attention
+
+    mesh = Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+        ("dp", "tp", "sp", "pp", "ep"),
+    )
+    qr = q[:2]
+    kr, vr = k[:2], v[:2]
+    ring_fl = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh, causal=True, use_flash=True))
+    ring_dn = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh, causal=True, use_flash=False))
+    err_r = float(jnp.max(jnp.abs(
+        ring_fl(qr, kr, vr).astype(jnp.float32)
+        - ring_dn(qr, kr, vr).astype(jnp.float32)
+    )))
+    assert err_r < 0.05, f"ring flash/dense parity {err_r}"
+    t_rf = device_seconds_per_iter(
+        lambda i, acc, q, k, v: jnp.max(
+            ring_fl(poke(q, acc), k, v).astype(jnp.float32)),
+        qr, kr, vr, chains=(5, 25))
+    t_rd = device_seconds_per_iter(
+        lambda i, acc, q, k, v: jnp.max(
+            ring_dn(poke(q, acc), k, v).astype(jnp.float32)),
+        qr, kr, vr, chains=(5, 25))
+
     out["pallas_on_device"] = {
         "flash_fwd_max_err": round(err, 5),
         "flash_bwd_rel_err": round(gerr, 5),
@@ -218,6 +351,9 @@ def _bench_pallas(out):
         "flash_fwd_ms": round(t_fa * 1e3, 3),
         "naive_attn_fwd_ms": round(t_nv * 1e3, 3),
         "flash_vs_naive_speedup": round(t_nv / t_fa, 3),
+        "ring_block_flash_ms": round(t_rf * 1e3, 3),
+        "ring_block_dense_ms": round(t_rd * 1e3, 3),
+        "ring_flash_speedup": round(t_rd / t_rf, 3),
         "shape": f"B{B} T{T} H{H} D{D} bf16 causal",
     }
 
@@ -238,6 +374,7 @@ def main() -> None:
 
     _bench_models(engine, out)
     _bench_dual_c4(engine, out)
+    _bench_cluster_serving(engine, out)
     _bench_pallas(out)
 
     # imagenet parity vs reference goldens (skips with reason in

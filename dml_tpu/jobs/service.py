@@ -933,6 +933,17 @@ class JobService:
                 paths = await self._fetch_inputs(batch)
             with span("worker.inference"):
                 results, infer_time, cost = await self._backend(batch.model, paths)
+            # backends key results by the LOCAL path (the engine uses
+            # the full path, others may use the basename), which
+            # differs by how the input materialized (store-replica hit
+            # -> name_versionN, data-plane download -> name.vN). Re-key
+            # to the sdfs names so merged job output is consistent no
+            # matter which worker classified which image.
+            to_sdfs = {}
+            for p, f in zip(paths, batch.files):
+                to_sdfs[p] = f
+                to_sdfs[os.path.basename(p)] = f
+            results = {to_sdfs.get(k, k): v for k, v in results.items()}
             out_name = f"output_{batch.job_id}_{batch.batch_id}_{self.node.me.port}.json"
             tmp = os.path.join(self.store.cfg.download_path(), out_name)
             os.makedirs(os.path.dirname(tmp), exist_ok=True)

@@ -110,9 +110,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[:] = m_scr[:, :1] + jnp.log(l)  # [bq, 1]
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, t_kv,
-                   padded_kv):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   *rest, scale, causal, block_q, block_k, t_kv,
+                   padded_kv, has_glse):
+    # rest = (glse_ref?, dq_ref, dq_scr): the lse-cotangent input only
+    # exists for flash_attention_lse — the plain path must not stream
+    # an all-zeros buffer through the kernel on every training step
+    if has_glse:
+        glse_ref, dq_ref, dq_scr = rest
+    else:
+        glse_ref, (dq_ref, dq_scr) = None, rest
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -136,7 +143,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
+        # dL/ds = p*(dp - delta) from the out path + p*g_lse from the
+        # lse output (d lse_i/d s_ij = p_ij) — the lse term exists
+        # only for flash_attention_lse (e.g. the ring merge)
+        row = (dp - delta + glse_ref[:]) if has_glse else (dp - delta)
+        ds = p * row * scale
         dq_scr[:] += jax.lax.dot(
             ds.astype(k_ref.dtype), k_ref[:],
             preferred_element_type=jnp.float32,
@@ -153,8 +164,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k, t_kv, padded_kv):
+                    *rest, scale, causal, block_q, block_k, t_kv,
+                    padded_kv, has_glse):
+    # rest = (glse_ref?, dk_ref, dv_ref, dk_scr, dv_scr) — see
+    # _bwd_dq_kernel for why glse is statically optional
+    if has_glse:
+        glse_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        glse_ref, (dk_ref, dv_ref, dk_scr, dv_scr) = None, rest
     # note the transposed grid: (b, h, k-block, q-block)
     ik, iq = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
@@ -185,7 +202,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
+        row = (dp - delta + glse_ref[:]) if has_glse else (dp - delta)
+        ds = (p * row * scale).astype(q_ref.dtype)
         dk_scr[:] += jax.lax.dot_general(  # dS^T @ Q: [bk, D]
             ds, q_ref[:], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -278,8 +296,13 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+def _flash_bwd_impl(q, k, v, out, lse, g, g_lse, causal, scale, block_q,
+                    block_k, interpret):
+    """Shared backward: dq/dk/dv given out-cotangent `g` and optional
+    lse-cotangent `g_lse` ([B,H,T] f32, or None for plain attention —
+    the g_lse input stream is then omitted from the kernels
+    entirely)."""
+    has_glse = g_lse is not None
     b, h, t, d = q.shape
     t_kv = k.shape[2]
     bq = min(block_q, t)
@@ -300,6 +323,10 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         )
         lsep = jnp.where(pad_rows, jnp.float32(-NEG_INF), lsep)
     deltap = _pad_seq(delta[..., None], bq)
+    glsep = (
+        _pad_seq(g_lse.astype(jnp.float32)[..., None], bq)
+        if has_glse else None
+    )
     nq = qp.shape[2] // bq
     nk = kp.shape[2] // bk
     padded_kv = kp.shape[2] != t_kv
@@ -308,31 +335,38 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     kv_spec = pl.BlockSpec((None, None, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0))
     row_spec = pl.BlockSpec((None, None, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
 
+    ins = [qp, kp, vp, gp, lsep, deltap] + ([glsep] if has_glse else [])
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec] + (
+        [row_spec] if has_glse else []
+    )
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
             block_k=bk, t_kv=t_kv, padded_kv=padded_kv,
+            has_glse=has_glse,
         ),
         grid=(b, h, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, gp, lsep, deltap)[:, :, :t]
+    )(*ins)[:, :, :t]
 
     # transposed grid: q-block innermost so dk/dv accumulate in scratch
     q_spec_t = pl.BlockSpec((None, None, bq, d), lambda b_, h_, j, i: (b_, h_, i, 0))
     kv_spec_t = pl.BlockSpec((None, None, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0))
     row_spec_t = pl.BlockSpec((None, None, bq, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+    in_specs_t = [q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t] + ([row_spec_t] if has_glse else [])
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
             block_k=bk, t_kv=t_kv, padded_kv=padded_kv,
+            has_glse=has_glse,
         ),
         grid=(b, h, nk, nq),
-        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
-                  row_spec_t],
+        in_specs=in_specs_t,
         out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[
             jax.ShapeDtypeStruct(kp.shape, k.dtype),
@@ -343,11 +377,79 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp, gp, lsep, deltap)
+    )(*ins)
     return dq, dk[:, :, :t_kv], dv[:, :, :t_kv]
 
 
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g, None, causal, scale,
+        block_q, block_k, interpret,
+    )
+
+
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# lse-returning variant (the ring-attention building block)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g_out, g_lse, causal, scale, block_q,
+        block_k, interpret,
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention that ALSO returns the per-row log-sum-exp.
+
+    q, k, v: [B, T, H, D] -> (out [B, Tq, H, D], lse [B, H, Tq] f32).
+    Differentiable in both outputs (the lse cotangent feeds `ds` as
+    `p * g_lse`), which is what lets ring attention merge per-block
+    flash results across devices and still train. Layout matches
+    `flash_attention`; `lse` stays [B, H, T] (the merge consumes it
+    head-major)."""
+    if q.ndim != 4:
+        raise ValueError(f"expected [B,T,H,D], got {q.shape}")
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError("causal attention needs equal q/k lengths")
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    interpret = _interpret_default() if interpret is None else interpret
+    out, lse = _flash_lse(
+        _bhtd(q), _bhtd(k), _bhtd(v), causal, scale, block_q, block_k,
+        interpret,
+    )
+    return _bhtd(out), lse
 
 
 def flash_attention(

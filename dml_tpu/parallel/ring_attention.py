@@ -107,6 +107,80 @@ def _ring_attention_local(
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
 
+def _ring_attention_local_flash(
+    q, k, v, *, axis_name: str, batch_axis: str, causal: bool,
+    scale: float
+):
+    """Per-device ring body with the Pallas flash kernel computing each
+    KV block (ops/flash_attention.py) instead of materializing the
+    [B,H,Tq,Tk] block scores in HBM. The kernel returns (out, lse) per
+    block; blocks merge through the standard two-estimate recurrence
+    m = max(lse, lse_blk); out = out*(1-w) + out_blk*w with
+    w = exp(lse_blk - m) / (exp(lse - m) + exp(lse_blk - m)).
+
+    Causality at block granularity: the diagonal block (src == my_idx)
+    runs the kernel's causal mask, strictly-past blocks run full
+    attention, strictly-future blocks are skipped via lax.cond (the
+    taken branch alone executes on TPU — future blocks cost nothing).
+    """
+    from ..ops.flash_attention import flash_attention_lse
+
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+
+    def varying(x):
+        return jax.lax.pcast(x, (batch_axis, axis_name), to="varying")
+
+    out0 = varying(jnp.zeros((b, t_local, h, d), jnp.float32))
+    lse0 = varying(jnp.full((b, h, t_local), NEG_INF, jnp.float32))
+
+    def blk_causal(q, kb, vb):
+        o, l = flash_attention_lse(q, kb, vb, causal=True, scale=scale)
+        return o.astype(jnp.float32), l
+
+    def blk_full(q, kb, vb):
+        o, l = flash_attention_lse(q, kb, vb, causal=False, scale=scale)
+        return o.astype(jnp.float32), l
+
+    def blk_skip(q, kb, vb):
+        return (
+            jnp.zeros((b, t_local, h, d), jnp.float32),
+            jnp.full((b, h, t_local), NEG_INF, jnp.float32),
+        )
+
+    def step(carry, i):
+        out, lse, k_blk, v_blk = carry
+        src = (my_idx - i) % axis_size
+        if causal:
+            o_blk, lse_blk = jax.lax.cond(
+                src == my_idx,
+                blk_causal,
+                lambda q, kb, vb: jax.lax.cond(
+                    src < my_idx, blk_full, blk_skip, q, kb, vb
+                ),
+                q, k_blk, v_blk,
+            )
+        else:
+            o_blk, lse_blk = blk_full(q, k_blk, v_blk)
+        m = jnp.maximum(lse, lse_blk)
+        a = jnp.exp(lse - m)
+        bb = jnp.exp(lse_blk - m)
+        w = (bb / (a + bb))  # [B,H,T]; first block: a=0 -> w=1
+        w_bthd = jnp.einsum("bht->bth", w)[..., None]
+        out = out * (1.0 - w_bthd) + o_blk * w_bthd
+        lse = m + jnp.log(a + bb)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (out, lse, k_blk, v_blk), None
+
+    (out, _, _, _), _ = jax.lax.scan(
+        step, (out0, lse0, k, v), jnp.arange(axis_size)
+    )
+    return out.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -116,21 +190,39 @@ def ring_attention(
     causal: bool = True,
     axis_name: str = "sp",
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Exact (flash-equivalent) attention with the sequence sharded
     over `axis_name`. Inputs/outputs [B, T, H, D] with T sharded on
     `axis_name` and B on `dp`. T must divide evenly by the axis size.
+
+    `use_flash=None` auto-selects: the Pallas-kernel block body on TPU
+    (each device's KV block streams through VMEM instead of
+    materializing [B,H,Tq,Tk] scores in HBM), the dense-jnp body
+    elsewhere. Both are differentiable and numerically equivalent.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
     spec = P("dp", axis_name, None, None)
-    fn = shard_map(
-        functools.partial(
+    if use_flash:
+        body = functools.partial(
+            _ring_attention_local_flash, axis_name=axis_name,
+            batch_axis="dp", causal=causal, scale=scale,
+        )
+    else:
+        body = functools.partial(
             _ring_attention_local, axis_name=axis_name, batch_axis="dp",
             causal=causal, scale=scale,
-        ),
+        )
+    fn = shard_map(
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call outputs carry no vma info; the body is
+        # per-device pure either way
+        check_vma=not use_flash,
     )
     return fn(q, k, v)
 

@@ -48,8 +48,11 @@ class FakeBackend:
         delay = self.per_model_delay.get(model, 0.0)
         if delay:
             await asyncio.sleep(delay)
+        # key by the FULL local path, mirroring the real engine
+        # (InferenceResult.files carries str(path)) so the service's
+        # sdfs re-keying is exercised production-shaped
         results = {
-            os.path.basename(p): [{"wnid": "n000", "label": model, "score": 1.0}]
+            p: [{"wnid": "n000", "label": model, "score": 1.0}]
             for p in paths
         }
         cost = {"load_time": 0.0, "first_query": 0.0, "per_query": 0.001}
@@ -754,6 +757,82 @@ async def test_auto_checkpoint_loop(tmp_path):
         gate.set()
         done = await client.wait_job(job_id, timeout=20.0)
         assert done["total_queries"] == 64
+
+
+async def test_ten_node_ring_full_stack(tmp_path):
+    """BASELINE config 4 at the reference's deployed scale: a 10-node
+    ring (the reference's H1-H10 universe, config.py:54-63) running the
+    full stack — join, replicated-store bulk load, a batch=32 ResNet50
+    job fanned across the 8 non-coordinator workers, C1/C5 metrics,
+    and output collection."""
+    async with cluster(10, tmp_path, 24100) as sim:
+        await sim.wait_converged(timeout=20.0)
+        client_u = sim.by_name("H10")
+        names = await sim.seed_images(client_u, 6)
+        client = sim.jobs[client_u]
+
+        await client.set_batch_size("ResNet50", 32)  # C3, cluster-wide
+        job_id = await client.submit_job("ResNet50", 256)
+        done = await client.wait_job(job_id, timeout=30.0)
+        assert done["total_queries"] == 256
+
+        coord = sim.coordinator_jobs()
+        # all 8 batches ran, spread across multiple workers (not
+        # serialized onto one)
+        used_workers = {
+            u for u, be in sim.backends.items()
+            if any(m == "ResNet50" for m, _ in be.calls)
+        }
+        assert len(used_workers) >= 4, used_workers
+        c1 = coord.c1_stats()
+        assert c1["ResNet50"]["total_queries"] == 256
+        out = await client.get_output(job_id, str(tmp_path / "final.json"))
+        assert len(out) == len(names)  # every distinct image classified
+
+
+async def test_efficientnet_dynamic_batching_with_failure(tmp_path):
+    """BASELINE config 5: the plug-in model (EfficientNet-B4) served
+    with a mid-run C3 batch-size change (dynamic batching) and a
+    worker killed mid-job (1-node failure injection); the job must
+    still complete every query."""
+    async with cluster(5, tmp_path, 24200) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H5")
+        await sim.seed_images(client_u, 4)
+        client = sim.jobs[client_u]
+        coord = sim.coordinator_jobs()
+        coord_u = next(iter(sim.nodes.values())).leader_unique
+
+        # dynamic batching: C3 re-sizes EfficientNetB4 batches
+        # cluster-wide before the job (reference SET_BATCH_SIZE,
+        # worker.py:1028-1037)
+        await client.set_batch_size("EfficientNetB4", 8)
+        gate = asyncio.Event()
+        for be in sim.backends.values():
+            be.gate = gate
+
+        job_id = await client.submit_job("EfficientNetB4", 64)  # 8 batches
+        await sim.wait_for(
+            lambda: len(coord.scheduler.in_progress) > 0,
+            what="batches in flight",
+        )
+        # failure injection: kill a worker that holds a batch
+        victim = next(
+            w for w in coord.scheduler.in_progress
+            if w not in (coord_u, client_u)
+        )
+        await sim.stop_node(victim)
+        gate.set()
+        done = await client.wait_job(job_id, timeout=30.0)
+        assert done["total_queries"] == 64
+        # the batch size actually took effect (8 per call, not default)
+        sizes = {
+            len(paths)
+            for be in sim.backends.values()
+            for m, paths in be.calls
+            if m == "EfficientNetB4"
+        }
+        assert sizes == {8}, sizes
 
 
 async def test_deterministic_batch_failure_fails_job_loudly(tmp_path):

@@ -89,6 +89,60 @@ def test_flash_under_jit():
     )
 
 
+def test_flash_lse_values_and_merge_identity():
+    """flash_attention_lse: lse matches logsumexp of the true scores,
+    and merging two KV halves via the (out, lse) recurrence equals
+    attention over the full KV — the ring-attention contract."""
+    from dml_tpu.ops.flash_attention import flash_attention_lse
+
+    q, k, v = _qkv(b=1, t=64, h=2, d=32, seed=9)
+    out, lse = flash_attention_lse(q, k, v, causal=False,
+                                   block_q=32, block_k=32)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (32 ** -0.5)
+    np.testing.assert_allclose(
+        lse, jax.nn.logsumexp(s, axis=-1), atol=2e-5, rtol=2e-5
+    )
+    # two-block merge
+    o1, l1 = flash_attention_lse(q, k[:, :32], v[:, :32], causal=False,
+                                 block_q=32, block_k=32)
+    o2, l2 = flash_attention_lse(q, k[:, 32:], v[:, 32:], causal=False,
+                                 block_q=32, block_k=32)
+    m = jnp.maximum(l1, l2)
+    a1, a2 = jnp.exp(l1 - m), jnp.exp(l2 - m)
+    w1 = jnp.einsum("bhq->bqh", a1 / (a1 + a2))[..., None]
+    merged = o1 * w1 + o2 * (1 - w1)
+    np.testing.assert_allclose(merged, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_lse_gradients_include_lse_cotangent():
+    """Loss depending on BOTH outputs (out and lse) must match the
+    oracle gradient — exercises the p*g_lse term in the backward."""
+    from dml_tpu.ops.flash_attention import flash_attention_lse
+
+    q, k, v = _qkv(b=1, t=64, h=2, d=32, seed=11)
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_lse(q, k, v, causal=False,
+                                     block_q=32, block_k=32)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(lse))
+
+    def loss_ref(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (32 ** -0.5)
+        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(
+            jnp.cos(jax.nn.logsumexp(s, axis=-1))
+        )
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            a, b, atol=5e-5, rtol=5e-4, err_msg=f"d{name} mismatch"
+        )
+
+
 @pytest.mark.parametrize("mode", ["caffe", "tf", "unit"])
 def test_fused_normalize_matches_oracle(mode):
     rng = np.random.RandomState(0)
