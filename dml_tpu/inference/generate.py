@@ -69,15 +69,15 @@ def _rms_norm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
 
 
 def _moe_ffn(moe: Dict[str, Any], y: jax.Array, dtype) -> jax.Array:
-    """Single-position MoE FFN (parallel/moe.py MoEMLP at decode time).
+    """Dense-dispatch MoE FFN (parallel/moe.py MoEMLP at serve time);
+    `y` is [B, T, d] (T=1 at decode, T=prompt_len at prefill).
 
-    Per-token top-2 routing is EXACT here — with one token per
-    sequence there is no batch-wide capacity competition, so no
-    dropped tokens (training-time capacity drops are a batching
+    Per-token top-2 routing is EXACT here — no capacity competition,
+    so no dropped tokens (training-time capacity drops are a batching
     artifact, not part of the learned function). Computes all experts
-    and combines with the gate weights: at decode batch sizes the
-    [B, E, d_ff] intermediate is small and the static shapes keep the
-    whole step in one compiled program."""
+    and combines with the gate weights: at serving batch sizes the
+    [tokens, E, d_ff] intermediate is small and the static shapes keep
+    the whole pass in one compiled program."""
     b = y.shape[0] * y.shape[1]
     d = y.shape[-1]
     tok = y.reshape(b, d)
@@ -100,6 +100,49 @@ def _moe_ffn(moe: Dict[str, Any], y: jax.Array, dtype) -> jax.Array:
     return out.reshape(*y.shape)
 
 
+def _apply_block(
+    blk: Dict[str, Any],
+    cfg: LMConfig,
+    x: jax.Array,  # [B, T, d]
+    positions: jax.Array,  # [T]
+    attn_fn,  # (q, k, v) [B,T,H,D] -> [B,T,H,D]
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """ONE transformer block — the single copy of the layer math that
+    decode (T=1, cache attention) and prefill (T=Tp, flash attention)
+    both run, so they cannot drift apart. Returns (x_out, k, v); the
+    caller owns what the attention closure and the cache do with k/v.
+    Matches models/transformer.py layer-for-layer.
+    """
+    b, t = x.shape[:2]
+    h, hd = cfg.n_heads, cfg.head_dim
+    y = _rms_norm(x, blk["ln_attn"]["scale"], cfg.dtype)
+    qkv = y @ blk["qkv"]["kernel"].astype(cfg.dtype)  # [B, T, 3d]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = rope(q.reshape(b, t, h, hd), positions)
+    k = rope(k.reshape(b, t, h, hd), positions)
+    v = v.reshape(b, t, h, hd)
+    attn = attn_fn(q, k, v)
+    attn = attn.reshape(b, t, cfg.d_model).astype(cfg.dtype)
+    x = x + attn @ blk["proj"]["kernel"].astype(cfg.dtype)
+    y = _rms_norm(x, blk["ln_mlp"]["scale"], cfg.dtype)
+    if "moe" in blk:
+        x = x + _moe_ffn(blk["moe"], y, cfg.dtype)
+    else:
+        y = y @ blk["up"]["kernel"].astype(cfg.dtype)
+        y = jax.nn.silu(y)
+        x = x + y @ blk["down"]["kernel"].astype(cfg.dtype)
+    return x, k, v
+
+
+def _head(params: Dict[str, Any], cfg: LMConfig, x_last: jax.Array) -> jax.Array:
+    """Final norm + lm head on [B, 1, d] -> [B, V] f32 logits."""
+    x = _rms_norm(x_last, params["ln_out"]["scale"], cfg.dtype)
+    return (
+        x.astype(jnp.float32)
+        @ params["lm_head"]["kernel"].astype(jnp.float32)
+    )[:, 0, :]
+
+
 def decode_step(
     params: Dict[str, Any],
     cfg: LMConfig,
@@ -112,8 +155,7 @@ def decode_step(
     Matches TransformerLM.apply on the prefix up to `idx` exactly
     (same layer math, same dtypes).
     """
-    b = tokens.shape[0]
-    h, hd = cfg.n_heads, cfg.head_dim
+    hd = cfg.head_dim
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)  # [B, d]
     x = x[:, None, :]  # [B, 1, d]
     positions = idx[None]  # [1]
@@ -123,41 +165,66 @@ def decode_step(
 
     new_cache: Dict[str, Any] = {}
     for i in range(cfg.n_layers):
-        blk = params[f"block_{i}"]
-        y = _rms_norm(x, blk["ln_attn"]["scale"], cfg.dtype)
-        qkv = y @ blk["qkv"]["kernel"].astype(cfg.dtype)  # [B, 1, 3d]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = rope(q.reshape(b, 1, h, hd), positions)
-        k = rope(k.reshape(b, 1, h, hd), positions)
-        v = v.reshape(b, 1, h, hd)
-        ck = jax.lax.dynamic_update_slice_in_dim(
-            cache[f"block_{i}"]["k"], k.astype(cfg.dtype), idx, axis=1
-        )
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            cache[f"block_{i}"]["v"], v.astype(cfg.dtype), idx, axis=1
-        )
-        new_cache[f"block_{i}"] = {"k": ck, "v": cv}
-        # attention of the single query against the whole cache (masked)
-        s = jnp.einsum("bqhd,bthd->bhqt", q.astype(jnp.float32),
-                       ck.astype(jnp.float32)) * (hd**-0.5)
-        s = jnp.where(valid[None, None, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bhqt,bthd->bqhd", p, cv.astype(jnp.float32))
-        attn = attn.reshape(b, 1, cfg.d_model).astype(cfg.dtype)
-        x = x + attn @ blk["proj"]["kernel"].astype(cfg.dtype)
-        y = _rms_norm(x, blk["ln_mlp"]["scale"], cfg.dtype)
-        if "moe" in blk:
-            x = x + _moe_ffn(blk["moe"], y, cfg.dtype)
-        else:
-            y = y @ blk["up"]["kernel"].astype(cfg.dtype)
-            y = jax.nn.silu(y)
-            x = x + y @ blk["down"]["kernel"].astype(cfg.dtype)
+        name = f"block_{i}"
 
-    x = _rms_norm(x, params["ln_out"]["scale"], cfg.dtype)
-    logits = x.astype(jnp.float32) @ params["lm_head"]["kernel"].astype(
-        jnp.float32
-    )
-    return logits[:, 0, :], new_cache
+        def attn_fn(q, k, v, name=name):
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                cache[name]["k"], k.astype(cfg.dtype), idx, axis=1
+            )
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cache[name]["v"], v.astype(cfg.dtype), idx, axis=1
+            )
+            new_cache[name] = {"k": ck, "v": cv}
+            # single query against the whole cache (masked)
+            s = jnp.einsum("bqhd,bthd->bhqt", q.astype(jnp.float32),
+                           ck.astype(jnp.float32)) * (hd**-0.5)
+            s = jnp.where(valid[None, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqt,bthd->bqhd", p, cv.astype(jnp.float32))
+
+        x, _, _ = _apply_block(params[name], cfg, x, positions, attn_fn)
+
+    return _head(params, cfg, x), new_cache
+
+
+def prefill(
+    params: Dict[str, Any],
+    cfg: LMConfig,
+    prompt: jax.Array,  # [B, Tp] int32
+    max_len: int,
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Process the WHOLE prompt in one forward: returns (logits at the
+    last prompt position [B, V], cache filled for positions < Tp).
+
+    The old path pushed the prompt through the decode scan one token
+    at a time — O(Tp) sequential [B,1] steps that leave the MXU idle.
+    This runs the same layer math at sequence granularity with the
+    Pallas flash kernel doing causal attention (interpreted off-TPU),
+    so a 4k-token prompt costs one batched forward instead of 4096
+    round trips through the scan."""
+    from ..ops.flash_attention import flash_attention
+
+    b, tp = prompt.shape
+    x = params["embed"]["embedding"][prompt].astype(cfg.dtype)  # [B,Tp,d]
+    positions = jnp.arange(tp)
+    pad = max_len - tp
+
+    def attn_fn(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    cache: Dict[str, Any] = {}
+    for i in range(cfg.n_layers):
+        x, k, v = _apply_block(
+            params[f"block_{i}"], cfg, x, positions, attn_fn
+        )
+        cache[f"block_{i}"] = {
+            "k": jnp.pad(k.astype(cfg.dtype),
+                         ((0, 0), (0, pad), (0, 0), (0, 0))),
+            "v": jnp.pad(v.astype(cfg.dtype),
+                         ((0, 0), (0, pad), (0, 0), (0, 0))),
+        }
+
+    return _head(params, cfg, x[:, -1:]), cache
 
 
 def _sample(logits, rng, temperature: float, top_k: Optional[int]):
@@ -182,34 +249,34 @@ def generate(
 ) -> jax.Array:
     """Greedy/temperature/top-k decoding; returns [B, max_new_tokens].
 
-    Prefill and decode share one scanned step function: positions
-    < Tp teacher-force the prompt token, later positions feed back the
-    sample. One jit compilation per (shape, config). Pass `rng` (a
-    PRNGKey) instead of `seed` when calling under jit — a traced key
-    doesn't force a retrace per seed.
+    The prompt runs through `prefill` (one flash-attention forward
+    filling the cache); the scan then covers ONLY the new tokens, each
+    a single [B,1] decode step against the cache. One jit compilation
+    per (shape, config). Pass `rng` (a PRNGKey) instead of `seed` when
+    calling under jit — a traced key doesn't force a retrace per seed.
     """
     b, tp = prompt.shape
+    if max_new_tokens <= 0:  # cache-warm / degenerate budgets: [B, 0]
+        return jnp.zeros((b, 0), jnp.int32)
     total = tp + max_new_tokens
-    cache = init_cache(cfg, b, total)
     if rng is None:
         rng = jax.random.PRNGKey(seed)
+
+    logits0, cache = prefill(params, cfg, prompt, total)
+    rng, sub = jax.random.split(rng)
+    first = _sample(logits0, sub, temperature, top_k)  # token at pos Tp
 
     def step(carry, t):
         cache, cur, rng = carry
         logits, cache = decode_step(params, cfg, cache, cur, t)
         rng, sub = jax.random.split(rng)
         sampled = _sample(logits, sub, temperature, top_k)
-        # next input: prompt token while still prefilling, else sample
-        nxt = jnp.where(t + 1 < tp, prompt[:, jnp.minimum(t + 1, tp - 1)], sampled)
-        return (cache, nxt, rng), sampled
+        return (cache, sampled, rng), sampled
 
-    # the prediction at position total-1 would index past the output,
-    # so the scan stops one step short of the cache length
+    # steps write positions Tp .. total-2, predicting Tp+1 .. total-1
     (_, _, _), samples = jax.lax.scan(
         step,
-        (cache, prompt[:, 0], rng),
-        jnp.arange(total - 1),
+        (cache, first, rng),
+        jnp.arange(tp, total - 1),
     )
-    # samples[t] is the model's prediction FOR position t+1; the new
-    # tokens are the predictions from position tp-1 onward
-    return samples.T[:, tp - 1 :]
+    return jnp.concatenate([first[:, None], samples.T], axis=1)

@@ -36,23 +36,39 @@ def decode_image(data: bytes, size: Tuple[int, int]) -> np.ndarray:
     return np.asarray(img, dtype=np.uint8)
 
 
+def _is_jpeg_file(path: str) -> bool:
+    """Content sniff (SOI marker), not extension: worker-fetched inputs
+    carry store/version suffixes (`name.v3`, `name_version2`) that an
+    extension check misses — which silently sent the whole serving hot
+    path down the PIL fallback."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(2) == b"\xff\xd8"
+    except OSError:
+        return False
+
+
 def load_images(paths: Iterable[str], size: Tuple[int, int]) -> np.ndarray:
     """Decode a batch of image files -> uint8 (N, H, W, 3).
 
     Fast path: the native C++ loader (libjpeg DCT-scaled decode +
-    threaded resize, dml_tpu/native) for all-JPEG batches; PIL
-    otherwise or when the native lib is unavailable.
+    threaded resize, dml_tpu/native) for all-JPEG batches (sniffed by
+    content, not name); PIL otherwise or when the native lib is
+    unavailable.
     """
     paths = [str(p) for p in paths]
-    if paths and all(p.lower().endswith((".jpg", ".jpeg")) for p in paths):
+    if paths:
         from ..native.loader import get_loader
 
+        # loader first (cached), sniff second: without the native lib
+        # the per-file open()+read sweep would be pure overhead in the
+        # prefetch hot loop
         loader = get_loader()
-        if loader is not None:
+        if loader is not None and all(_is_jpeg_file(p) for p in paths):
             try:
                 return loader.decode_batch(paths, size)
             except RuntimeError as e:
-                # e.g. a non-JPEG payload with a .jpeg name: PIL decides
+                # e.g. a truncated JPEG payload: PIL decides
                 import logging
 
                 logging.getLogger(__name__).debug("native decode fell back: %s", e)

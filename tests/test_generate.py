@@ -39,6 +39,35 @@ def test_decode_step_matches_full_forward(lm):
         )
 
 
+def test_prefill_matches_full_forward_and_decode_cache(lm):
+    """The one-pass flash prefill must produce the same last-position
+    logits as the full model AND the same cache a step-by-step decode
+    builds (the contract that makes prefill+decode exact)."""
+    from dml_tpu.inference.generate import prefill
+
+    model, params = lm
+    tokens = jnp.asarray(
+        np.random.RandomState(5).randint(0, CFG.vocab_size, (2, 8)),
+        jnp.int32,
+    )
+    full = np.asarray(model.apply({"params": params}, tokens))
+    logits, cache = prefill(params, CFG, tokens, max_len=12)
+    np.testing.assert_allclose(np.asarray(logits), full[:, -1], atol=2e-4)
+
+    ref_cache = init_cache(CFG, 2, 12)
+    for t in range(8):
+        _, ref_cache = decode_step(
+            params, CFG, ref_cache, tokens[:, t], jnp.int32(t)
+        )
+    for blk in cache:
+        for kv in ("k", "v"):
+            np.testing.assert_allclose(
+                np.asarray(cache[blk][kv][:, :8]),
+                np.asarray(ref_cache[blk][kv][:, :8]),
+                atol=2e-4, err_msg=f"{blk}.{kv}",
+            )
+
+
 def test_greedy_generate_matches_full_forward_loop(lm):
     model, params = lm
     prompt = jnp.asarray([[3, 14, 15, 9], [2, 7, 18, 28]], jnp.int32)
