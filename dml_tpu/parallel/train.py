@@ -38,36 +38,107 @@ from ..models.registry import get_model
 from .sharding import partition_params
 
 
+def warmup_cosine(
+    peak_lr: float, warmup_steps: int, total_steps: int,
+    end_lr: float = 0.0,
+) -> optax.Schedule:
+    """The standard TPU training schedule: linear warmup into a cosine
+    decay. Pass the result as Trainer(learning_rate=...) — optax
+    optimizers take schedules wherever they take floats."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak_lr, warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1), end_value=end_lr,
+    )
+
+
 def make_train_step(
     model,
     preprocess_mode: str,
     optimizer,
     dtype=jnp.bfloat16,
+    grad_accum: int = 1,
+    remat: bool = False,
+    mesh: Optional[Mesh] = None,
 ) -> Callable:
     """The un-jitted step: (state, images_u8, labels) -> (state, metrics).
 
     `state` is a dict {params, batch_stats, opt_state, step} — a plain
     pytree so sharding annotations apply leaf-wise.
+
+    `grad_accum > 1` splits the batch into that many micro-batches and
+    accumulates gradients through a `lax.scan` — the effective batch
+    stays the same while peak activation memory drops ~grad_accum-fold
+    (the standard trick for batches that don't fit HBM). `remat` wraps
+    the forward in `jax.checkpoint`, trading recompute for activation
+    memory — composable with grad_accum for the largest models.
     """
+    def _fwd(params, batch_stats, x):
+        return model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x, train=True, mutable=["batch_stats"],
+        )
+
+    if remat:
+        # kwargs (train/mutable) are closed over, so the checkpointed
+        # function is positional-pytree-only, which jax.checkpoint wants
+        _fwd = jax.checkpoint(_fwd)
+
+    def _loss(params, batch_stats, x, labels):
+        probs, updated = _fwd(params, batch_stats, x)
+        logp = jnp.log(probs.astype(jnp.float32) + 1e-9)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+        acc = (jnp.argmax(probs, axis=-1) == labels).mean()
+        return nll, (updated["batch_stats"], acc)
+
+    grad_fn = jax.value_and_grad(_loss, has_aux=True)
 
     def train_step(state, images_u8, labels):
         x = normalize_on_device(images_u8, preprocess_mode, dtype)
 
-        def loss_fn(params):
-            probs, updated = model.apply(
-                {"params": params, "batch_stats": state["batch_stats"]},
-                x,
-                train=True,
-                mutable=["batch_stats"],
+        if grad_accum <= 1:
+            (loss, (batch_stats, acc)), grads = grad_fn(
+                state["params"], state["batch_stats"], x, labels
             )
-            logp = jnp.log(probs.astype(jnp.float32) + 1e-9)
-            nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
-            acc = (jnp.argmax(probs, axis=-1) == labels).mean()
-            return nll, (updated["batch_stats"], acc)
+        else:
+            b = x.shape[0]
+            micro = b // grad_accum
+            xm = x.reshape(grad_accum, micro, *x.shape[1:])
+            ym = labels.reshape(grad_accum, micro)
+            if mesh is not None and mesh.shape.get("dp", 1) > 1:
+                # keep each micro-batch dp-sharded (axis 1 after the
+                # reshape), or GSPMD gathers the whole batch per step
+                sh = NamedSharding(
+                    mesh, P(None, "dp", *([None] * (xm.ndim - 2)))
+                )
+                xm = jax.lax.with_sharding_constraint(xm, sh)
+                ym = jax.lax.with_sharding_constraint(
+                    ym, NamedSharding(mesh, P(None, "dp"))
+                )
 
-        (loss, (batch_stats, acc)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state["params"])
+            def accum(carry, xy):
+                gsum, bs, loss_sum, acc_sum = carry
+                xi, yi = xy
+                (loss_i, (bs, acc_i)), g = grad_fn(
+                    state["params"], bs, xi, yi
+                )
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                return (gsum, bs, loss_sum + loss_i, acc_sum + acc_i), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+            )
+            (gsum, batch_stats, loss_sum, acc_sum), _ = jax.lax.scan(
+                accum,
+                (zeros, state["batch_stats"], jnp.float32(0), jnp.float32(0)),
+                (xm, ym),
+            )
+            inv = 1.0 / grad_accum
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g * inv).astype(p.dtype),
+                gsum, state["params"],
+            )
+            loss, acc = loss_sum * inv, acc_sum * inv
+
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )
@@ -96,18 +167,28 @@ class Trainer:
         model_name: str,
         mesh: Mesh,
         batch_size: int,
-        learning_rate: float = 1e-3,
+        learning_rate=1e-3,  # float or optax schedule (warmup_cosine)
         optimizer=None,
         dtype=jnp.bfloat16,
         seed: int = 0,
         num_classes: int = 1000,
         variables: Any = None,
+        grad_accum: int = 1,
+        remat: bool = False,
     ):
         self.spec = get_model(model_name)
         self.mesh = mesh
         dp = mesh.shape.get("dp", 1)
         if batch_size % dp != 0:
             raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
+        if grad_accum < 1 or batch_size % grad_accum:
+            raise ValueError(
+                f"grad_accum {grad_accum} must divide batch_size {batch_size}"
+            )
+        if grad_accum > 1 and (batch_size // grad_accum) % dp:
+            raise ValueError(
+                f"micro-batch {batch_size // grad_accum} not divisible by dp={dp}"
+            )
         self.batch_size = batch_size
         self.model = self.spec.build(dtype=dtype, num_classes=num_classes)
         self.optimizer = optimizer or optax.adamw(learning_rate)
@@ -126,12 +207,37 @@ class Trainer:
         self.state = jax.device_put(state, self._state_shardings)
         data_sh = NamedSharding(mesh, P("dp"))
         repl = NamedSharding(mesh, P())
-        step = make_train_step(self.model, self.spec.preprocess, self.optimizer, dtype)
+        step = make_train_step(
+            self.model, self.spec.preprocess, self.optimizer, dtype,
+            grad_accum=grad_accum, remat=remat, mesh=mesh,
+        )
         self._step = jax.jit(
             step,
             in_shardings=(self._state_shardings, data_sh, data_sh),
             out_shardings=(self._state_shardings, repl),
             donate_argnums=(0,),
+        )
+        mode, dt = self.spec.preprocess, dtype
+
+        def eval_step(params, batch_stats, images_u8, labels):
+            x = normalize_on_device(images_u8, mode, dt)
+            probs = self.model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                x, train=False,
+            )
+            logp = jnp.log(probs.astype(jnp.float32) + 1e-9)
+            nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+            acc = (jnp.argmax(probs, axis=-1) == labels).mean()
+            return {"loss": nll, "accuracy": acc}
+
+        self._eval = jax.jit(
+            eval_step,
+            in_shardings=(
+                self._state_shardings["params"],
+                self._state_shardings["batch_stats"],
+                data_sh, data_sh,
+            ),
+            out_shardings=repl,
         )
         self.last_step_time: Optional[float] = None
 
@@ -143,6 +249,15 @@ class Trainer:
         )
         metrics = jax.device_get(metrics)
         self.last_step_time = time.monotonic() - t0
+        return {k: float(v) for k, v in metrics.items()}
+
+    def evaluate(self, images_u8: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+        """Inference-mode loss/accuracy on one batch (running BN
+        statistics, no state mutation)."""
+        metrics = jax.device_get(self._eval(
+            self.state["params"], self.state["batch_stats"],
+            jnp.asarray(images_u8), jnp.asarray(labels.astype(np.int32)),
+        ))
         return {k: float(v) for k, v in metrics.items()}
 
     @property

@@ -130,6 +130,28 @@ def test_moe_decode_matches_full_forward():
         )
 
 
+def test_moe_ffn_chunked_matches_unchunked(monkeypatch):
+    """Long token runs chunk the dense MoE dispatch through lax.map
+    (bounded memory at prefill); the math must equal the one-shot
+    path exactly."""
+    import dml_tpu.inference.generate as G
+
+    rng = np.random.RandomState(0)
+    d, e, dff = 16, 4, 32
+    moe = {
+        "router": {"kernel": jnp.asarray(rng.randn(d, e), jnp.float32)},
+        "w_up": jnp.asarray(rng.randn(e, d, dff), jnp.float32),
+        "w_down": jnp.asarray(rng.randn(e, dff, d), jnp.float32),
+    }
+    y = jnp.asarray(rng.randn(2, 700, d), jnp.float32)  # 1400 tokens
+    chunked = G._moe_ffn(moe, y, jnp.float32)  # > _MOE_CHUNK: lax.map
+    monkeypatch.setattr(G, "_MOE_CHUNK", 10**9)
+    ref = G._moe_ffn(moe, y, jnp.float32)  # one shot
+    np.testing.assert_allclose(
+        np.asarray(chunked), np.asarray(ref), atol=1e-4
+    )
+
+
 def test_longcontext_lm_generate_end_to_end():
     from dml_tpu.parallel.long_context import LongContextLM
     from dml_tpu.parallel.mesh import local_mesh

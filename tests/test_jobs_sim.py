@@ -759,6 +759,53 @@ async def test_auto_checkpoint_loop(tmp_path):
         assert done["total_queries"] == 64
 
 
+async def test_double_failure_coordinator_and_standby(tmp_path):
+    """Losing the coordinator AND the hot standby together exceeds
+    what the relay shadow can cover — the store-backed scheduler
+    snapshot is the designed recovery path: the third-in-line wins the
+    election, restores the snapshot from the replicated store, and
+    the job still completes on the surviving workers."""
+    async with cluster(6, tmp_path, 24300) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H6")
+        await sim.seed_images(client_u, 4)
+        client = sim.jobs[client_u]
+        gate = asyncio.Event()
+        for be in sim.backends.values():
+            be.gate = gate
+
+        job_id = await client.submit_job("ResNet50", 96)  # 3 batches
+        coord = sim.coordinator_jobs()
+        coord_u = next(iter(sim.nodes.values())).leader_unique
+        standby_u = sim.stores[coord_u].standby_node().unique_name
+        await sim.wait_for(
+            lambda: job_id in coord.scheduler.jobs, what="job intake"
+        )
+        await coord.checkpoint_jobs()  # snapshot into the store
+
+        # M=2 simultaneous failures: primary AND its hot standby
+        await sim.stop_node(coord_u)
+        await sim.stop_node(standby_u)
+
+        def third_leader():
+            leaders = {n.leader_unique for n in sim.nodes.values()}
+            return (
+                len(leaders) == 1
+                and None not in leaders
+                and next(iter(leaders)) in sim.nodes
+            )
+
+        await sim.wait_for(third_leader, timeout=15.0,
+                           what="third-in-line elected")
+        new_coord = sim.coordinator_jobs()
+        assert new_coord.scheduler.job_state(job_id) is None  # shadow died too
+        r = await new_coord.restore_jobs()
+        assert r["jobs"] >= 1
+        gate.set()
+        done = await client.wait_job(job_id, timeout=30.0)
+        assert done["total_queries"] == 96
+
+
 async def test_ten_node_ring_full_stack(tmp_path):
     """BASELINE config 4 at the reference's deployed scale: a 10-node
     ring (the reference's H1-H10 universe, config.py:54-63) running the
