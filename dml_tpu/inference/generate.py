@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.transformer import rope
+from .quantize import kernel_of
 
 RMS_EPS = 1e-6  # flax nn.RMSNorm default, as used by TransformerLM
 
@@ -104,8 +105,8 @@ def _moe_ffn(moe: Dict[str, Any], y: jax.Array, dtype) -> jax.Array:
         g2 = (gates * m2).sum(-1)
         denom = jnp.maximum(g1 + g2, 1e-9)
         w = m1 * (g1 / denom)[:, None] + m2 * (g2 / denom)[:, None]  # [n, E]
-        w_up = moe["w_up"].astype(dtype)
-        w_down = moe["w_down"].astype(dtype)
+        w_up = kernel_of(moe["w_up"], dtype)
+        w_down = kernel_of(moe["w_down"], dtype)
         h = jax.nn.silu(jnp.einsum("bd,edf->bef", tok, w_up))
         o = jnp.einsum("bef,efd->bed", h, w_down)
         return jnp.einsum("bed,be->bd", o, w.astype(dtype))
@@ -137,21 +138,21 @@ def _apply_block(
     b, t = x.shape[:2]
     h, hd = cfg.n_heads, cfg.head_dim
     y = _rms_norm(x, blk["ln_attn"]["scale"], cfg.dtype)
-    qkv = y @ blk["qkv"]["kernel"].astype(cfg.dtype)  # [B, T, 3d]
+    qkv = y @ kernel_of(blk["qkv"], cfg.dtype)  # [B, T, 3d]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = rope(q.reshape(b, t, h, hd), positions)
     k = rope(k.reshape(b, t, h, hd), positions)
     v = v.reshape(b, t, h, hd)
     attn = attn_fn(q, k, v)
     attn = attn.reshape(b, t, cfg.d_model).astype(cfg.dtype)
-    x = x + attn @ blk["proj"]["kernel"].astype(cfg.dtype)
+    x = x + attn @ kernel_of(blk["proj"], cfg.dtype)
     y = _rms_norm(x, blk["ln_mlp"]["scale"], cfg.dtype)
     if "moe" in blk:
         x = x + _moe_ffn(blk["moe"], y, cfg.dtype)
     else:
-        y = y @ blk["up"]["kernel"].astype(cfg.dtype)
+        y = y @ kernel_of(blk["up"], cfg.dtype)
         y = jax.nn.silu(y)
-        x = x + y @ blk["down"]["kernel"].astype(cfg.dtype)
+        x = x + y @ kernel_of(blk["down"], cfg.dtype)
     return x, k, v
 
 
@@ -160,7 +161,7 @@ def _head(params: Dict[str, Any], cfg: LMConfig, x_last: jax.Array) -> jax.Array
     x = _rms_norm(x_last, params["ln_out"]["scale"], cfg.dtype)
     return (
         x.astype(jnp.float32)
-        @ params["lm_head"]["kernel"].astype(jnp.float32)
+        @ kernel_of(params["lm_head"], jnp.float32)
     )[:, 0, :]
 
 

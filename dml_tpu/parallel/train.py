@@ -38,6 +38,16 @@ from ..models.registry import get_model
 from .sharding import partition_params
 
 
+def classification_metrics(probs: jax.Array, labels: jax.Array):
+    """(nll, accuracy) — the ONE definition train and eval share, so
+    a loss change (label smoothing, clipping) can't silently diverge
+    their metrics."""
+    logp = jnp.log(probs.astype(jnp.float32) + 1e-9)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+    acc = (jnp.argmax(probs, axis=-1) == labels).mean()
+    return nll, acc
+
+
 def warmup_cosine(
     peak_lr: float, warmup_steps: int, total_steps: int,
     end_lr: float = 0.0,
@@ -85,9 +95,7 @@ def make_train_step(
 
     def _loss(params, batch_stats, x, labels):
         probs, updated = _fwd(params, batch_stats, x)
-        logp = jnp.log(probs.astype(jnp.float32) + 1e-9)
-        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
-        acc = (jnp.argmax(probs, axis=-1) == labels).mean()
+        nll, acc = classification_metrics(probs, labels)
         return nll, (updated["batch_stats"], acc)
 
     grad_fn = jax.value_and_grad(_loss, has_aux=True)
@@ -217,17 +225,17 @@ class Trainer:
             out_shardings=(self._state_shardings, repl),
             donate_argnums=(0,),
         )
-        mode, dt = self.spec.preprocess, dtype
+        # bind locals: the jitted closure must not capture `self` (and
+        # with it the whole training state) for its lifetime
+        mode, dt, model = self.spec.preprocess, dtype, self.model
 
         def eval_step(params, batch_stats, images_u8, labels):
             x = normalize_on_device(images_u8, mode, dt)
-            probs = self.model.apply(
+            probs = model.apply(
                 {"params": params, "batch_stats": batch_stats},
                 x, train=False,
             )
-            logp = jnp.log(probs.astype(jnp.float32) + 1e-9)
-            nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
-            acc = (jnp.argmax(probs, axis=-1) == labels).mean()
+            nll, acc = classification_metrics(probs, labels)
             return {"loss": nll, "accuracy": acc}
 
         self._eval = jax.jit(

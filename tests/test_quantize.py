@@ -1,0 +1,128 @@
+"""Weight-only int8 serving (inference/quantize.py): round-trip error
+bounds, structural coverage, serving parity through the generate path,
+and the LongContextLM knob."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dml_tpu.inference.generate import LMConfig, prefill
+from dml_tpu.inference.quantize import (
+    is_quantized,
+    kernel_of,
+    quantize_lm_params,
+    quantized_bytes,
+)
+from dml_tpu.models.transformer import TransformerLM
+
+CFG = LMConfig(vocab_size=61, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+               dtype=jnp.float32)
+
+
+def _params(moe=False, seed=0):
+    kw = dict(vocab_size=CFG.vocab_size, d_model=CFG.d_model,
+              n_heads=CFG.n_heads, n_layers=CFG.n_layers, d_ff=CFG.d_ff,
+              dtype=jnp.float32)
+    if moe:
+        kw.update(num_experts=4, moe_every=1)
+    model = TransformerLM(**kw)
+    variables = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+    )
+    return variables["params"]
+
+
+def test_quant_roundtrip_error_bounded():
+    params = _params()
+    q = quantize_lm_params(params)
+    w = np.asarray(params["block_0"]["qkv"]["kernel"])
+    wq = np.asarray(kernel_of(q["block_0"]["qkv"], jnp.float32))
+    assert q["block_0"]["qkv"]["kernel"]["q"].dtype == jnp.int8
+    # symmetric per-channel: error <= scale/2 per element
+    scale = np.asarray(q["block_0"]["qkv"]["kernel"]["scale"])
+    assert np.all(np.abs(w - wq) <= scale / 2 + 1e-7)
+
+
+def test_quant_structure_and_bytes():
+    params = _params(moe=True)
+    q = quantize_lm_params(params)
+    # big matmuls quantized; norms/embeddings/router untouched
+    assert is_quantized(q["block_0"]["qkv"]["kernel"])
+    assert is_quantized(q["lm_head"]["kernel"])
+    assert is_quantized(q["block_0"]["moe"]["w_up"])
+    assert not is_quantized(q["block_0"]["moe"]["router"]["kernel"])
+    np.testing.assert_array_equal(
+        np.asarray(q["embed"]["embedding"]),
+        np.asarray(params["embed"]["embedding"]),
+    )
+    now, _ = quantized_bytes(q)
+    base, _ = quantized_bytes(params)
+    # int8 kernels shrink the tree even counting the per-channel
+    # scale tensors the quantized form adds
+    assert now < base
+
+
+import pytest
+
+
+@pytest.mark.parametrize("moe", [False, True])
+def test_quantized_prefill_close_to_float(moe):
+    """Serving parity: prefill logits through the quantized tree stay
+    highly correlated with the float tree (weight-only int8 bounds the
+    logit perturbation) — including MoE blocks with their
+    per-(expert, channel) scales."""
+    params = _params(moe=moe, seed=3)
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, CFG.vocab_size, (2, 12)),
+        jnp.int32,
+    )
+    lf, _ = prefill(params, CFG, tokens, max_len=16)
+    lq, cache_q = prefill(quantize_lm_params(params), CFG, tokens, max_len=16)
+    a = np.asarray(lf).ravel()
+    b = np.asarray(lq).ravel()
+    corr = float(np.corrcoef(a, b)[0, 1])
+    assert corr > 0.999, corr
+    # cache shapes identical (decode continues transparently)
+    assert cache_q["block_0"]["k"].shape == (2, 16, CFG.n_heads, CFG.head_dim)
+
+
+def test_moe_scales_are_per_expert():
+    """An outlier expert must not inflate other experts' scales."""
+    params = _params(moe=True)
+    w_up = np.array(params["block_0"]["moe"]["w_up"])  # writable copy
+    w_up[3] *= 100.0  # expert 3 becomes an outlier
+    params["block_0"]["moe"]["w_up"] = jnp.asarray(w_up)
+    q = quantize_lm_params(params)
+    scale = np.asarray(q["block_0"]["moe"]["w_up"]["scale"])
+    assert scale.shape[0] == w_up.shape[0]  # one scale row per expert
+    assert scale[3].mean() > 10 * scale[0].mean()  # outlier isolated
+    # expert 0's reconstruction is unaffected by expert 3's magnitude
+    from dml_tpu.inference.quantize import kernel_of
+
+    deq = np.asarray(kernel_of(q["block_0"]["moe"]["w_up"], jnp.float32))
+    assert np.abs(deq[0] - w_up[0]).max() <= scale[0].max() / 2 + 1e-7
+
+
+def test_longcontext_generate_quantized_runs():
+    from dml_tpu.parallel.long_context import LongContextLM
+    from dml_tpu.parallel.mesh import local_mesh
+
+    mesh = local_mesh(dp=-1)  # all 8 virtual devices on dp
+    lm = LongContextLM(
+        mesh, seq_len=32, vocab_size=64, d_model=32, n_heads=2,
+        n_layers=2, d_ff=64, dtype=jnp.float32,
+    )
+    prompt = np.array([[1, 2, 3, 4]], np.int32)
+    out_f = lm.generate(prompt, 6)
+    out_q = lm.generate(prompt, 6, quantize_weights=True)
+    assert out_f.shape == out_q.shape == (1, 6)
+    assert (0 <= out_q).all() and (out_q < 64).all()
+    # f32 model + f32 params: the default cast is a no-op, so the
+    # training tree serves ZERO-COPY (no duplicate parameter HBM)
+    assert lm._serving_params(quantized=False, cast=True) is lm.state["params"]
+    assert lm._serving_params(quantized=False, cast=False) is lm.state["params"]
+    # only the int8 form was materialized, cached per training step
+    assert lm._serve_params[0] == 0
+    assert set(lm._serve_params[1]) == {"int8"}
+    lm.generate(prompt, 6, quantize_weights=True)
+    assert lm._serve_params[0] == 0
