@@ -52,15 +52,30 @@ class LMConfig:
     n_layers: int
     d_ff: int
     dtype: Any = jnp.bfloat16
+    n_kv_heads: Optional[int] = None  # GQA; None = MHA
+
+    def __post_init__(self):
+        kv = self.n_kv_heads
+        if kv is not None and (kv <= 0 or self.n_heads % kv):
+            raise ValueError(
+                f"n_kv_heads {kv} must be positive and divide "
+                f"n_heads {self.n_heads}"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+
 
 def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
-    """Pre-allocated KV cache: one [B, max_len, H, D] pair per layer."""
-    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    """Pre-allocated KV cache: one [B, max_len, KV, D] pair per layer
+    — KV = n_kv_heads under GQA, so the cache (and each decode step's
+    HBM reads of it) shrinks n_heads/n_kv_heads-fold."""
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {
         f"block_{i}": {
             "k": jnp.zeros(shape, cfg.dtype),
@@ -136,14 +151,16 @@ def _apply_block(
     Matches models/transformer.py layer-for-layer.
     """
     b, t = x.shape[:2]
-    h, hd = cfg.n_heads, cfg.head_dim
+    h, hd, kv = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     y = _rms_norm(x, blk["ln_attn"]["scale"], cfg.dtype)
-    qkv = y @ kernel_of(blk["qkv"], cfg.dtype)  # [B, T, 3d]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    qkv = y @ kernel_of(blk["qkv"], cfg.dtype)  # [B, T, d + 2*kv*hd]
+    q = qkv[..., : cfg.d_model]
+    k = qkv[..., cfg.d_model : cfg.d_model + kv * hd]
+    v = qkv[..., cfg.d_model + kv * hd :]
     q = rope(q.reshape(b, t, h, hd), positions)
-    k = rope(k.reshape(b, t, h, hd), positions)
-    v = v.reshape(b, t, h, hd)
-    attn = attn_fn(q, k, v)
+    k = rope(k.reshape(b, t, kv, hd), positions)
+    v = v.reshape(b, t, kv, hd)
+    attn = attn_fn(q, k, v)  # k/v carry kv heads; the closure decides
     attn = attn.reshape(b, t, cfg.d_model).astype(cfg.dtype)
     x = x + attn @ kernel_of(blk["proj"], cfg.dtype)
     y = _rms_norm(x, blk["ln_mlp"]["scale"], cfg.dtype)
@@ -178,6 +195,8 @@ def decode_step(
     (same layer math, same dtypes).
     """
     hd = cfg.head_dim
+    b = tokens.shape[0]
+    grp = cfg.n_heads // cfg.kv_heads  # query heads per KV head (GQA)
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)  # [B, d]
     x = x[:, None, :]  # [B, 1, d]
     positions = idx[None]  # [1]
@@ -190,6 +209,9 @@ def decode_step(
         name = f"block_{i}"
 
         def attn_fn(q, k, v, name=name):
+            # cache keeps the COMPACT kv-head layout — the whole point
+            # of GQA is that each decode step streams n_kv_heads worth
+            # of cache, not n_heads
             ck = jax.lax.dynamic_update_slice_in_dim(
                 cache[name]["k"], k.astype(cfg.dtype), idx, axis=1
             )
@@ -197,12 +219,15 @@ def decode_step(
                 cache[name]["v"], v.astype(cfg.dtype), idx, axis=1
             )
             new_cache[name] = {"k": ck, "v": cv}
-            # single query against the whole cache (masked)
-            s = jnp.einsum("bqhd,bthd->bhqt", q.astype(jnp.float32),
-                           ck.astype(jnp.float32)) * (hd**-0.5)
-            s = jnp.where(valid[None, None, None, :], s, -1e30)
+            # grouped single-query attention against the masked cache
+            qg = q.astype(jnp.float32).reshape(b, 1, cfg.kv_heads, grp, hd)
+            s = jnp.einsum(
+                "bqkgd,btkd->bkgqt", qg, ck.astype(jnp.float32)
+            ) * (hd**-0.5)
+            s = jnp.where(valid[None, None, None, None, :], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            return jnp.einsum("bhqt,bthd->bqhd", p, cv.astype(jnp.float32))
+            attn = jnp.einsum("bkgqt,btkd->bqkgd", p, cv.astype(jnp.float32))
+            return attn.reshape(b, 1, cfg.n_heads, hd)
 
         x, _, _ = _apply_block(params[name], cfg, x, positions, attn_fn)
 
@@ -230,8 +255,15 @@ def prefill(
     x = params["embed"]["embedding"][prompt].astype(cfg.dtype)  # [B,Tp,d]
     positions = jnp.arange(tp)
     pad = max_len - tp
+    grp = cfg.n_heads // cfg.kv_heads
 
     def attn_fn(q, k, v):
+        # flash kernel is head-symmetric: broadcast GQA kv heads to
+        # full heads for the prefill pass (the cache below keeps the
+        # compact layout _apply_block returned)
+        if grp > 1:
+            k = jnp.repeat(k, grp, axis=2)
+            v = jnp.repeat(v, grp, axis=2)
         return flash_attention(q, k, v, causal=True)
 
     cache: Dict[str, Any] = {}

@@ -46,6 +46,10 @@ class Block(nn.Module):
     d_ff: int
     attention: AttentionFn
     dtype: Any = jnp.bfloat16
+    # grouped-query attention: K/V get this many heads (must divide
+    # n_heads); None = multi-head (n_heads). Shrinks the serving KV
+    # cache — and its per-token HBM reads — by n_heads/n_kv_heads.
+    n_kv_heads: Optional[int] = None
     # >0 turns this block's FFN into a mixture-of-experts
     # (parallel/moe.py), sharded over `ep` when `mesh` is given
     num_experts: int = 0
@@ -56,13 +60,23 @@ class Block(nn.Module):
     def __call__(self, x, positions):
         b, t, _ = x.shape
         h, hd = self.n_heads, self.d_model // self.n_heads
+        kv = self.n_kv_heads or h
+        if h % kv:
+            raise ValueError(f"n_kv_heads {kv} must divide n_heads {h}")
         y = nn.RMSNorm(dtype=self.dtype, name="ln_attn")(x)
-        qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=self.dtype,
-                       name="qkv")(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = nn.Dense(self.d_model + 2 * kv * hd, use_bias=False,
+                       dtype=self.dtype, name="qkv")(y)
+        q = qkv[..., : self.d_model]
+        k = qkv[..., self.d_model : self.d_model + kv * hd]
+        v = qkv[..., self.d_model + kv * hd :]
         q = rope(q.reshape(b, t, h, hd), positions)
-        k = rope(k.reshape(b, t, h, hd), positions)
-        v = v.reshape(b, t, h, hd)
+        k = rope(k.reshape(b, t, kv, hd), positions)
+        v = v.reshape(b, t, kv, hd)
+        if kv != h:
+            # broadcast KV groups to full heads at use: the attention
+            # kernels (flash / ring / reference) stay head-symmetric
+            k = jnp.repeat(k, h // kv, axis=2)
+            v = jnp.repeat(v, h // kv, axis=2)
         attn = self.attention(q, k, v, causal=True)
         attn = attn.reshape(b, t, self.d_model)
         x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
@@ -93,6 +107,7 @@ class TransformerLM(nn.Module):
     d_ff: int = 2048
     attention: Optional[AttentionFn] = None
     dtype: Any = jnp.bfloat16
+    n_kv_heads: Optional[int] = None  # GQA; None = MHA
     # num_experts > 0 makes every `moe_every`-th block's FFN an MoE
     # (GShard-style interleaving: dense and sparse blocks alternate)
     num_experts: int = 0
@@ -116,6 +131,7 @@ class TransformerLM(nn.Module):
             x = Block(
                 d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
                 attention=attn, dtype=self.dtype, name=f"block_{i}",
+                n_kv_heads=self.n_kv_heads,
                 num_experts=self.num_experts if is_moe else 0,
                 capacity_factor=self.capacity_factor, mesh=self.mesh,
             )(x, positions)

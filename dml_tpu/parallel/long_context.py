@@ -208,6 +208,7 @@ class LongContextLM:
         cfg = LMConfig(
             vocab_size=m.vocab_size, d_model=m.d_model, n_heads=m.n_heads,
             n_layers=m.n_layers, d_ff=m.d_ff, dtype=m.dtype,
+            n_kv_heads=m.n_kv_heads,
         )
         # one jitted closure per decode config, cached — repeated
         # serving calls must not re-trace the n_layers decode graph

@@ -80,6 +80,26 @@ def test_ring_first_token_attends_only_itself():
     np.testing.assert_allclose(out[0, 0], np.asarray(v)[0, 0], rtol=1e-5, atol=1e-5)
 
 
+def test_long_context_lm_gqa_trains_and_generates():
+    """GQA flows through the whole long-context stack: sp-sharded
+    training (kv heads broadcast before the ring) and compact-cache
+    generation."""
+    from dml_tpu.parallel.long_context import LongContextLM
+
+    mesh = local_mesh(dp=2, sp=4)
+    lm = LongContextLM(
+        mesh, seq_len=64, vocab_size=64, d_model=32, n_heads=4,
+        n_layers=2, d_ff=64, dtype=jnp.float32, n_kv_heads=2,
+        learning_rate=1e-2,
+    )
+    tokens = np.tile(np.tile(np.arange(8), 8)[None, :64], (2, 1)).astype(np.int32)
+    losses = [lm.train_step(tokens) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    out = lm.generate(np.array([[1, 2, 3, 4]], np.int32), 6)
+    assert out.shape == (1, 6)
+    assert (0 <= out).all() and (out < 64).all()
+
+
 def test_long_context_lm_trains_sharded():
     from dml_tpu.parallel.long_context import LongContextLM
 
