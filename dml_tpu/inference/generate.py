@@ -192,39 +192,55 @@ def decode_step(
     """One decode step: logits for position `idx` + updated cache.
 
     Matches TransformerLM.apply on the prefix up to `idx` exactly
-    (same layer math, same dtypes).
+    (same layer math, same dtypes). The shared-position special case
+    of `batched_decode_step` — ONE implementation, so the
+    single-request and continuous-batching paths cannot diverge.
     """
+    b = tokens.shape[0]
+    return batched_decode_step(
+        params, cfg, cache, tokens, jnp.full((b,), idx, jnp.int32)
+    )
+
+
+def batched_decode_step(
+    params: Dict[str, Any],
+    cfg: LMConfig,
+    cache: Dict[str, Any],
+    tokens: jax.Array,  # [B] int32 — each slot's current input token
+    pos: jax.Array,  # [B] int32 — each slot's own write position
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """decode_step with PER-SLOT positions — the continuous-batching
+    primitive (inference/lm_server.py): every slot advances through
+    its own sequence independently, so requests of different lengths
+    decode together in one program. Identical math to decode_step
+    (which is the pos-broadcast special case)."""
     hd = cfg.head_dim
     b = tokens.shape[0]
-    grp = cfg.n_heads // cfg.kv_heads  # query heads per KV head (GQA)
-    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)  # [B, d]
-    x = x[:, None, :]  # [B, 1, d]
-    positions = idx[None]  # [1]
+    grp = cfg.n_heads // cfg.kv_heads
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)[:, None, :]
+    positions = pos[:, None]  # [B, 1] — rope's per-example form
     max_len = next(iter(cache.values()))["k"].shape[1]
-    # mask over cached positions: only <= idx are valid
-    valid = jnp.arange(max_len) <= idx  # [T]
+    # per-slot validity: slot b sees cache positions <= pos[b]
+    valid = jnp.arange(max_len)[None, :] <= pos[:, None]  # [B, T]
 
     new_cache: Dict[str, Any] = {}
     for i in range(cfg.n_layers):
         name = f"block_{i}"
 
         def attn_fn(q, k, v, name=name):
-            # cache keeps the COMPACT kv-head layout — the whole point
-            # of GQA is that each decode step streams n_kv_heads worth
-            # of cache, not n_heads
-            ck = jax.lax.dynamic_update_slice_in_dim(
-                cache[name]["k"], k.astype(cfg.dtype), idx, axis=1
+            upd = jax.vmap(
+                lambda c, u, p: jax.lax.dynamic_update_slice_in_dim(
+                    c, u, p, axis=0
+                )
             )
-            cv = jax.lax.dynamic_update_slice_in_dim(
-                cache[name]["v"], v.astype(cfg.dtype), idx, axis=1
-            )
+            ck = upd(cache[name]["k"], k.astype(cfg.dtype), pos)
+            cv = upd(cache[name]["v"], v.astype(cfg.dtype), pos)
             new_cache[name] = {"k": ck, "v": cv}
-            # grouped single-query attention against the masked cache
             qg = q.astype(jnp.float32).reshape(b, 1, cfg.kv_heads, grp, hd)
             s = jnp.einsum(
                 "bqkgd,btkd->bkgqt", qg, ck.astype(jnp.float32)
             ) * (hd**-0.5)
-            s = jnp.where(valid[None, None, None, None, :], s, -1e30)
+            s = jnp.where(valid[:, None, None, None, :], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             attn = jnp.einsum("bkgqt,btkd->bqkgd", p, cv.astype(jnp.float32))
             return attn.reshape(b, 1, cfg.n_heads, hd)

@@ -1,0 +1,295 @@
+"""Continuous-batching LM server: requests join and leave a running
+decode batch.
+
+The plain `generate` path serves one request shape per call; a real
+serving workload has requests of different prompt lengths and budgets
+arriving while others are mid-decode. This server keeps `max_slots`
+sequences decoding together in ONE compiled program:
+
+- a fixed slot grid: per-layer KV cache [slots, max_len, KV, D] plus
+  per-slot position/current-token vectors — static shapes, so one
+  compilation serves every mix of requests;
+- `submit()` prefills the new request's prompt in one flash-attention
+  forward (prompt lengths bucketed to powers of two to bound distinct
+  compilations) and writes its cache rows into a free slot;
+- `run()`/`step()` advance EVERY active slot one token per
+  `batched_decode_step` (per-slot positions), `chunk` tokens per
+  dispatch through a `lax.scan` — host round-trips (expensive through
+  a remoted TPU) amortize over the chunk;
+- finished slots free immediately and the next queued request takes
+  the slot — no drain barrier, which is the whole point of continuous
+  batching.
+
+Correctness contract (pinned by tests/test_lm_server.py): greedy
+outputs are IDENTICAL to running `generate` per request in isolation —
+batching is a throughput decision, never a semantics change.
+
+Measured on v5e (12-layer 1024d GQA-4 LM, bf16): 1 slot decodes at
+1177 tok/s, 8 slots at 3799 tok/s aggregate — 3.2x, because the
+weight stream (the per-step HBM bill) is shared by every slot.
+Caveat for remoted chips: the server makes several dispatches per
+request (prefill, insert, chunks); through a high-latency tunnel the
+round trips dominate and a single fused `generate` call can win —
+on a local TPU host dispatch is microseconds and the device-side
+rate is what you get.
+
+Net-new vs the reference (inference over single images, no sequence
+serving — SURVEY §0); the slot scheduler is the LM-serving analog of
+the job scheduler's one-batch-per-worker fair-share loop
+(jobs/scheduler.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generate import (
+    LMConfig,
+    _sample,
+    batched_decode_step,
+    init_cache,
+    prefill,
+)
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray  # [Tp] int32
+    max_new_tokens: int
+    out: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        return len(self.out) >= self.max_new_tokens
+
+
+class LMServer:
+    """Slot-based continuous batching over `batched_decode_step`.
+
+    >>> srv = LMServer(params, cfg, max_slots=4, max_len=512)
+    >>> a = srv.submit(prompt_a, max_new_tokens=64)
+    >>> b = srv.submit(prompt_b, max_new_tokens=32)
+    >>> results = srv.run()          # {rid: np.ndarray of new tokens}
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: LMConfig,
+        max_slots: int = 4,
+        max_len: int = 1024,
+        chunk: int = 16,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        seed: int = 0,
+    ):
+        if chunk < 1:
+            raise ValueError("chunk must be >= 1")
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.chunk = chunk
+        self.temperature = temperature
+        self.top_k = top_k
+        self.cache = init_cache(cfg, max_slots, max_len)
+        self.pos = np.zeros(max_slots, np.int32)  # next write position
+        self.cur = np.zeros(max_slots, np.int32)  # next input token
+        self._slot_req: List[Optional[_Request]] = [None] * max_slots
+        self._queue: List[_Request] = []
+        self._done: Dict[int, _Request] = {}
+        self._rid = 0
+        self._rng = jax.random.PRNGKey(seed)
+        self._prefill_cache: Dict[int, Any] = {}  # bucket -> jitted fn
+        # params are explicit ARGUMENTS to every jitted piece — closing
+        # over them would bake the whole weight tree into the program
+        # as constants (rejected outright by remote compile services
+        # for real model sizes)
+        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=(1,))
+        self._redecode = jax.jit(
+            lambda w, c, t, p: batched_decode_step(
+                w, self.cfg, c, t, p
+            ),
+            donate_argnums=(1,),
+        )
+
+    # -- jitted pieces -------------------------------------------------
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_cache.get(bucket)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, pr: prefill(p, self.cfg, pr, self.max_len)
+            )
+            self._prefill_cache[bucket] = fn
+        return fn
+
+    def _insert_impl(self, cache, pcache, slot, n_valid):
+        """Copy a prefilled request's cache rows into `slot`. Only the
+        first `n_valid` positions carry real data, but copying the
+        whole row is one contiguous DMA and stale tail positions are
+        invisible behind the per-slot validity mask."""
+        del n_valid
+        out = {}
+        for name, kv in cache.items():
+            src_k = pcache[name]["k"][0]
+            src_v = pcache[name]["v"][0]
+            out[name] = {
+                "k": kv["k"].at[slot].set(src_k),
+                "v": kv["v"].at[slot].set(src_v),
+            }
+        return out
+
+    def _chunk_impl(self, params, cache, cur, pos, rng):
+        """`chunk` batched decode steps in one dispatch."""
+
+        def body(carry, _):
+            cache, cur, pos, rng = carry
+            logits, cache = batched_decode_step(
+                params, self.cfg, cache, cur, pos
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits, sub, self.temperature, self.top_k)
+            return (cache, nxt, pos + 1, rng), nxt
+
+        (cache, cur, pos, rng), toks = jax.lax.scan(
+            body, (cache, cur, pos, rng), None, length=self.chunk
+        )
+        return cache, cur, pos, rng, toks  # toks: [chunk, slots]
+
+    # -- public API ----------------------------------------------------
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
+        """Queue a request; returns its request id. Placement happens
+        immediately if a slot is free, else at the next step()."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            # generate() returns [B, 0] for a zero budget; a server
+            # request always produces tokens, so reject instead of
+            # silently emitting one
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {prompt.size} + budget {max_new_tokens} "
+                f"exceeds max_len {self.max_len}"
+            )
+        self._rid += 1
+        req = _Request(self._rid, prompt, max_new_tokens)
+        self._queue.append(req)
+        self._place_waiting()
+        return req.rid
+
+    def _place_waiting(self) -> None:
+        for slot in range(self.max_slots):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            req = self._queue.pop(0)
+            tp = req.prompt.size
+            bucket = min(_bucket(tp), self.max_len)
+            padded = np.zeros(bucket, np.int32)
+            padded[:tp] = req.prompt
+            # pad with the last token: garbage positions >= tp are
+            # behind the validity mask, but rope/cache still write them
+            padded[tp:] = req.prompt[-1]
+            logits, pcache = self._prefill_fn(bucket)(
+                self.params, jnp.asarray(padded[None, :])
+            )
+            self.cache = self._insert(
+                self.cache, pcache, jnp.int32(slot), jnp.int32(tp)
+            )
+            if tp == bucket:
+                first_logits = np.asarray(logits[0])
+            else:
+                # bucket padding means the prefill's returned logits
+                # sit at the PAD tail, not the true last prompt
+                # position — re-decode position tp-1 through the
+                # validity mask for exact logits. Other slots decode
+                # a throwaway token at their current (cur, pos): the
+                # cache write is idempotent (same values the next
+                # chunk writes) and the logits are discarded.
+                lg, self.cache = self._redecode(
+                    self.params,
+                    self.cache,
+                    jnp.asarray(np.where(
+                        np.arange(self.max_slots) == slot,
+                        req.prompt[-1], self.cur,
+                    ).astype(np.int32)),
+                    jnp.asarray(np.where(
+                        np.arange(self.max_slots) == slot,
+                        tp - 1, self.pos,
+                    ).astype(np.int32)),
+                )
+                first_logits = np.asarray(lg[slot])
+            self._rng, sub = jax.random.split(self._rng)
+            first = int(np.asarray(
+                _sample(jnp.asarray(first_logits[None]), sub,
+                        self.temperature, self.top_k)
+            )[0])
+            req.out.append(first)
+            req.slot = slot
+            self._slot_req[slot] = req
+            self.pos[slot] = tp
+            self.cur[slot] = first
+            if req.done:  # max_new_tokens == 1
+                self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        assert req is not None
+        self._done[req.rid] = req
+        req.slot = None
+        self._slot_req[slot] = None
+
+    def step(self) -> None:
+        """One chunked dispatch: every active slot advances up to
+        `chunk` tokens; finished slots free and waiting requests take
+        their place."""
+        if not any(r is not None for r in self._slot_req):
+            self._place_waiting()
+            if not any(r is not None for r in self._slot_req):
+                return
+        self.cache, cur, pos, self._rng, toks = self._chunk_fn(
+            self.params, self.cache, jnp.asarray(self.cur),
+            jnp.asarray(self.pos), self._rng,
+        )
+        toks = np.asarray(toks)  # [chunk, slots]
+        cur, pos = np.asarray(cur), np.asarray(pos)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            take = min(self.chunk, req.max_new_tokens - len(req.out))
+            req.out.extend(int(t) for t in toks[:take, slot])
+            self.pos[slot] = self.pos[slot] + take
+            self.cur[slot] = int(toks[take - 1, slot]) if take else cur[slot]
+            if req.done:
+                self._retire(slot)
+        self._place_waiting()
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive until every submitted request finishes; returns
+        {rid: generated tokens}."""
+        while self._queue or any(r is not None for r in self._slot_req):
+            self.step()
+        out = {
+            rid: np.asarray(r.out, np.int32)
+            for rid, r in self._done.items()
+        }
+        self._done.clear()
+        return out

@@ -26,13 +26,19 @@ AttentionFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
 
 
 def rope(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
-    """Rotary position embedding. x: [B, T, H, D], positions: [T]."""
+    """Rotary position embedding. x: [B, T, H, D]; positions: [T]
+    shared across the batch, or [B, T] per-example (continuous-batching
+    decode, where each slot sits at its own sequence position)."""
     d = x.shape[-1]
     half = d // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, half]
+    if positions.ndim == 1:
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:  # [B, T, half] -> broadcast over heads
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
