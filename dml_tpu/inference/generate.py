@@ -141,7 +141,7 @@ def _apply_block(
     blk: Dict[str, Any],
     cfg: LMConfig,
     x: jax.Array,  # [B, T, d]
-    positions: jax.Array,  # [T]
+    positions: jax.Array,  # [T] shared or [B, T] per-example
     attn_fn,  # (q, k, v) [B,T,H,D] -> [B,T,H,D]
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """ONE transformer block — the single copy of the layer math that
@@ -149,6 +149,10 @@ def _apply_block(
     both run, so they cannot drift apart. Returns (x_out, k, v); the
     caller owns what the attention closure and the cache do with k/v.
     Matches models/transformer.py layer-for-layer.
+
+    `positions` is [T] (shared across the batch: prefill, plain
+    decode) or [B, T] (per-example: continuous-batching decode, where
+    every slot sits at its own position) — rope handles both forms.
     """
     b, t = x.shape[:2]
     h, hd, kv = cfg.n_heads, cfg.head_dim, cfg.kv_heads
@@ -255,9 +259,17 @@ def prefill(
     cfg: LMConfig,
     prompt: jax.Array,  # [B, Tp] int32
     max_len: int,
+    logits_index: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, Any]]:
     """Process the WHOLE prompt in one forward: returns (logits at the
     last prompt position [B, V], cache filled for positions < Tp).
+
+    `logits_index` (scalar) selects which position's logits to return
+    instead of the last — the continuous-batching server prefills
+    bucket-PADDED prompts, and causal masking guarantees the logits at
+    the true last prompt position are untouched by the pad tail, so
+    reading them here keeps the server's first token numerically
+    IDENTICAL to an unpadded `generate` call.
 
     The old path pushed the prompt through the decode scan one token
     at a time — O(Tp) sequential [B,1] steps that leave the MXU idle.
@@ -294,7 +306,11 @@ def prefill(
                          ((0, 0), (0, pad), (0, 0), (0, 0))),
         }
 
-    return _head(params, cfg, x[:, -1:]), cache
+    if logits_index is None:
+        x_last = x[:, -1:]
+    else:
+        x_last = jax.lax.dynamic_slice_in_dim(x, logits_index, 1, axis=1)
+    return _head(params, cfg, x_last), cache
 
 
 def _sample(logits, rng, temperature: float, top_k: Optional[int]):

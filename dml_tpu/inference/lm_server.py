@@ -99,6 +99,8 @@ class LMServer:
     ):
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -114,30 +116,18 @@ class LMServer:
         self._done: Dict[int, _Request] = {}
         self._rid = 0
         self._rng = jax.random.PRNGKey(seed)
-        self._prefill_cache: Dict[int, Any] = {}  # bucket -> jitted fn
         # params are explicit ARGUMENTS to every jitted piece — closing
         # over them would bake the whole weight tree into the program
         # as constants (rejected outright by remote compile services
-        # for real model sizes)
+        # for real model sizes). jax.jit's own cache handles one
+        # compilation per distinct prompt bucket.
+        self._prefill = jax.jit(
+            lambda p, pr, li: prefill(
+                p, self.cfg, pr, self.max_len, logits_index=li
+            )
+        )
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=(1,))
-        self._redecode = jax.jit(
-            lambda w, c, t, p: batched_decode_step(
-                w, self.cfg, c, t, p
-            ),
-            donate_argnums=(1,),
-        )
-
-    # -- jitted pieces -------------------------------------------------
-
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_cache.get(bucket)
-        if fn is None:
-            fn = jax.jit(
-                lambda p, pr: prefill(p, self.cfg, pr, self.max_len)
-            )
-            self._prefill_cache[bucket] = fn
-        return fn
 
     def _insert_impl(self, cache, pcache, slot, n_valid):
         """Copy a prefilled request's cache rows into `slot`. Only the
@@ -208,35 +198,18 @@ class LMServer:
             # pad with the last token: garbage positions >= tp are
             # behind the validity mask, but rope/cache still write them
             padded[tp:] = req.prompt[-1]
-            logits, pcache = self._prefill_fn(bucket)(
-                self.params, jnp.asarray(padded[None, :])
+            # logits_index = tp-1: causal masking makes the logits at
+            # the true last prompt position identical to an UNPADDED
+            # prefill's, so the first token matches generate() exactly
+            # (bit-for-bit, any dtype) despite the bucket padding
+            logits, pcache = self._prefill(
+                self.params, jnp.asarray(padded[None, :]),
+                jnp.int32(tp - 1),
             )
             self.cache = self._insert(
                 self.cache, pcache, jnp.int32(slot), jnp.int32(tp)
             )
-            if tp == bucket:
-                first_logits = np.asarray(logits[0])
-            else:
-                # bucket padding means the prefill's returned logits
-                # sit at the PAD tail, not the true last prompt
-                # position — re-decode position tp-1 through the
-                # validity mask for exact logits. Other slots decode
-                # a throwaway token at their current (cur, pos): the
-                # cache write is idempotent (same values the next
-                # chunk writes) and the logits are discarded.
-                lg, self.cache = self._redecode(
-                    self.params,
-                    self.cache,
-                    jnp.asarray(np.where(
-                        np.arange(self.max_slots) == slot,
-                        req.prompt[-1], self.cur,
-                    ).astype(np.int32)),
-                    jnp.asarray(np.where(
-                        np.arange(self.max_slots) == slot,
-                        tp - 1, self.pos,
-                    ).astype(np.int32)),
-                )
-                first_logits = np.asarray(lg[slot])
+            first_logits = np.asarray(logits[0])
             self._rng, sub = jax.random.split(self._rng)
             first = int(np.asarray(
                 _sample(jnp.asarray(first_logits[None]), sub,
