@@ -66,6 +66,7 @@ class Node:
         self._tasks: List[asyncio.Task] = []
         self._stopped = asyncio.Event()
         self._left = False
+        self._probe_idx = 0  # anti-entropy probe round-robin cursor
         # services hook these (wired by store/job services at attach)
         self.on_node_failed_cbs: List[Callable[[str], None]] = []
         self.on_coordinate_ack_cbs: List[Callable[[str, Dict], None]] = []
@@ -223,6 +224,7 @@ class Node:
                     if self.election.in_progress:
                         self._election_tick()
                     await self._ping_round()
+                    self._anti_entropy_probe()
             except Exception:
                 log.exception("%s: failure-detection tick failed", self.me)
             await asyncio.sleep(self.spec.timing.ping_interval)
@@ -253,6 +255,63 @@ class Node:
         finally:
             if self._ack_waiters.get(uname) is ev:
                 del self._ack_waiters[uname]
+
+    def _anti_entropy_probe(self) -> None:
+        """Each tick, ping ONE spec node we currently believe dead
+        (round-robin). Ping targets come from the ALIVE list, so once
+        a node is cleaned up nothing would ever talk to it again —
+        a healed network partition (or a false-positive cleanup the
+        node never noticed) would leave the cluster permanently split.
+        The probe re-establishes contact: its ACK resurrects the peer
+        here (mark_alive clears the tombstone) and the piggybacked
+        gossip + leader fields resurrect this side over there. One
+        datagram per tick; dead-forever nodes just never answer.
+        (The reference has no equivalent — a cleaned node can only
+        return via a voluntary re-join, README STEP-4.)"""
+        alive = {n.unique_name for n in self.membership.alive_nodes()}
+        candidates = [
+            n for n in self.spec.nodes
+            if n.unique_name != self.me.unique_name
+            and n.unique_name not in alive
+        ]
+        if not candidates:
+            return
+        target = candidates[self._probe_idx % len(candidates)]
+        self._probe_idx += 1
+        self.send(target, MsgType.PING, {
+            "members": self.membership.snapshot(),
+            "leader": self.membership.leader,
+        })
+
+    def _check_leader_conflict(self, their_leader: Optional[str]) -> None:
+        """Two sides of a healed partition each elected a leader; the
+        disagreement is only observable through the leader field that
+        pings/ACKs piggyback. Re-running the bully election converges
+        everyone on the rank winner AND rebuilds the store's global
+        table from the COORDINATE_ACK inventories — the same
+        reconciliation a failover uses.
+
+        Guard: the foreign leader must be ALIVE in our merged view.
+        During an ordinary failover, a node still carrying the DEAD
+        old leader in its gossip would otherwise trigger a spurious
+        cluster-wide re-election (+ metadata rebuild) on every
+        staggered suspicion; in the genuine partition-heal case the
+        merge that just ran has already resurrected the other side's
+        leader, so the guard never masks a real conflict."""
+        mine = self.membership.leader
+        if (
+            self.joined
+            and their_leader
+            and mine
+            and their_leader != mine
+            and self.membership.is_alive(their_leader)
+            and not self.election.in_progress
+        ):
+            log.info(
+                "%s: leader conflict (%s here vs %s there) -> election",
+                self.me, mine, their_leader,
+            )
+            self.election.start()
 
     # ------------------------------------------------------------------
     # join/bootstrap (reference worker.py:551-614, 1137-1148)
@@ -392,6 +451,7 @@ class Node:
         their_leader = msg.data.get("leader")
         if their_leader and self.membership.leader is None and not self.election.in_progress:
             self._set_leader(their_leader)
+        self._check_leader_conflict(their_leader)
         self.send_unique(
             msg.sender,
             MsgType.ACK,
@@ -403,6 +463,7 @@ class Node:
         worker.py:551-570 -> _notify_waiting)."""
         self.membership.merge(msg.data.get("members", {}))
         self.membership.mark_alive(msg.sender)
+        self._check_leader_conflict(msg.data.get("leader"))
         ev = self._ack_waiters.get(msg.sender)
         if ev is not None:
             ev.set()

@@ -19,7 +19,7 @@ from __future__ import annotations
 import asyncio
 import random
 import time
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 from .wire import Message
 
@@ -71,6 +71,11 @@ class UdpTransport(asyncio.DatagramProtocol):
         self.packets_sent = 0
         self.packets_dropped = 0
         self.first_send_time: Optional[float] = None
+        # fault-injection seam: network-partition simulation. When
+        # set, outbound datagrams to addresses the predicate matches
+        # are dropped (set symmetrically on every node for a full
+        # bidirectional partition).
+        self.partition_filter: Optional[Callable[[Tuple[str, int]], bool]] = None
 
     def set_loss_enabled(self, enabled: bool) -> None:
         self._loss.enabled = enabled
@@ -115,6 +120,9 @@ class UdpTransport(asyncio.DatagramProtocol):
         from the periodic re-ping/re-send loops, like the reference)."""
         if self._transport is None:
             raise RuntimeError("transport not bound")
+        if self.partition_filter is not None and self.partition_filter(addr):
+            self.packets_dropped += 1
+            return
         if self._loss.should_drop():
             self.packets_dropped += 1
             return

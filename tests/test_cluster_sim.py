@@ -95,6 +95,34 @@ class Sim:
         any_node = next(iter(self.nodes.values()))
         return self.stores[any_node.leader_unique]
 
+    def by_unique(self, name: str) -> str:
+        return self.spec.node_by_name(name).unique_name
+
+    def partition(self, *groups):
+        """Bidirectional CONTROL-PLANE partition: UDP datagrams
+        between groups are dropped (the introducer DNS stays
+        reachable — it is a rendezvous, not a router). Scope: the TCP
+        data plane is NOT gated — membership/election/metadata all
+        ride UDP, which is what these scenarios exercise; a test that
+        must forbid cross-partition file transfer needs its own data-
+        plane gate."""
+        port_group = {}
+        for gi, names in enumerate(groups):
+            for uname in names:
+                port_group[self.nodes[uname].me.port] = gi
+        for uname, node in self.nodes.items():
+            mine = port_group.get(node.me.port)
+
+            def blocked(addr, mine=mine):
+                other = port_group.get(addr[1])
+                return other is not None and other != mine
+
+            node.transport.partition_filter = blocked
+
+    def heal(self):
+        for node in self.nodes.values():
+            node.transport.partition_filter = None
+
 
 @contextlib.asynccontextmanager
 async def cluster(n, tmp_path, base_port):
@@ -347,6 +375,87 @@ async def test_voluntary_leave_rejoin(tmp_path):
 
         node.rejoin()
         await sim.wait_converged(timeout=15.0)
+
+
+async def test_partition_heal_reconverges_single_leader(tmp_path):
+    """A network partition splits the cluster into two working halves
+    (each elects/keeps a leader — availability); when the network
+    heals, the anti-entropy probe re-establishes contact, the
+    piggybacked leader fields expose the disagreement, and a fresh
+    bully election converges EVERY node on one leader with a rebuilt
+    global file table. (The reference has no partition story at all:
+    a cleaned node could only ever return via a manual re-join.)"""
+    async with cluster(5, tmp_path, 21900) as sim:
+        h1 = sim.spec.node_by_name("H1")
+        await sim.wait_converged(expect_leader=h1.unique_name)
+        src = tmp_path / "p.txt"
+        src.write_bytes(b"survives partitions")
+        client = sim.stores[sim.spec.node_by_name("H5").unique_name]
+        await client.put(str(src), "p.txt")
+
+        minority = [sim.by_unique(n) for n in ("H1", "H2")]
+        majority = [sim.by_unique(n) for n in ("H3", "H4", "H5")]
+        sim.partition(minority, majority)
+
+        # majority side: H1 unreachable -> cleanup -> elects H3 (its
+        # highest rank); minority keeps H1
+        await sim.wait_for(
+            lambda: all(
+                sim.nodes[u].leader_unique == sim.by_unique("H3")
+                for u in majority
+            ),
+            timeout=20.0,
+            what="majority elects its own leader",
+        )
+        assert all(
+            sim.nodes[u].leader_unique == h1.unique_name for u in minority
+        )
+        # both sides remain AVAILABLE: each serves a put
+        maj_file = tmp_path / "maj.txt"
+        maj_file.write_bytes(b"majority side")
+        r = await sim.stores[majority[2]].put(str(maj_file), "maj.txt")
+        assert r["ok"]
+
+        sim.heal()
+        # anti-entropy probes re-establish contact; leader conflict
+        # triggers a re-election; H1 (global rank winner) retakes
+        await sim.wait_converged(expect_leader=h1.unique_name, timeout=30.0)
+        # the rebuilt global table serves BOTH sides' files everywhere
+        for uname, store in sim.stores.items():
+            dst = tmp_path / f"got_{store.node.me.port}.txt"
+            await store.get("p.txt", str(dst))
+            assert dst.read_bytes() == b"survives partitions", uname
+        dst = tmp_path / "got_maj.txt"
+        await sim.stores[minority[0]].get("maj.txt", str(dst))
+        assert dst.read_bytes() == b"majority side"
+
+
+async def test_false_positive_cleanup_self_heals(tmp_path):
+    """A node wrongly cleaned up (e.g. a long GC pause) used to be
+    gone forever unless it manually re-joined; the anti-entropy probe
+    rediscovers it."""
+    async with cluster(4, tmp_path, 22000) as sim:
+        await sim.wait_converged()
+        victim_u = sim.by_unique("H4")
+        victim = sim.nodes[victim_u]
+        # simulate a pause: victim can't talk to anyone, then recovers
+        sim.partition([victim_u],
+                      [sim.by_unique(n) for n in ("H1", "H2", "H3")])
+        await sim.wait_for(
+            lambda: all(
+                victim_u not in {
+                    n.unique_name
+                    for n in sim.nodes[u].membership.alive_nodes()
+                }
+                for u in (sim.by_unique("H1"), sim.by_unique("H2"),
+                          sim.by_unique("H3"))
+            ),
+            timeout=20.0,
+            what="victim cleaned up by ALL the others",
+        )
+        sim.heal()
+        await sim.wait_converged(timeout=30.0)
+        assert victim.joined
 
 
 async def test_join_repairs_under_replication(tmp_path):
