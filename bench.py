@@ -67,10 +67,18 @@ def _bench_models(engine, out):
             "mfu": round(flops / secs / peak, 4) if flops else None,
         }, lm, batch
 
-    # ResNet50 sweep (BASELINE config 4 family); headline at b32
+    # ResNet50 sweep (BASELINE config 4 family); headline at b32.
+    # Chain lengths scale INVERSELY with batch so every point
+    # accumulates >=150 ms of device work between the two chain
+    # lengths — short chains at small batches let tunnel jitter
+    # through (a b32 point once read 22.8k q/s at (10,50) that
+    # re-measures 14.3k at (20,120))
     sweep = []
-    for b in (16, 32, 64, 128, 256):
-        point, lm, batch = measure("ResNet50", b)
+    for b, ch in (
+        (16, (20, 160)), (32, (20, 120)), (64, (15, 90)),
+        (128, (10, 60)), (256, (5, 35)),
+    ):
+        point, lm, batch = measure("ResNet50", b, chains=ch)
         sweep.append(point)
         if b == 32:
             p50, p99 = dispatch_latency(lm.forward, lm.variables, batch)
@@ -85,11 +93,17 @@ def _bench_models(engine, out):
     best = max(sweep, key=lambda p: p["qps"])
     out["resnet50_throughput_optimal_batch"] = best["batch"]
 
-    i8, _, _ = measure("InceptionV3", 8)      # BASELINE config 2
-    i32, _, _ = measure("InceptionV3", 32)
-    out["inceptionv3"] = [i8, i32]
-    e32, _, _ = measure("EfficientNetB4", 32, chains=(5, 25))
-    out["efficientnet_b4"] = [e32]
+    i8, _, _ = measure("InceptionV3", 8, chains=(20, 160))  # config 2
+    i32, _, _ = measure("InceptionV3", 32, chains=(15, 90))
+    # b128 is InceptionV3's throughput point (the ratio to b32 lives
+    # in this run's own `inceptionv3` points; b256 regresses) — the
+    # branchy blocks need a deep batch before XLA's tilings fill the
+    # MXU
+    i128, _, _ = measure("InceptionV3", 128, chains=(8, 40))
+    out["inceptionv3"] = [i8, i32, i128]
+    e32, _, _ = measure("EfficientNetB4", 32, chains=(5, 30))
+    e128, _, _ = measure("EfficientNetB4", 128, chains=(3, 13))
+    out["efficientnet_b4"] = [e32, e128]
 
 
 def _bench_dual_c4(engine, out):
@@ -97,7 +111,17 @@ def _bench_dual_c4(engine, out):
     through the real fair-share scheduler; the engine executes every
     assigned batch on the chip. Wall-clock here includes per-batch
     dispatch (tunnel) — it demonstrates the C4 capability and the
-    scheduler's fair split, not peak chip rate (see the sweep)."""
+    scheduler's fair split, not peak chip rate (see the sweep).
+
+    Two dispatch modes measured (VERDICT r2 item 6): `sync` executes
+    one synchronous round-trip per batch (the reference's shape —
+    worker.py:518-537 overlaps nothing); `pipelined` enqueues every
+    assignment in a scheduling round via `infer_arrays_nowait` and
+    drains in order, so transfers and forwards of later batches
+    overlap earlier readbacks. C1/C2 are reported from the pipelined
+    run (the serving path). Both models are warmed through the EXACT
+    execution path first (same arrays, same shapes), so C2 reports
+    serving latency, not first-call XLA compilation (item 5)."""
     import numpy as np
 
     from dml_tpu.jobs.cost_model import ModelCost
@@ -105,50 +129,90 @@ def _bench_dual_c4(engine, out):
 
     rng = np.random.RandomState(0)
     workers = ["W1", "W2", "W3", "W4"]
-    sched = Scheduler()
+    costs = {}
     for m, bs in (("ResNet50", 32), ("InceptionV3", 8)):
         lm = engine.load_model(m, batch_size=bs, warmup=True)
-        sched.set_cost(m, ModelCost(
+        costs[m] = ModelCost(
             load_time=lm.load_time, first_query=lm.first_query,
             per_query=lm.per_query, download_time=0.0, batch_size=bs,
-        ))
+        )
     files = [f"img_{i}.jpeg" for i in range(64)]
     n_r, n_i = 512, 256
-    sched.submit_job(1, "ResNet50", files, n_r, "bench")
-    sched.submit_job(2, "InceptionV3", files, n_i, "bench")
-
     imgs = {
         "ResNet50": rng.randint(0, 255, (32, 224, 224, 3), dtype=np.uint8),
         "InceptionV3": rng.randint(0, 255, (8, 299, 299, 3), dtype=np.uint8),
     }
-    t0 = time.monotonic()
-    done = 0
-    while sched.jobs:
-        assigns = sched.schedule(workers)
-        if not assigns and not sched.in_progress:
-            break
-        for a in assigns:
-            bt0 = time.monotonic()
-            engine.infer_arrays(a.batch.model, imgs[a.batch.model][: len(a.batch.files)])
-            sched.on_batch_done(
-                a.worker, a.batch.job_id, a.batch.batch_id,
-                time.monotonic() - bt0, len(a.batch.files),
-            )
-            done += 1
-    wall = time.monotonic() - t0
+    # warm the exact serving path (infer_arrays' device_put + forward +
+    # readback at the exact shapes) so no compile lands in a C2 sample
+    for m in imgs:
+        engine.infer_arrays(m, imgs[m])
+
+    def run(pipelined: bool):
+        sched = Scheduler()
+        for m, c in costs.items():
+            sched.set_cost(m, c)
+        sched.submit_job(1, "ResNet50", files, n_r, "bench")
+        sched.submit_job(2, "InceptionV3", files, n_i, "bench")
+        t0 = time.monotonic()
+        done = 0
+        while sched.jobs:
+            assigns = sched.schedule(workers)
+            if not assigns and not sched.in_progress:
+                break
+            round_handles = []
+            for a in assigns:
+                bt0 = time.monotonic()
+                h = engine.infer_arrays_nowait(
+                    a.batch.model, imgs[a.batch.model][: len(a.batch.files)]
+                )
+                if pipelined:
+                    round_handles.append((a, bt0, h))
+                else:
+                    h()
+                    sched.on_batch_done(
+                        a.worker, a.batch.job_id, a.batch.batch_id,
+                        time.monotonic() - bt0, len(a.batch.files),
+                    )
+                    done += 1
+            for a, bt0, h in round_handles:
+                h()
+                sched.on_batch_done(
+                    a.worker, a.batch.job_id, a.batch.batch_id,
+                    time.monotonic() - bt0, len(a.batch.files),
+                )
+                done += 1
+        return time.monotonic() - t0, done, sched
+
+    wall_sync, done_sync, sched_sync = run(pipelined=False)
+    wall_pipe, done_pipe, sched_pipe = run(pipelined=True)
     out["dual_model_c4"] = {
         "resnet50_queries": n_r,
         "inceptionv3_queries": n_i,
-        "batches_executed": done,
-        "wall_s": round(wall, 2),
-        "combined_qps_incl_dispatch": round((n_r + n_i) / wall, 1),
-        "c1": sched.c1_stats(window=wall),
-        "c2_resnet50": sched.c2_stats("ResNet50"),
-        "c2_inceptionv3": sched.c2_stats("InceptionV3"),
+        "batches_executed": done_pipe,
+        "wall_s_sync": round(wall_sync, 2),
+        "wall_s_pipelined": round(wall_pipe, 2),
+        "combined_qps_sync": round((n_r + n_i) / wall_sync, 1),
+        "combined_qps_pipelined": round((n_r + n_i) / wall_pipe, 1),
+        "pipelining_speedup": round(wall_sync / wall_pipe, 2),
+        "c1": sched_pipe.c1_stats(window=wall_pipe),
+        # C2 from the SYNC run: its per-batch sample is dispatch ->
+        # result with nothing else in flight (the r01 measurement
+        # point, comparable across rounds). The pipelined run's
+        # enqueue->drain spans include waiting on earlier batches in
+        # the round — a queueing number, not a processing-time one.
+        "c2_resnet50": sched_sync.c2_stats("ResNet50"),
+        "c2_inceptionv3": sched_sync.c2_stats("InceptionV3"),
+        "note": "through the axon tunnel the serialized link voids "
+                "transfer/compute overlap, so pipelined ~= sync here; "
+                "the pipelining win applies on-host (the r2 17.3 q/s "
+                "-> ~49 q/s gain came from warming the exact serving "
+                "path so C2 no longer eats first-compiles)",
     }
 
 
-def _bench_cluster_serving(engine, out):
+def _bench_cluster_serving(engine, out, *, model="ResNet50",
+                           batch=32, big_batch=128, n_queries=512,
+                           base_port=28801):
     """BASELINE config 4's shape on available hardware: a real
     localhost cluster (UDP control plane + TCP data plane + SDFS
     replication) serving a batch=32 ResNet50 job with THE REAL ENGINE
@@ -172,7 +236,7 @@ def _bench_cluster_serving(engine, out):
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp, exist_ok=True)
         spec = ClusterSpec.localhost(
-            4, base_port=28801, introducer_port=28800,
+            4, base_port=base_port, introducer_port=base_port - 1,
             timing=Timing(ping_interval=0.2, ack_timeout=0.3,
                           cleanup_time=1.0, leader_rpc_timeout=10.0),
             store=StoreConfig(root=os.path.join(tmp, "roots"),
@@ -222,21 +286,111 @@ def _bench_cluster_serving(engine, out):
                         rng.randint(0, 255, (256, 256, 3), np.uint8)
                     ).save(p)
                     await client_store.put(p, f"img_{i}.jpeg")
-            await client_jobs.set_batch_size("ResNet50", 32)
-            n_q = 512
+            await client_jobs.set_batch_size(model, batch)
+            n_q = n_queries
             t0 = time.monotonic()
-            job_id = await client_jobs.submit_job("ResNet50", n_q)
+            job_id = await client_jobs.submit_job(model, n_q)
             done = await client_jobs.wait_job(job_id, timeout=600.0)
             wall = time.monotonic() - t0
             assert done["total_queries"] == n_q
+            leader = next(
+                (n, s, j) for n, s, j in stack if n.is_leader
+            )
             out["cluster_serving"] = {
                 "nodes": 4,
                 "input_source": source,
                 "queries": n_q,
                 "wall_s": round(wall, 2),
                 "qps_end_to_end": round(n_q / wall, 1),
+                # where each batch's wall time went, from ACK-carried
+                # worker timings (VERDICT r2 item 9)
+                "breakdown": leader[2].breakdown_stats(),
                 "note": "full stack: UDP control plane + SDFS-replicated "
-                        "inputs + host JPEG decode + engine on chip",
+                        "inputs + host JPEG decode + engine on chip. "
+                        "breakdown.infer_ms is dominated by the remote "
+                        "chip's tunnel round-trips (device compute is "
+                        "~2.2 ms/batch, see resnet50_sweep) — on-host "
+                        "serving would be decode-bound",
+            }
+
+            # throughput variant: batch 128 amortizes the per-batch
+            # dispatch round-trip 4x (the b32 number is RTT-bound
+            # through the tunnel; the sweep shows the chip itself is
+            # indifferent between b32 and b128)
+            await client_jobs.set_batch_size(model, big_batch)
+            t0 = time.monotonic()
+            job_id = await client_jobs.submit_job(model, n_q)
+            done = await client_jobs.wait_job(job_id, timeout=600.0)
+            wall128 = time.monotonic() - t0
+            assert done["total_queries"] == n_q
+            out["cluster_serving_b128"] = {
+                "queries": n_q,
+                "wall_s": round(wall128, 2),
+                "qps_end_to_end": round(n_q / wall128, 1),
+            }
+
+            # BASELINE config 5: failure injection during LIVE serving
+            # (VERDICT r2 item 4) — kill a busy non-leader, non-standby
+            # worker mid-job ABRUPTLY (transport closed, no goodbye:
+            # the reference's crash case, worker.py:1279-1306) and
+            # record completion, requeues, and detection latency.
+            await client_jobs.set_batch_size(model, batch)
+            leader_jobs = leader[2]
+            standby = leader[1].standby_node()
+            client_node = stack[-1][0]
+            victim = next(
+                (n, s, j) for n, s, j in stack
+                if not n.is_leader and n is not client_node
+                and (standby is None or n.me.unique_name != standby.unique_name)
+            )
+            victim_name = victim[0].me.unique_name
+            requeues_before = leader_jobs.scheduler.requeue_count
+            t0 = time.monotonic()
+            job_id = await client_jobs.submit_job(model, n_q)
+            # kill once the victim is actually running a batch
+            for _ in range(500):
+                if victim_name in leader_jobs.scheduler.in_progress:
+                    break
+                await asyncio.sleep(0.01)
+            t_kill = time.monotonic()
+            await victim[0].stop()
+            await victim[2].stop()
+            await victim[1].stop()
+            # detection latency: kill -> first requeue of its batch.
+            # Bounded at 20 s (cleanup_time is 1 s; detection lands in
+            # ~2 s) and exits early if the job finishes — a kill that
+            # raced completion must be RECORDED as not-injected, not
+            # spun on for a minute and emitted as a vacuous pass
+            detect_s = None
+            while time.monotonic() - t_kill < 20.0:
+                if leader_jobs.scheduler.requeue_count > requeues_before:
+                    detect_s = time.monotonic() - t_kill
+                    break
+                if job_id in leader_jobs.scheduler.done_jobs:
+                    break
+                await asyncio.sleep(0.01)
+            done = await client_jobs.wait_job(job_id, timeout=600.0)
+            wall_f = time.monotonic() - t0
+            assert done["total_queries"] == n_q, "completion under failure"
+            requeues = leader_jobs.scheduler.requeue_count - requeues_before
+            out["cluster_serving_failure"] = {
+                "queries": n_q,
+                "completed": done["total_queries"],
+                # False = the victim's work completed before the kill
+                # could displace anything (a raced run, not evidence)
+                "failure_injected": requeues > 0,
+                "killed_worker": victim_name,
+                "killed_at_s": round(t_kill - t0, 2),
+                "detect_to_requeue_s": (
+                    round(detect_s, 2) if detect_s is not None else None
+                ),
+                "requeues": requeues,
+                "wall_s": round(wall_f, 2),
+                "qps_end_to_end": round(n_q / wall_f, 1),
+                "healthy_wall_s": round(wall, 2),
+                "note": "worker killed abruptly mid-job (no leave msg); "
+                        "100% completion via SWIM detect -> requeue-at-"
+                        "front -> reschedule",
             }
         finally:
             for node, store, jobs in reversed(stack):
@@ -276,10 +430,12 @@ def _bench_pallas(out):
     # parity, compiled on device
     o_fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
     o_nv = jax.jit(naive)(q, k, v)
+    # parity is RECORDED (pass flag + value), not asserted: a marginal
+    # tolerance miss on a different chip/toolchain must degrade the
+    # report, not abort the whole matrix (advisor finding, r2)
     err = float(jnp.max(jnp.abs(
         o_fa.astype(jnp.float32) - o_nv.astype(jnp.float32)
     )))
-    assert err < 0.05, f"flash parity {err}"
 
     def g(fn):
         return jax.jit(jax.grad(
@@ -291,7 +447,6 @@ def _bench_pallas(out):
     gerr = float(jnp.max(jnp.abs(
         g_fa.astype(jnp.float32) - g_nv.astype(jnp.float32)
     ))) / (float(jnp.max(jnp.abs(g_nv))) + 1e-6)
-    assert gerr < 0.08, f"flash bwd parity {gerr}"
 
     def step_fa(i, acc, q, k, v):
         return jnp.max(
@@ -309,7 +464,6 @@ def _bench_pallas(out):
         jax.jit(lambda x: fused_normalize(x, "caffe"))(x).astype(jnp.float32)
         - normalize_on_device(x, "caffe", jnp.bfloat16).astype(jnp.float32)
     )))
-    assert err_n < 1.0, f"normalize parity {err_n}"
 
     # ring-attention body: Pallas-flash blocks vs dense-jnp blocks
     # (1-device sp mesh — the multi-device ring is validated on the
@@ -334,7 +488,6 @@ def _bench_pallas(out):
         ring_fl(qr, kr, vr).astype(jnp.float32)
         - ring_dn(qr, kr, vr).astype(jnp.float32)
     )))
-    assert err_r < 0.05, f"ring flash/dense parity {err_r}"
     # longer chains than the big-kernel timings: the flash ring body
     # is sub-millisecond, and a short chain's slope can drown in
     # tunnel round-trip jitter (a degenerate ~0 slipped through once)
@@ -351,6 +504,10 @@ def _bench_pallas(out):
         "flash_fwd_max_err": round(err, 5),
         "flash_bwd_rel_err": round(gerr, 5),
         "normalize_max_err": round(err_n, 5),
+        "ring_parity_max_err": round(err_r, 5),
+        "parity_pass": bool(
+            err < 0.05 and gerr < 0.08 and err_n < 1.0 and err_r < 0.05
+        ),
         "flash_fwd_ms": round(t_fa * 1e3, 3),
         "naive_attn_fwd_ms": round(t_nv * 1e3, 3),
         "flash_vs_naive_speedup": round(t_nv / t_fa, 3),
@@ -359,6 +516,235 @@ def _bench_pallas(out):
         "ring_flash_speedup": round(t_rd / t_rf, 3),
         "shape": f"B{B} T{T} H{H} D{D} bf16 causal",
     }
+
+
+def _bench_lm(
+    out,
+    *,
+    engine=None,
+    vocab=32000,
+    d_model=1024,
+    n_heads=16,
+    n_layers=12,
+    d_ff=4096,
+    decode_lengths=(32, 160),  # 128-step delta: a sub-ms decode body
+    # must accumulate well past the tunnel's ~100 ms RTT jitter, or a
+    # degenerate ~0 slope slips through (seen once at (16, 64))
+    reps=5,
+):
+    """LM serving matrix — driver-captured versions of every number the
+    inference/ docstrings claim (VERDICT r2 item 1):
+
+    - decode tok/s for f32- / bf16- / int8-resident weights (B=1,
+      short context: the weight-stream-bound regime);
+    - MHA vs GQA-4 vs MQA decode at 4k context (B=1: the KV-cache-
+      bound regime the compact cache exists for);
+    - prefill (one flash-attention forward) vs token-by-token scan at
+      a 2k prompt;
+    - the continuous-batching server's device program
+      (`batched_decode_step`, per-slot positions — exactly what
+      LMServer._chunk_impl scans) at 1 vs 8 active slots.
+
+    All rates are `scan_slope`-timed: each measured program runs the
+    decode body under `lax.scan` with the sampled token chained into
+    the next step (argmax of the previous logits), so the chain is
+    sequential by construction and the two-length slope cancels the
+    tunnel round-trip. Weight trees are built directly as arrays (the
+    param-tree layout `generate` consumes, matching
+    models/transformer.py); throughput is value-independent.
+
+    Reference analog: its published measured model constants
+    (reference test.py:109-131); the LM stack itself is net-new scope.
+    """
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dml_tpu.benchmarks import device_seconds_per_iter, poke, scan_slope
+    from dml_tpu.inference.generate import (
+        LMConfig,
+        batched_decode_step,
+        init_cache,
+        prefill,
+    )
+    from dml_tpu.inference.quantize import quantize_lm_params
+
+    # free the CNN weights first: the LM section allocates ~2 GB of
+    # param trees + caches, and the int8 decode path is sensitive to
+    # HBM headroom (with the CNN models still resident the r3
+    # full-bench run measured int8 at 1056 tok/s vs 3658 standalone)
+    if engine is not None:
+        for name in list(engine.loaded_models):
+            engine.unload_model(name)
+        gc.collect()
+
+    hd = d_model // n_heads
+
+    def make_params(n_kv, seed=0):
+        """f32 param tree in generate()'s layout (models/transformer.py
+        naming), built host-side: bench needs shapes + HBM residency,
+        not trained values."""
+        rng = np.random.RandomState(seed)
+
+        def m(*shape, scale):
+            return jnp.asarray(
+                rng.standard_normal(shape).astype(np.float32) * scale
+            )
+
+        p = {
+            "embed": {"embedding": m(vocab, d_model, scale=0.02)},
+            "ln_out": {"scale": jnp.ones((d_model,), jnp.float32)},
+            "lm_head": {"kernel": m(d_model, vocab, scale=0.02)},
+        }
+        for i in range(n_layers):
+            p[f"block_{i}"] = {
+                "ln_attn": {"scale": jnp.ones((d_model,), jnp.float32)},
+                "ln_mlp": {"scale": jnp.ones((d_model,), jnp.float32)},
+                "qkv": {"kernel": m(
+                    d_model, d_model + 2 * n_kv * hd, scale=d_model**-0.5
+                )},
+                "proj": {"kernel": m(d_model, d_model, scale=d_model**-0.5)},
+                "up": {"kernel": m(d_model, d_ff, scale=d_model**-0.5)},
+                "down": {"kernel": m(d_ff, d_model, scale=d_ff**-0.5)},
+            }
+        return p
+
+    def tree_bytes(p):
+        return sum(l.nbytes for l in jax.tree_util.tree_leaves(p))
+
+    def tree_mb(p):
+        return round(tree_bytes(p) / 2**20, 1)
+
+    def decode_rate(params, cfg, batch, max_len, lengths=decode_lengths):
+        """Seconds per batched decode step at ~max_len context (the
+        scan starts at max_len - lengths[1] - 1 so both chain lengths
+        run over the same cache footprint)."""
+        cache = init_cache(cfg, batch, max_len)
+        tok = jnp.zeros((batch,), jnp.int32)
+        start = max(0, max_len - lengths[1] - 1)
+        pos = jnp.full((batch,), start, jnp.int32)
+
+        def make(n):
+            def run(params, cache, tok, pos):
+                def body(carry, _):
+                    cache, tok, pos = carry
+                    logits, cache = batched_decode_step(
+                        params, cfg, cache, tok, pos
+                    )
+                    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                    return (cache, nxt, pos + 1), None
+
+                (cache, tok, pos), _ = jax.lax.scan(
+                    body, (cache, tok, pos), None, length=n
+                )
+                return jnp.sum(tok)
+
+            return jax.jit(run)
+
+        return scan_slope(make, (params, cache, tok, pos), lengths, reps)
+
+    lm = {"config": {
+        "vocab": vocab, "d_model": d_model, "n_heads": n_heads,
+        "n_layers": n_layers, "d_ff": d_ff,
+    }}
+    out["lm"] = lm
+
+    # -- weight-form sweep: f32 vs bf16 vs int8 (B=1, 512 ctx) --------
+    cfg_gqa_f32 = LMConfig(vocab, d_model, n_heads, n_layers, d_ff,
+                           dtype=jnp.float32, n_kv_heads=4)
+    cfg_gqa = LMConfig(vocab, d_model, n_heads, n_layers, d_ff,
+                       dtype=jnp.bfloat16, n_kv_heads=4)
+    p32 = make_params(4)
+    pbf = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), p32)
+    pq8 = quantize_lm_params(p32)
+    lm["params_millions"] = round(sum(
+        l.size for l in jax.tree_util.tree_leaves(p32)
+    ) / 1e6, 1)
+
+    forms = {}
+    for name, params, cfg in (
+        ("f32", p32, cfg_gqa_f32),
+        ("bf16", pbf, cfg_gqa),
+        ("int8", pq8, cfg_gqa),
+    ):
+        secs = decode_rate(params, cfg, batch=1, max_len=512)
+        forms[name] = {
+            "tok_per_s": round(1.0 / secs, 1),
+            "ms_per_tok": round(secs * 1e3, 3),
+            "weights_mb": tree_mb(params),
+        }
+    forms["bf16_vs_f32_speedup"] = round(
+        forms["bf16"]["tok_per_s"] / forms["f32"]["tok_per_s"], 2)
+    forms["int8_vs_bf16_capacity"] = round(
+        tree_bytes(pbf) / tree_bytes(pq8), 2)
+    lm["decode_weight_forms_b1"] = forms
+
+    # -- KV-head sweep at 4k context (B=1, bf16) ----------------------
+    ctx = 4096
+    heads = {}
+    for name, n_kv, params in (
+        ("mha", n_heads, None),
+        ("gqa4", 4, pbf),
+        ("mqa", 1, None),
+    ):
+        if params is None:
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.bfloat16), make_params(n_kv)
+            )
+        cfg = LMConfig(vocab, d_model, n_heads, n_layers, d_ff,
+                       dtype=jnp.bfloat16, n_kv_heads=n_kv)
+        secs = decode_rate(params, cfg, batch=1, max_len=ctx)
+        cache_mb = round(
+            n_layers * 2 * ctx * n_kv * hd * 2 / 2**20, 1
+        )
+        heads[name] = {
+            "n_kv_heads": n_kv,
+            "tok_per_s": round(1.0 / secs, 1),
+            "cache_mb_per_slot_at_4k": cache_mb,
+        }
+    heads["gqa4_vs_mha_speedup"] = round(
+        heads["gqa4"]["tok_per_s"] / heads["mha"]["tok_per_s"], 2)
+    heads["mqa_vs_mha_speedup"] = round(
+        heads["mqa"]["tok_per_s"] / heads["mha"]["tok_per_s"], 2)
+    lm["decode_kv_heads_4k_ctx_b1"] = heads
+
+    # -- prefill vs token-by-token scan at a 2k prompt ----------------
+    tp = 2048
+    prompt = jnp.zeros((1, tp), jnp.int32)
+
+    def step_prefill(i, acc, params, prompt):
+        logits, _ = prefill(params, cfg_gqa, poke(prompt, acc), tp)
+        return jnp.max(logits)
+
+    t_prefill = device_seconds_per_iter(
+        step_prefill, pbf, prompt, chains=(3, 10), reps=reps
+    )
+    # scan baseline: per-step decode cost at the same cache footprint,
+    # measured mid-prompt (~Tp/2 average context over the scan)
+    t_step = decode_rate(pbf, cfg_gqa, batch=1, max_len=tp // 2)
+    lm["prefill_2k_prompt"] = {
+        "prefill_ms": round(t_prefill * 1e3, 2),
+        "scan_ms_est": round(t_step * tp * 1e3, 2),
+        "speedup": round(t_step * tp / t_prefill, 1),
+        "note": "scan cost = measured per-step decode at ~Tp/2 context "
+                "x Tp steps",
+    }
+
+    # -- continuous-batching slots: 1 vs 8 active (the LMServer device
+    #    program: batched_decode_step with per-slot positions) --------
+    slots = {}
+    for b in (1, 8):
+        secs = decode_rate(pbf, cfg_gqa, batch=b, max_len=1024)
+        slots[f"slots_{b}"] = {
+            "aggregate_tok_per_s": round(b / secs, 1),
+            "ms_per_step": round(secs * 1e3, 3),
+        }
+    slots["batching_gain_8_vs_1"] = round(
+        slots["slots_8"]["aggregate_tok_per_s"]
+        / slots["slots_1"]["aggregate_tok_per_s"], 2)
+    lm["continuous_batching"] = slots
 
 
 def main() -> None:
@@ -379,6 +765,7 @@ def main() -> None:
     _bench_dual_c4(engine, out)
     _bench_cluster_serving(engine, out)
     _bench_pallas(out)
+    _bench_lm(out, engine=engine)
 
     # imagenet parity vs reference goldens (skips with reason in
     # hermetic environments; full label-match report when weights are

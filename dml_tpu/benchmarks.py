@@ -88,6 +88,28 @@ def device_seconds_per_iter(
     return max((t2 - t1) / (c2 - c1), 1e-9)
 
 
+def scan_slope(
+    make: Callable[[int], Callable],
+    args: Tuple,
+    lengths: Tuple[int, int] = (16, 64),
+    reps: int = 5,
+) -> float:
+    """Seconds per iteration of a SEQUENTIAL scanned body.
+
+    `make(n)` returns a jitted callable over `args` that runs the body
+    n times under `lax.scan` with a genuinely loop-carried dependency
+    (e.g. autoregressive decode: each step's token is the argmax of
+    the previous step's logits, so nothing hoists) and returns a value
+    depending on the full chain. The per-iteration time is the slope
+    between the two lengths — same dispatch/readback cancellation as
+    `device_seconds_per_iter`, for bodies whose carry (KV caches) is
+    too structured for the fori_loop `poke` protocol."""
+    n1, n2 = lengths
+    t1 = _median_total(make(n1), args, reps)
+    t2 = _median_total(make(n2), args, reps)
+    return max((t2 - t1) / (n2 - n1), 1e-9)
+
+
 def forward_rate(
     forward: Callable,
     variables: Any,

@@ -244,41 +244,100 @@ class InferenceEngine:
 
     # ---- serving ----
 
+    def _dispatch_chunk(self, lm: _LoadedModel, chunk: np.ndarray):
+        """Pad one <=batch_size slice to the compiled shape and enqueue
+        its forward (async dispatch — nothing blocks here). Returns
+        (device probs, valid count). THE one pad/dispatch site shared
+        by the sync and nowait paths."""
+        bs = lm.batch_size
+        pad = bs - chunk.shape[0]
+        if pad:
+            chunk = np.concatenate(
+                [chunk, np.zeros((pad, *chunk.shape[1:]), np.uint8)]
+            )
+        probs = lm.forward(lm.variables, jax.device_put(chunk, self.device))
+        return probs, bs - pad
+
     def infer_arrays(self, name: str, images_u8: np.ndarray) -> np.ndarray:
         """uint8 (N,H,W,3) -> float32 probs (N,1000). Pads N up to the
-        compiled batch size (static shapes; one XLA program)."""
+        compiled batch size (static shapes; one XLA program).
+
+        JAX's async dispatch pipelines the chunks: forwards are
+        enqueued ahead of the blocking host readbacks (one sync per
+        chunk would serialize transfer and compute). The in-flight
+        window is bounded so device memory stays O(window), not O(n):
+        each pending chunk pins its input (+output) buffers in HBM.
+        """
         lm = self._require(name)
         n = images_u8.shape[0]
         if n == 0:
             return np.zeros((0, lm.num_classes), np.float32)
         bs = lm.batch_size
-        # JAX's async dispatch pipelines the chunks: forwards are
-        # enqueued ahead of the blocking host readbacks (one sync per
-        # chunk would serialize transfer and compute). The in-flight
-        # window is bounded so device memory stays O(window), not O(n):
-        # each pending chunk pins its input (+output) buffers in HBM.
         window = 4
         pending: List[Any] = []
         out: List[np.ndarray] = []
-
-        def drain_one() -> None:
-            probs, valid = pending.pop(0)
-            out.append(np.asarray(probs[:valid]))
-
         for start in range(0, n, bs):
-            chunk = images_u8[start : start + bs]
-            pad = bs - chunk.shape[0]
-            if pad:
-                chunk = np.concatenate(
-                    [chunk, np.zeros((pad, *chunk.shape[1:]), np.uint8)]
-                )
-            probs = lm.forward(lm.variables, jax.device_put(chunk, self.device))
-            pending.append((probs, bs - pad))
+            pending.append(
+                self._dispatch_chunk(lm, images_u8[start : start + bs])
+            )
             if len(pending) >= window:
-                drain_one()
-        while pending:
-            drain_one()
+                probs, valid = pending.pop(0)
+                out.append(np.asarray(probs[:valid]))
+        for probs, valid in pending:
+            out.append(np.asarray(probs[:valid]))
         return np.concatenate(out)[:n]
+
+    def infer_arrays_nowait(self, name: str, images_u8: np.ndarray):
+        """Enqueue the forward(s) for a batch WITHOUT blocking on the
+        result; returns a zero-arg callable that blocks and returns the
+        float32 probs (N, classes).
+
+        This is the dispatch-pipelining primitive: a dispatcher playing
+        several workers on one chip (the dual-model C4 bench, or a
+        multi-queue serving front-end) enqueues every assignment in a
+        scheduling round and then drains them in order, so batch k+1's
+        host->device transfer and forward overlap batch k's readback —
+        instead of one synchronous round-trip per batch. The reference
+        overlaps nothing (worker.py:518-537). Device memory: at most
+        `window` chunks of THIS handle are in flight at once (same
+        O(window) HBM bound as infer_arrays — a large input dispatches
+        its remaining chunks lazily as earlier ones drain inside
+        result()), and each undrained handle pins up to that many
+        input+output buffer pairs, so callers also bound their live
+        handle count (the scheduler's one-batch-per-worker rule does
+        this naturally)."""
+        lm = self._require(name)
+        n = images_u8.shape[0]
+        if n == 0:
+            return lambda: np.zeros((0, lm.num_classes), np.float32)
+        bs = lm.batch_size
+        window = 4
+        starts = list(range(0, n, bs))
+        pending = [
+            self._dispatch_chunk(lm, images_u8[s : s + bs])
+            for s in starts[:window]
+        ]
+        remaining = starts[window:]
+        cached: List[np.ndarray] = []
+
+        def result() -> np.ndarray:
+            if cached:  # handle re-read: same answer, no re-drain
+                return cached[0]
+            out: List[np.ndarray] = []
+            nxt = 0
+            while pending:
+                probs, valid = pending.pop(0)
+                out.append(np.asarray(probs[:valid]))
+                if nxt < len(remaining):
+                    s = remaining[nxt]
+                    pending.append(
+                        self._dispatch_chunk(lm, images_u8[s : s + bs])
+                    )
+                    nxt += 1
+            cached.append(np.concatenate(out)[:n])
+            return cached[0]
+
+        return result
 
     def infer_files(self, name: str, files: Sequence[str], top: int = 5) -> InferenceResult:
         """The reference's perform_inference(model, files) equivalent

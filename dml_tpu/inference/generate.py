@@ -6,8 +6,10 @@ serving half of the framework's LM path. Written TPU-first:
 - The prompt runs through `prefill`: ONE batched forward over
   [B, Tp] with the Pallas flash kernel doing causal attention (bf16
   MXU), filling the KV cache in a single pass — a 2k-token prompt
-  costs one forward, not 2k scanned steps (measured ~5x faster
-  end-to-end generation on v5e).
+  costs one ~6-11 ms forward instead of 2k scanned steps (~1.1 s) —
+  a ~100-170x prompt-processing speedup across v5e captures
+  (re-measured every bench run — `lm.prefill_2k_prompt` in the
+  latest BENCH_r* artifact).
 - New tokens then run under ONE `lax.scan` of `decode_step` inside
   one jit; the chip never returns to the host between tokens.
   Per-step attention is one [B,H,1,T] f32 matvec against the cached

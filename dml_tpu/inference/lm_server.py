@@ -24,9 +24,20 @@ Correctness contract (pinned by tests/test_lm_server.py): greedy
 outputs are IDENTICAL to running `generate` per request in isolation —
 batching is a throughput decision, never a semantics change.
 
-Measured on v5e (12-layer 1024d GQA-4 LM, bf16): 1 slot decodes at
-1177 tok/s, 8 slots at 3799 tok/s aggregate — 3.2x, because the
-weight stream (the per-step HBM bill) is shared by every slot.
+Sampling (temperature > 0) is reproducible PER REQUEST, independent of
+batch composition and arrival order: token i of request `rid` is drawn
+from `fold_in(fold_in(PRNGKey(seed), rid), position)` — its own
+counter-derived stream, not a shared per-step key. Two servers with
+the same seed produce identical sampled outputs for a request whether
+it decodes alone or packed with others (pinned by
+test_sampled_request_independent_of_batch). Note the stream differs
+from `generate`'s split-chain, which is shape-coupled by design.
+
+Measured on v5e (12-layer 1024d GQA-4 LM, bf16, 1k cache;
+re-captured every bench run — `lm.continuous_batching` in the latest
+BENCH_r* artifact): 1 slot decodes at ~1923 tok/s, 8 slots at ~7214
+tok/s aggregate — ~3.8x, because the weight stream (the per-step HBM
+bill) is shared by every slot.
 Caveat for remoted chips: the server makes several dispatches per
 request (prefill, insert, chunks); through a high-latency tunnel the
 round trips dominate and a single fused `generate` call can win —
@@ -111,11 +122,15 @@ class LMServer:
         self.cache = init_cache(cfg, max_slots, max_len)
         self.pos = np.zeros(max_slots, np.int32)  # next write position
         self.cur = np.zeros(max_slots, np.int32)  # next input token
+        self.rid_vec = np.zeros(max_slots, np.int32)  # slot -> request id
         self._slot_req: List[Optional[_Request]] = [None] * max_slots
         self._queue: List[_Request] = []
         self._done: Dict[int, _Request] = {}
         self._rid = 0
-        self._rng = jax.random.PRNGKey(seed)
+        # one master key; every sample folds in (rid, position), so a
+        # request's stream is a pure function of (seed, rid, position)
+        # — no mutable chain to couple slots together
+        self._base_rng = jax.random.PRNGKey(seed)
         # params are explicit ARGUMENTS to every jitted piece — closing
         # over them would bake the whole weight tree into the program
         # as constants (rejected outright by remote compile services
@@ -133,7 +148,14 @@ class LMServer:
         """Copy a prefilled request's cache rows into `slot`. Only the
         first `n_valid` positions carry real data, but copying the
         whole row is one contiguous DMA and stale tail positions are
-        invisible behind the per-slot validity mask."""
+        invisible behind the per-slot validity mask.
+
+        INVARIANT (with `_chunk_impl`): an empty slot's pos is clamped
+        to max_len - 1 on the device, so between retire and reuse its
+        scan steps only ever rewrite the LAST cache row — and this
+        full-row overwrite then erases that too. Any future partial-row
+        insert or unclamped scatter would break the pairing; keep both
+        sides together."""
         del n_valid
         out = {}
         for name, kv in cache.items():
@@ -145,22 +167,45 @@ class LMServer:
             }
         return out
 
-    def _chunk_impl(self, params, cache, cur, pos, rng):
-        """`chunk` batched decode steps in one dispatch."""
+    def _sample_slots(self, logits, rid, write_pos):
+        """Per-slot sampling: the token that will occupy position
+        write_pos[b] of request rid[b] draws from
+        fold_in(fold_in(base, rid), write_pos) — its own
+        counter-derived stream, so a request's sampled output does not
+        depend on what else is in the batch (advisor finding, r2)."""
+        if self.temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        keys = jax.vmap(
+            lambda r, p: jax.random.fold_in(
+                jax.random.fold_in(self._base_rng, r), p
+            )
+        )(rid, write_pos)
+        return jax.vmap(
+            lambda k, lg: _sample(
+                lg[None], k, self.temperature, self.top_k
+            )[0]
+        )(keys, logits)
+
+    def _chunk_impl(self, params, cache, cur, pos, rid):
+        """`chunk` batched decode steps in one dispatch. Per-slot pos
+        is clamped to the last cache row on the device, making the
+        empty-slot write target explicit — see _insert_impl's
+        invariant note."""
+        last = self.max_len - 1
 
         def body(carry, _):
-            cache, cur, pos, rng = carry
+            cache, cur, pos = carry
+            pos_c = jnp.minimum(pos, last)
             logits, cache = batched_decode_step(
-                params, self.cfg, cache, cur, pos
+                params, self.cfg, cache, cur, pos_c
             )
-            rng, sub = jax.random.split(rng)
-            nxt = _sample(logits, sub, self.temperature, self.top_k)
-            return (cache, nxt, pos + 1, rng), nxt
+            nxt = self._sample_slots(logits, rid, pos_c + 1)
+            return (cache, nxt, pos + 1), nxt
 
-        (cache, cur, pos, rng), toks = jax.lax.scan(
-            body, (cache, cur, pos, rng), None, length=self.chunk
+        (cache, cur, pos), toks = jax.lax.scan(
+            body, (cache, cur, pos), None, length=self.chunk
         )
-        return cache, cur, pos, rng, toks  # toks: [chunk, slots]
+        return cache, cur, pos, toks  # toks: [chunk, slots]
 
     # -- public API ----------------------------------------------------
 
@@ -210,7 +255,11 @@ class LMServer:
                 self.cache, pcache, jnp.int32(slot), jnp.int32(tp)
             )
             first_logits = np.asarray(logits[0])
-            self._rng, sub = jax.random.split(self._rng)
+            # the first generated token occupies position tp — same
+            # (rid, position) stream the chunk sampler continues
+            sub = jax.random.fold_in(
+                jax.random.fold_in(self._base_rng, req.rid), tp
+            )
             first = int(np.asarray(
                 _sample(jnp.asarray(first_logits[None]), sub,
                         self.temperature, self.top_k)
@@ -220,6 +269,7 @@ class LMServer:
             self._slot_req[slot] = req
             self.pos[slot] = tp
             self.cur[slot] = first
+            self.rid_vec[slot] = req.rid
             if req.done:  # max_new_tokens == 1
                 self._retire(slot)
 
@@ -229,6 +279,7 @@ class LMServer:
         self._done[req.rid] = req
         req.slot = None
         self._slot_req[slot] = None
+        self.rid_vec[slot] = 0
 
     def step(self) -> None:
         """One chunked dispatch: every active slot advances up to
@@ -238,9 +289,9 @@ class LMServer:
             self._place_waiting()
             if not any(r is not None for r in self._slot_req):
                 return
-        self.cache, cur, pos, self._rng, toks = self._chunk_fn(
+        self.cache, cur, pos, toks = self._chunk_fn(
             self.params, self.cache, jnp.asarray(self.cur),
-            jnp.asarray(self.pos), self._rng,
+            jnp.asarray(self.pos), jnp.asarray(self.rid_vec),
         )
         toks = np.asarray(toks)  # [chunk, slots]
         cur, pos = np.asarray(cur), np.asarray(pos)

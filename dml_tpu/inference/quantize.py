@@ -7,20 +7,26 @@ int8 with a per-output-channel float scale cuts the weight bytes
 1.57x vs bf16 (2.9x vs f32) with no activation-calibration step;
 accuracy loss is bounded by per-channel rounding (~0.4%).
 
-What this buys, measured on v5e (184M-param LM, B=1, 256 tokens):
+What this buys, measured on v5e (198M-param GQA-4 LM, B=1, 512-token
+cache; re-captured every bench run — `lm.decode_weight_forms_b1` in
+the latest BENCH_r* artifact, first landed in BENCH_r03_preview.json):
 
-- f32-resident weights:   858 tok/s
-- bf16-resident weights: 1169 tok/s  <- the HBM roofline (0.86 ms/tok
-                                        = 369 MB of weights / 423 GB/s)
-- int8 + dequant-at-use:  ~1000 tok/s
+- f32-resident weights:  ~1082 tok/s (0.93 ms/tok)
+- bf16-resident weights: ~2062 tok/s (0.49 ms/tok)
+- int8 + dequant-at-use: ~4172 tok/s (0.24 ms/tok)
 
-i.e. on this chip int8 is a CAPACITY feature, not a throughput one:
-XLA materializes the dequantized buffer per step instead of fusing the
-int8 read into the matvec, so bf16-resident weights are faster — but
-the int8 tree occupies 1.57x less HBM, fitting a proportionally larger
-model (or more resident models) per chip. `LongContextLM.generate`
-therefore serves bf16-cast weights by default and offers
-`quantize_weights=True` for the memory-constrained case.
+i.e. int8 is BOTH a throughput and a capacity feature on the current
+toolchain: XLA fuses the int8 read + dequant into the matvec, so the
+per-token HBM bill drops with the weight bytes (~2x vs bf16 on the
+matmul-kernel stream). The capacity side is bounded by what stays
+float: 1.33x less HBM than the bf16 tree end-to-end (the f32 embed
+dominates the remainder). TWO caveats this bench exists to keep
+honest: (a) an earlier toolchain materialized the dequantized buffer
+per scan step and int8 LOST to bf16; (b) the fused-read speed needs
+HBM headroom — with ~1 GB of CNN weights co-resident the same program
+measured ~1056 tok/s (r3), so the bench frees the chip first.
+`LongContextLM.generate` serves bf16-cast weights by default and
+offers `quantize_weights=True`.
 
 Scope: the 2-D matmul kernels of TransformerLM blocks (qkv, proj,
 up, down, lm_head) and the stacked MoE expert tensors (w_up, w_down,

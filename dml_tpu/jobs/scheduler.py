@@ -118,6 +118,9 @@ class Scheduler:
         self.max_batch_failures = 5
         self._newly_failed: List[JobState] = []
         self._job_counter = 0
+        # requeues observed (worker death + live-worker batch failure)
+        # — the recovery evidence the failure-injection bench records
+        self.requeue_count = 0
         # metrics (reference worker.py:485-495, 1000-1001); bounded
         # deques so a long-lived coordinator doesn't grow forever
         self.max_samples = 10_000
@@ -406,6 +409,7 @@ class Scheduler:
             )
             return None
         self._queue(cur.model).appendleft(cur)
+        self.requeue_count += 1
         return cur
 
     def fail_job(self, job_id: int, error: str) -> Optional[JobState]:
@@ -437,6 +441,7 @@ class Scheduler:
         batch = self.in_progress.pop(worker, None)
         if batch is not None:
             self._queue(batch.model).appendleft(batch)
+            self.requeue_count += 1
         return batch
 
     def drop_worker(self, worker: str) -> None:

@@ -85,6 +85,9 @@ class JobService:
         # incarnation (keyed per sender as (inc, last_seq))
         self._incarnation = int(time.time() * 1000)
         self._assigned_at: Dict[str, Tuple[Tuple[int, int], float]] = {}
+        # coordinator-side per-batch wall-time breakdown from ACKs
+        # (fetch / backend / infer) — where cluster-serving time goes
+        self.batch_timing: Deque[Dict[str, float]] = deque(maxlen=512)
         self._last_seq: Dict[str, Tuple[int, int]] = {}  # sender -> (inc, seq)
         self.task_resend_after = max(
             1.0, 4 * node.spec.timing.ping_interval
@@ -356,6 +359,29 @@ class JobService:
     def c5_assignments(self) -> Dict[str, Any]:
         return self.scheduler.c5_assignments()
 
+    def breakdown_stats(self) -> Dict[str, float]:
+        """Mean per-batch wall-time split from ACK-carried timings
+        (coordinator-side; VERDICT r2 item 9): `fetch_ms` replica
+        fetch, `decode_ms` host JPEG decode (backend − infer),
+        `infer_ms` the engine's infer call — device forward PLUS
+        dispatch, which on a remoted chip is dominated by the tunnel
+        round-trips (device compute for a b32 ResNet batch is ~2.2 ms;
+        see the bench sweep) — and `other_ms` output PUT + ACK path
+        (exec − fetch − backend). Empty dict when no samples."""
+        if not self.batch_timing:
+            return {}
+        n = len(self.batch_timing)
+        mean = lambda k: sum(s[k] for s in self.batch_timing) / n  # noqa: E731
+        f, b, i, e = mean("fetch"), mean("backend"), mean("infer"), mean("exec")
+        return {
+            "batches": n,
+            "fetch_ms": round(f * 1e3, 1),
+            "decode_ms": round((b - i) * 1e3, 1),
+            "infer_ms": round(i * 1e3, 1),
+            "other_ms": round((e - f - b) * 1e3, 1),
+            "exec_ms": round(e * 1e3, 1),
+        }
+
     # ------------------------------------------------------------------
     # handler registration
     # ------------------------------------------------------------------
@@ -526,6 +552,15 @@ class JobService:
             msg.sender, job_id, batch_id,
             float(d.get("exec_time", 0.0)), int(d.get("n_images", 0)),
         )
+        if "fetch_time" in d:
+            self.batch_timing.append({
+                "model": d.get("model", ""),
+                "exec": float(d.get("exec_time", 0.0)),
+                "fetch": float(d.get("fetch_time", 0.0)),
+                "backend": float(d.get("backend_time", 0.0)),
+                "infer": float(d.get("infer_time", 0.0)),
+                "n": int(d.get("n_images", 0)),
+            })
         sb = self.store.standby_node()
         if sb is not None and sb.unique_name != self._me:
             self.node.send(
@@ -931,8 +966,11 @@ class JobService:
         try:
             with span("worker.fetch_inputs"):
                 paths = await self._fetch_inputs(batch)
+            t_fetch = time.monotonic() - t0
+            t1 = time.monotonic()
             with span("worker.inference"):
                 results, infer_time, cost = await self._backend(batch.model, paths)
+            t_backend = time.monotonic() - t1
             # backends key results by the LOCAL path (the engine uses
             # the full path, others may use the basename), which
             # differs by how the input materialized (store-replica hit
@@ -966,6 +1004,12 @@ class JobService:
                     "n_images": len(batch.files),
                     "exec_time": time.monotonic() - t0,
                     "infer_time": infer_time,
+                    # where the batch's wall time went (VERDICT r2
+                    # item 9): replica fetch vs backend (backend −
+                    # infer ≈ host JPEG decode); the coordinator
+                    # aggregates these into breakdown_stats()
+                    "fetch_time": t_fetch,
+                    "backend_time": t_backend,
                     "cost": cost,
                 },
             )

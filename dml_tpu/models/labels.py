@@ -22,9 +22,34 @@ _SEARCH_PATHS = (
 )
 
 
+_OVERRIDE_PATH: str | None = None
+
+
+def set_class_index_path(path: str | None) -> None:
+    """Pin the process-wide label table to a specific file — used by
+    tools that locate the class index outside the default search set
+    (e.g. a TF-downloaded copy) so the engine's decode_predictions
+    reads the same table. None restores the default search."""
+    global _OVERRIDE_PATH
+    _OVERRIDE_PATH = path
+    class_index.cache_clear()
+
+
 @functools.lru_cache(maxsize=1)
 def class_index(path: str | None = None) -> Dict[int, Tuple[str, str]]:
-    candidates = [path] if path else [os.path.expanduser(p) for p in _SEARCH_PATHS]
+    if path:
+        candidates = [path]
+    elif _OVERRIDE_PATH:
+        candidates = [_OVERRIDE_PATH]
+    else:
+        candidates = [os.path.expanduser(p) for p in _SEARCH_PATHS]
+        env_dir = os.environ.get("DML_TPU_KERAS_WEIGHTS_DIR")
+        if env_dir:
+            # next to the dropped-in weight files (the TF-free parity
+            # flow: one directory holds the .h5s and the class index)
+            candidates.insert(
+                0, os.path.join(env_dir, "imagenet_class_index.json")
+            )
     for p in candidates:
         if p and os.path.exists(p):
             with open(p) as f:

@@ -91,6 +91,28 @@ def _register_builtin() -> None:
             aliases=("resnet", "resnet-50"),
         )
     )
+
+    def _build_resnet_deep(depth, num_classes=1000, dtype=jnp.bfloat16):
+        from . import resnet
+
+        return getattr(resnet, f"ResNet{depth}")(
+            num_classes=num_classes, dtype=dtype
+        )
+
+    for depth, per_q in ((101, 0.48), (152, 0.70)):
+        register(
+            ModelSpec(
+                name=f"ResNet{depth}",
+                builder=partial(_build_resnet_deep, depth),
+                input_size=(224, 224),
+                preprocess="caffe",
+                # priors scaled from the ResNet50 CPU numbers by FLOPs
+                cost=CostDefaults(
+                    load_time=4.0, first_query=1.2, per_query=per_q
+                ),
+                aliases=(f"resnet-{depth}",),
+            )
+        )
     # input sizes inlined (efficientnet.VARIANTS) so registering stays
     # lazy — the flax-heavy module loads on first build, not on import
     for variant, size in (("b0", 224), ("b4", 380)):

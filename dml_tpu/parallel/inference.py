@@ -19,7 +19,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.params_io import init_variables
-from ..models.preprocess import normalize_on_device
+from ..ops.preprocess import normalize_sharded
 from ..models.registry import get_model
 from .sharding import partition_params
 
@@ -56,7 +56,9 @@ class ShardedInference:
         out_sharding = NamedSharding(mesh, P("dp"))
 
         def fwd(vs, batch_u8):
-            x = normalize_on_device(batch_u8, self.spec.preprocess, dtype)
+            x = normalize_sharded(
+                batch_u8, self.spec.preprocess, dtype, mesh
+            )
             return model.apply(vs, x, train=False)
 
         self._forward = jax.jit(

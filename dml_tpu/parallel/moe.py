@@ -114,6 +114,14 @@ class MoEMLP(nn.Module):
         while per_shard % g:
             g -= 1
         gt = t // g  # groups per sequence (multiple of sp by choice of g)
+        # NOTE (r2 advisor): routing groups are PER-SEQUENCE (per
+        # shard) since the [B, T/g, g] resharding fix — capacity
+        # competition and token-drop patterns differ from the round-1
+        # flattened-b*t grouping, and with short per-shard sequences
+        # ceil(g/E*cf) quantizes coarsely. Intentional (it is what
+        # keeps dispatch local to the (dp, sp) shard — no [SPMD]
+        # rematerialization); don't compare loss curves against
+        # round-1 checkpoints without accounting for it.
         capacity = max(1, math.ceil(g / e * self.capacity_factor))
         tokens = x.reshape(b, gt, g, d)
 

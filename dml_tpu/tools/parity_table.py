@@ -1,0 +1,319 @@
+"""Generate PARITY.md's performance table from a BENCH_r*.json.
+
+VERDICT r2 item 2: round 2's hand-maintained perf table claimed a
+cluster-serving number (196 q/s) that the driver's own capture
+contradicted (110.6 q/s). Hand-edited tables drift; this tool makes
+the table a pure function of the bench artifact:
+
+- every cell is computed from named keys of ONE bench json (the file
+  and its short sha1 are recorded on the marker line);
+- `--write` splices the table into PARITY.md between
+  `<!-- BENCH-TABLE:BEGIN ... -->` / `<!-- BENCH-TABLE:END -->`;
+- tests/test_parity_table.py regenerates from the recorded source and
+  fails if the committed table was edited by hand or went stale.
+
+Run: ``python -m dml_tpu.tools.parity_table [--bench FILE] [--write]``
+(default --bench: the highest-numbered BENCH_r*.json in the repo
+root, preview files included).
+
+Reference baseline numbers quoted in the left column come from the
+reference's own measurements (reference test.py:109-131; SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import hashlib
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+PARITY_PATH = os.path.join(REPO_ROOT, "PARITY.md")
+BEGIN_RE = re.compile(
+    r"<!-- BENCH-TABLE:BEGIN source=(?P<src>\S+) sha1=(?P<sha>[0-9a-f]+) -->"
+)
+END_MARK = "<!-- BENCH-TABLE:END -->"
+
+
+def latest_bench_path() -> Optional[str]:
+    """Highest-round BENCH_r*.json in the repo root. Previews count,
+    but on a same-round tie the driver's capture wins (the preview is
+    the builder's stale stand-in once BENCH_rNN.json exists); ties
+    otherwise break by name for determinism."""
+    best = None
+    best_key = (-1, -1, "")
+    for p in sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json"))):
+        name = os.path.basename(p)
+        m = re.search(r"BENCH_r(\d+)", name)
+        if not m:
+            continue
+        key = (int(m.group(1)), 0 if "preview" in name else 1, name)
+        if key > best_key:
+            best, best_key = p, key
+    return best
+
+
+def _short_sha1(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha1(f.read()).hexdigest()[:12]
+
+
+def _num(x, nd=0):
+    """Format a number; anything non-numeric renders as n/a (schema
+    drift must degrade the cell, not crash the generator)."""
+    if not isinstance(x, (int, float)):
+        return "n/a"
+    return f"{x:,.{nd}f}"
+
+
+def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
+    """The markdown block, markers included. Missing sections render
+    as 'n/a (pending next bench run)' so a schema change degrades the
+    table instead of faking numbers."""
+    m = bench.get("matrix", bench)
+    rows: List[List[str]] = []
+
+    def row(metric: str, ref: str, ours: str) -> None:
+        rows.append([metric, ref, ours])
+
+    hl = m.get("headline_resnet50_b32") or {}
+    qps = hl.get("qps")
+    if isinstance(qps, (int, float)) and qps > 0:
+        mfu = hl.get("mfu")
+        mfu_txt = (
+            f", {mfu*100:.0f}% MFU" if isinstance(mfu, (int, float)) else ""
+        )
+        row(
+            "ResNet50 steady inference",
+            "250 ms/image (4 q/s/node)",
+            f"≈{1000.0/qps:.3f} ms/image at batch 32 "
+            f"(≈{_num(qps)} q/s/chip{mfu_txt})",
+        )
+    sweep = m.get("resnet50_sweep") or []
+    if sweep:
+        sweep_qps = [p["qps"] for p in sweep if "qps" in p]
+        row(
+            f"ResNet50 batch sweep {sweep[0]['batch']}..{sweep[-1]['batch']}",
+            "—",
+            f"{min(sweep_qps)/1000:.1f}k–{max(sweep_qps)/1000:.1f}k q/s; "
+            f"batch {m.get('resnet50_throughput_optimal_batch', '?')} is "
+            "throughput-optimal",
+        )
+    def _model_pts(points):
+        out = []
+        for p in points:
+            if not isinstance(p.get("qps"), (int, float)):
+                continue
+            mfu = p.get("mfu")
+            mfu_txt = (
+                f" ({mfu*100:.0f}% MFU)"
+                if isinstance(mfu, (int, float)) else ""
+            )
+            out.append(f"b{p.get('batch', '?')} ≈{p['qps']/1000:.1f}k q/s{mfu_txt}")
+        return ", ".join(out)
+
+    inc = m.get("inceptionv3") or []
+    if inc:
+        row("InceptionV3 steady inference",
+            "325 ms/image (3.1 q/s/node)", _model_pts(inc) + " per chip")
+    b4 = m.get("efficientnet_b4") or []
+    if b4:
+        row("EfficientNet-B4 (plug-in model)", "—",
+            _model_pts(b4) + " per chip")
+    c4 = m.get("dual_model_c4") or {}
+    if c4:
+        if "combined_qps_pipelined" in c4:
+            ours = (
+                f"{c4['combined_qps_sync']} q/s sync → "
+                f"{c4['combined_qps_pipelined']} q/s with pipelined "
+                f"dispatch ({c4.get('pipelining_speedup', 'n/a')}×) "
+                "through the real fair-share scheduler (tunnel "
+                "dispatch included)"
+            )
+        else:  # r2 schema
+            ours = (
+                f"{c4.get('combined_qps_incl_dispatch', 'n/a')} q/s "
+                "incl. per-batch tunnel dispatch (capability, not peak "
+                "— see sweep)"
+            )
+        row("Dual-model C4 fair-share", "manual 10-VM runs", ours)
+    cs = m.get("cluster_serving") or {}
+    if cs:
+        extra = ""
+        if "breakdown" in cs:
+            b = cs["breakdown"]
+            extra = " (" + ", ".join(
+                f"{k} {v}" for k, v in b.items()
+            ) + ")"
+        fi = m.get("cluster_serving_failure") or {}
+        fi_txt = ""
+        if fi:
+            fi_txt = (
+                f"; worker killed mid-job: "
+                f"{fi.get('completed', 'n/a')}/{fi.get('queries', 'n/a')} "
+                f"completed, detect→requeue "
+                f"{fi.get('detect_to_requeue_s', 'n/a')} s, wall "
+                f"{fi.get('wall_s', 'n/a')} s"
+            )
+        row(
+            f"Cluster serving end-to-end ({cs.get('nodes', '?')} nodes, "
+            "SDFS-replicated JPEGs, batch 32)",
+            "≈0.8 q/s/node (25-image task in ~31 s)",
+            f"≈{cs.get('qps_end_to_end', 'n/a')} q/s through the full "
+            f"stack{extra}{fi_txt}",
+        )
+    pl = m.get("pallas_on_device") or {}
+    if pl:
+        row(
+            f"Flash-attention kernel ({pl.get('shape', '?')})",
+            "—",
+            f"{pl.get('flash_fwd_ms', 'n/a')} ms fwd, "
+            f"{pl.get('flash_vs_naive_speedup', 'n/a')}× naive XLA; "
+            f"ring body {pl.get('ring_flash_speedup', 'n/a')}× its "
+            "dense form"
+            + ("" if pl.get("parity_pass", True) else
+               " — PARITY CHECK FAILED, see bench json"),
+        )
+    lm = m.get("lm") or {}
+    if lm:
+        forms = lm.get("decode_weight_forms_b1") or {}
+        if forms:
+            row(
+                "LM decode by weight form "
+                f"({lm.get('params_millions', '?')}M params, B=1)",
+                "—",
+                ", ".join(
+                    f"{k} {_num(forms[k].get('tok_per_s'))} tok/s"
+                    for k in ("f32", "bf16", "int8")
+                    if isinstance(forms.get(k), dict)
+                ),
+            )
+        heads = lm.get("decode_kv_heads_4k_ctx_b1") or {}
+        if heads:
+            row(
+                "LM decode at 4k context by KV heads (B=1, bf16)",
+                "—",
+                ", ".join(
+                    f"{k.upper()} {_num(heads[k].get('tok_per_s'))} tok/s"
+                    for k in ("mha", "gqa4", "mqa")
+                    if isinstance(heads.get(k), dict)
+                )
+                + f"; GQA-4 = {heads.get('gqa4_vs_mha_speedup', 'n/a')}× MHA",
+            )
+        pf = lm.get("prefill_2k_prompt") or {}
+        if pf:
+            row(
+                "LM prefill vs token-by-token scan (2k prompt)",
+                "—",
+                f"{pf.get('prefill_ms', 'n/a')} ms vs "
+                f"{pf.get('scan_ms_est', 'n/a')} ms "
+                f"({pf.get('speedup', 'n/a')}×)",
+            )
+        cb = lm.get("continuous_batching") or {}
+        if cb:
+            s1 = (cb.get("slots_1") or {}).get("aggregate_tok_per_s")
+            s8 = (cb.get("slots_8") or {}).get("aggregate_tok_per_s")
+            row(
+                "Continuous-batching decode (device program)",
+                "—",
+                f"1 slot {_num(s1)} → 8 slots {_num(s8)} tok/s aggregate "
+                f"({cb.get('batching_gain_8_vs_1', 'n/a')}×)",
+            )
+    if isinstance(qps, (int, float)) and qps > 0:
+        row("`vs_baseline` (bench.py headline)", "1×",
+            f"≈{_num(qps / 4.0)}×")
+
+    lines = [
+        f"<!-- BENCH-TABLE:BEGIN source={source} sha1={sha1} -->",
+        "",
+        f"*Generated by `python -m dml_tpu.tools.parity_table` from "
+        f"`{source}` (sha1 {sha1}) — do not edit by hand; "
+        "tests/test_parity_table.py enforces this.*",
+        "",
+        "| Metric | Reference (CPU, CS425 VMs) | dml_tpu (1× TPU v5e) |",
+        "|---|---|---|",
+    ]
+    for r in rows:
+        lines.append("| " + " | ".join(r) + " |")
+    if not rows:
+        lines.append(
+            "| (source file is a truncated driver wrapper — "
+            "regenerate from a raw bench.py output) | — | — |"
+        )
+    lines += ["", END_MARK]
+    return "\n".join(lines)
+
+
+def load_bench(bench_path: str) -> Dict[str, Any]:
+    """A bench artifact: either the raw ONE-json-line bench.py output
+    (preview files this tool writes tables from) or the driver's
+    wrapper ({"cmd", "rc", "tail", ...}) whose `tail` holds the stdout
+    — possibly truncated, in which case the error says so rather than
+    rendering a silently empty table."""
+    with open(bench_path) as f:
+        data = json.load(f)
+    if "tail" in data and "metric" not in data:
+        try:
+            return json.loads(data["tail"][data["tail"].index("{"):])
+        except Exception:
+            # the driver truncates long stdout; degrade to a
+            # deterministic empty matrix (the table renders a note)
+            # rather than aborting, so the PARITY test can still
+            # enforce committed-table == regeneration
+            return {"_unparseable_wrapper": True}
+    return data
+
+
+def generate(bench_path: str) -> str:
+    return render_table(
+        load_bench(bench_path),
+        os.path.basename(bench_path),
+        _short_sha1(bench_path),
+    )
+
+
+def splice(parity_text: str, table: str) -> str:
+    begin = BEGIN_RE.search(parity_text)
+    end = parity_text.find(END_MARK)
+    if not begin or end < 0:
+        raise ValueError(
+            "PARITY.md has no BENCH-TABLE markers; add "
+            "'<!-- BENCH-TABLE:BEGIN source=x sha1=0 -->' and "
+            f"'{END_MARK}' around the perf table once"
+        )
+    return (
+        parity_text[: begin.start()]
+        + table
+        + parity_text[end + len(END_MARK):]
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--bench", default=None, help="bench json path")
+    ap.add_argument(
+        "--write", action="store_true",
+        help="splice the table into PARITY.md (default: print)",
+    )
+    args = ap.parse_args()
+    bench_path = args.bench or latest_bench_path()
+    if bench_path is None:
+        raise SystemExit("no BENCH_r*.json found")
+    table = generate(bench_path)
+    if args.write:
+        with open(PARITY_PATH) as f:
+            text = f.read()
+        with open(PARITY_PATH, "w") as f:
+            f.write(splice(text, table))
+        print(f"PARITY.md table regenerated from {bench_path}")
+    else:
+        print(table)
+
+
+if __name__ == "__main__":
+    main()

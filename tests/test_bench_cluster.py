@@ -1,0 +1,73 @@
+"""The bench's cluster-serving section logic, driven on CPU with the
+tiny test model: healthy run with per-batch breakdown, the big-batch
+variant, and BASELINE config 5's failure injection (a worker killed
+abruptly mid-job must still yield 100% completion, with the requeue
+and detection latency recorded). The real-chip numbers come from the
+driver's bench run; this pins the MACHINERY so the TPU run can't hit
+a code path for the first time."""
+
+import numpy as np
+
+from _tinynet import ensure_tinynet
+
+
+def test_cluster_serving_bench_with_failure_injection():
+    ensure_tinynet()
+    from bench import _bench_cluster_serving
+    from dml_tpu.inference import InferenceEngine
+    import jax.numpy as jnp
+
+    engine = InferenceEngine(dtype=jnp.float32)
+    engine.load_model("TinyNet", batch_size=4)
+    out = {}
+    _bench_cluster_serving(
+        engine, out, model="TinyNet", batch=4, big_batch=8,
+        n_queries=24, base_port=28901,
+    )
+
+    cs = out["cluster_serving"]
+    assert cs["queries"] == 24
+    assert cs["qps_end_to_end"] > 0
+    bd = cs["breakdown"]
+    assert bd["batches"] > 0
+    # the split must account for the exec time it decomposes
+    assert bd["fetch_ms"] >= 0 and bd["infer_ms"] > 0
+    assert bd["exec_ms"] >= bd["fetch_ms"] + bd["infer_ms"]
+
+    assert out["cluster_serving_b128"]["queries"] == 24
+
+    fi = out["cluster_serving_failure"]
+    assert fi["completed"] == 24  # 100% completion under failure
+    assert fi["requeues"] >= 1  # the victim's batch was requeued
+    assert fi["detect_to_requeue_s"] is not None
+    assert fi["killed_worker"]  # a real victim was chosen
+    assert fi["qps_end_to_end"] > 0
+
+
+def test_nowait_window_bound():
+    """infer_arrays_nowait must not enqueue more than its window of
+    chunks eagerly (r3 review: a 10k-image call would otherwise pin
+    O(n) buffers in HBM before the handle is drained)."""
+    ensure_tinynet()
+    from dml_tpu.inference import InferenceEngine
+    import jax.numpy as jnp
+
+    engine = InferenceEngine(dtype=jnp.float32)
+    lm = engine.load_model("TinyNet", batch_size=2, warmup=False)
+    calls = []
+    orig = engine._dispatch_chunk
+
+    def counting(lm, chunk):
+        calls.append(chunk.shape[0])
+        return orig(lm, chunk)
+
+    engine._dispatch_chunk = counting
+    imgs = np.zeros((20, 32, 32, 3), np.uint8)  # 10 chunks of 2
+    h = engine.infer_arrays_nowait("TinyNet", imgs)
+    assert len(calls) == 4  # the window, not all 10
+    probs = h()
+    assert len(calls) == 10  # the rest dispatched during drain
+    assert probs.shape == (20, 1000)
+    np.testing.assert_allclose(
+        probs, engine.infer_arrays("TinyNet", imgs), rtol=1e-6
+    )

@@ -107,3 +107,23 @@ def test_reload_with_new_batch_size_keeps_explicit_weights(engine):
     b = jax.tree_util.tree_leaves(explicit)
     for x, y in zip(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_infer_arrays_nowait_matches_sync(engine):
+    """The dispatch-pipelining handle returns the same probs as the
+    synchronous path, including padding/chunking and empty input; and
+    several in-flight handles drain correctly in any order (the C4
+    pipelined dispatch pattern)."""
+    rng = np.random.RandomState(7)
+    imgs = rng.randint(0, 255, (6, 32, 32, 3), dtype=np.uint8)
+    sync = engine.infer_arrays("TinyNet", imgs)
+    h = engine.infer_arrays_nowait("TinyNet", imgs)
+    np.testing.assert_allclose(h(), sync, rtol=1e-6)
+    assert engine.infer_arrays_nowait("TinyNet", imgs[:0])().shape == (0, 1000)
+    # overlapping handles, drained LIFO
+    batches = [rng.randint(0, 255, (3, 32, 32, 3), np.uint8) for _ in range(3)]
+    handles = [engine.infer_arrays_nowait("TinyNet", b) for b in batches]
+    for b, h in reversed(list(zip(batches, handles))):
+        np.testing.assert_allclose(
+            h(), engine.infer_arrays("TinyNet", b), rtol=1e-6
+        )

@@ -54,9 +54,11 @@ def test_keras_parity(name, keras_builder):
     assert ky.shape == fy.shape == (1, 1000)
     # with random weights the softmax is near-uniform (spread ~1e-5), so
     # argmax is decided by float noise — assert a tight absolute error
-    # (accumulated f32 noise over ~300 layers measures ~4e-6) plus
-    # correlation of the centered signal, which tolerance luck can't fake
-    np.testing.assert_allclose(fy, ky, atol=1e-5)
+    # plus correlation of the centered signal. atol 1e-6: with correct
+    # layer pairing the f32 compute-order noise floor measures ~1e-7;
+    # the old 1e-5 tolerance masked a same-shape conv mis-pairing that
+    # sat at ~3.5e-6 (see params_io.from_keras_model docstring)
+    np.testing.assert_allclose(fy, ky, atol=1e-6)
     kc, fc = ky - ky.mean(), fy - fy.mean()
     corr = float((kc * fc).sum() / np.sqrt((kc * kc).sum() * (fc * fc).sum()))
     assert corr > 0.5, f"centered correlation {corr:.3f} too low"
@@ -141,3 +143,67 @@ def test_keras_parity_efficientnet_b0(size):
     kc, fc = ky - ky.mean(), fy - fy.mean()
     corr = float((kc * fc).sum() / np.sqrt((kc * kc).sum() * (fc * fc).sum()))
     assert corr > 0.5, f"centered correlation {corr:.3f} too low"
+
+
+@pytest.mark.parametrize("name", ["ResNet50", "InceptionV3"])
+def test_from_keras_h5_matches_from_keras_model(name, tmp_path):
+    """The TF-free .h5 reader must produce the IDENTICAL tree the live
+    converter does (VERDICT r2 item 8: parity without TF's downloader).
+    Saved random weights stand in for the stock imagenet file — the h5
+    layout (layer groups, weight_names, autogenerated InceptionV3
+    names) is the same either way."""
+    tf = _keras()
+    from dml_tpu.models.params_io import from_keras_h5
+
+    spec = get_model(name)
+    kmodel = {
+        "ResNet50": lambda: tf.keras.applications.ResNet50(weights=None),
+        "InceptionV3": lambda: tf.keras.applications.InceptionV3(weights=None),
+    }[name]()
+    h5 = str(tmp_path / f"{name}.h5")
+    # write the LEGACY topological layout — the format of the stock
+    # imagenet files (Keras 3's native .weights.h5 is a different,
+    # positional layout the loader intentionally rejects)
+    import h5py
+    from keras.src.legacy.saving import legacy_h5_format
+
+    with h5py.File(h5, "w") as f:
+        legacy_h5_format.save_weights_to_hdf5_group(f, kmodel)
+
+    variables = init_variables(
+        spec, seed=0, dtype=jnp.float32, image_size=spec.input_size
+    )
+    via_model = from_keras_model(kmodel, variables)
+    via_h5 = from_keras_h5(h5, variables)
+
+    flat_m = jax.tree_util.tree_leaves_with_path(via_model)
+    flat_h = dict(jax.tree_util.tree_leaves_with_path(via_h5))
+    assert len(flat_m) == len(flat_h)
+    for path, leaf in flat_m:
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(flat_h[path]),
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+@pytest.mark.parametrize("name", ["ResNet101", "ResNet152"])
+def test_keras_parity_deep_resnets(name):
+    """ResNet101/152 share ResNet50's graph/naming scheme, so the same
+    exact-name weight pairing must hold (reference serves only 50/V3;
+    the deeper variants are net-new family width)."""
+    tf = _keras()
+    spec = get_model(name)
+    kmodel = getattr(tf.keras.applications, name)(weights=None)
+    variables = init_variables(
+        spec, seed=0, dtype=jnp.float32, image_size=spec.input_size
+    )
+    variables = from_keras_model(kmodel, variables)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, *spec.input_size, 3)).astype(np.float32)
+    ky = np.asarray(kmodel(x, training=False))
+    model = spec.build(dtype=jnp.float32)
+    fy = np.asarray(
+        jax.jit(lambda v, a: model.apply(v, a, train=False))(variables, x)
+    )
+    assert ky.shape == fy.shape == (1, 1000)
+    np.testing.assert_allclose(fy, ky, atol=1e-6)

@@ -123,3 +123,26 @@ def test_single_token_budget_and_validation(params):
     with pytest.raises(ValueError):
         srv.submit(np.array([1, 2]), 0)  # zero budget: rejected, not
         # silently one token (generate() returns [] for it)
+
+
+def test_sampled_request_independent_of_batch(params):
+    """temperature > 0: a request's sampled output is a pure function
+    of (seed, rid, positions) — fold_in streams, not a shared per-step
+    key — so it cannot depend on what else is decoding alongside it
+    (advisor finding, r2)."""
+    rng = np.random.RandomState(5)
+    pa = rng.randint(0, CFG.vocab_size, 9)
+    pb = rng.randint(0, CFG.vocab_size, 14)
+
+    def serve(prompts_budgets):
+        srv = LMServer(params, CFG, max_slots=2, max_len=64, chunk=3,
+                       temperature=0.8, top_k=20, seed=7)
+        rids = [srv.submit(p, n) for p, n in prompts_budgets]
+        return srv.run(), rids
+
+    out_alone, (ra,) = serve([(pa, 10)])
+    out_packed, (ra2, rb) = serve([(pa, 10), (pb, 6)])
+    # rid of A is 1 in both servers -> identical stream
+    np.testing.assert_array_equal(out_alone[ra], out_packed[ra2])
+    # and the second request actually produced tokens under sampling
+    assert len(out_packed[rb]) == 6

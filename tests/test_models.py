@@ -9,10 +9,13 @@ from dml_tpu.models.preprocess import decode_image, load_images, normalize_on_de
 
 # Small spatial inputs keep CPU compile+compute fast; parameter shapes
 # and graph structure are identical to deployment sizes (224/299).
-SMALL = {"ResNet50": (64, 64), "InceptionV3": (75, 75), "MobileNetV2": (64, 64)}
+SMALL = {"ResNet50": (64, 64), "ResNet101": (64, 64), "ResNet152": (64, 64),
+         "InceptionV3": (75, 75), "MobileNetV2": (64, 64)}
 
 
-@pytest.mark.parametrize("name", ["ResNet50", "InceptionV3", "MobileNetV2"])
+@pytest.mark.parametrize(
+    "name", ["ResNet50", "ResNet101", "ResNet152", "InceptionV3", "MobileNetV2"]
+)
 def test_forward_shape_and_probs(name):
     spec = get_model(name)
     model = spec.build(dtype=jnp.float32)
