@@ -319,6 +319,10 @@ class InferenceEngine:
         ]
         remaining = starts[window:]
         cached: List[np.ndarray] = []
+        # mutable cell so the drain can DROP the input reference: a
+        # long-lived handle must pin only the result, not a possibly
+        # multi-GB uint8 input (plus undispatched chunk plans) forever
+        src = [images_u8]
 
         def result() -> np.ndarray:
             if cached:  # handle re-read: same answer, no re-drain
@@ -331,10 +335,12 @@ class InferenceEngine:
                 if nxt < len(remaining):
                     s = remaining[nxt]
                     pending.append(
-                        self._dispatch_chunk(lm, images_u8[s : s + bs])
+                        self._dispatch_chunk(lm, src[0][s : s + bs])
                     )
                     nxt += 1
             cached.append(np.concatenate(out)[:n])
+            src.clear()
+            remaining.clear()
             return cached[0]
 
         return result

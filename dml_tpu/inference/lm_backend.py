@@ -1,0 +1,171 @@
+"""LM serving backend for the distributed job pipeline.
+
+Makes LM generation a first-class JOB TYPE of the cluster: prompts
+live in the replicated store as token files, `submit-job <lm> <N>`
+fans batches out to workers exactly like image jobs (same fair-share
+scheduler, same preemption/requeue recovery, same hot-standby
+relays — jobs/scheduler.py, jobs/service.py), and each worker decodes
+its batch through the continuous-batching `LMServer`. The reference
+has nothing like this (SURVEY §0: no sequence models); it is the
+distributed analog of its image pipeline (worker.py:518-537) for the
+framework's net-new LM stack.
+
+Prompt file contract (tokenizer-free core — plug a tokenizer at the
+edge): a text file of whitespace/comma-separated integer token ids,
+e.g. ``12 7 998 4``. Output per file: ``{"tokens": [...]}`` — the
+greedy completion, EXACTLY equal to an isolated
+`generate(prompt, max_new_tokens)` call for that prompt (the
+LMServer batching-exactness contract, tests/test_lm_server.py),
+regardless of which worker served it or what else shared the batch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..jobs.cost_model import ModelCost
+from .generate import LMConfig
+from .lm_server import LMServer
+
+
+def parse_prompt_file(path: str, vocab_size: int) -> np.ndarray:
+    """Token ids from a prompt file; raises with the offending path on
+    malformed content (the job pipeline surfaces it as a batch FAIL)."""
+    with open(path) as f:
+        text = f.read()
+    toks = [t for t in text.replace(",", " ").split() if t]
+    if not toks:
+        raise ValueError(f"{path}: empty prompt file")
+    try:
+        ids = np.array([int(t) for t in toks], np.int32)
+    except ValueError as e:
+        raise ValueError(f"{path}: non-integer token ({e})") from None
+    if (ids < 0).any() or (ids >= vocab_size).any():
+        raise ValueError(
+            f"{path}: token id out of range [0, {vocab_size})"
+        )
+    return ids
+
+
+class LMBackend:
+    """A worker-side serving backend compatible with
+    `JobService(infer_backend=...)`'s contract:
+    ``await backend(model, paths) -> (results, infer_time, cost)``.
+
+    Holds one `LMServer` (slot grid + KV cache allocated once); each
+    job batch submits its prompts and drains the server. Greedy by
+    default so distributed outputs are reproducible; temperature>0
+    stays per-request-deterministic via the server's fold_in streams.
+
+    >>> be = LMBackend(params, cfg, max_new_tokens=32)
+    >>> jobs = JobService(node, store, infer_backend=None)
+    >>> jobs.register_lm("MyLM", backend=be.backend, cost=be.cost())
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: LMConfig,
+        max_new_tokens: int = 32,
+        max_slots: int = 8,
+        max_len: int = 1024,
+        chunk: int = 16,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.max_new_tokens = max_new_tokens
+        self.server = LMServer(
+            params, cfg, max_slots=max_slots, max_len=max_len,
+            chunk=chunk, temperature=temperature, top_k=top_k, seed=seed,
+        )
+        # measured serving constants for the scheduler's cost model
+        # (folded from real ACKs after the first batch either way)
+        self._per_query = 0.05
+        # the LMServer is MUTABLE state. When the scheduler preempts a
+        # worker (jobs/service.py _h_task_request), the host-side task
+        # is cancelled at its await but the to_thread decode keeps
+        # running to completion in the background — without this lock
+        # the replacement batch would drive the same server
+        # concurrently and corrupt the slot grid (observed as KeyErrors
+        # under fair-share preemption). The orphaned run finishes,
+        # drains its slots, and its result is simply discarded.
+        self._serve_lock = threading.Lock()
+
+    def serve_files(
+        self, paths: Sequence[str]
+    ) -> Tuple[Dict[str, Any], float, Dict[str, float]]:
+        """Decode every prompt file; returns (results keyed by path,
+        decode seconds, cost constants) — the sync core of
+        `backend()`."""
+        prompts = [
+            parse_prompt_file(p, self.cfg.vocab_size) for p in paths
+        ]
+        # validate EVERY prompt against server capacity before
+        # submitting ANY: a mid-batch submit() failure would leave the
+        # earlier requests queued in the shared server (decoded and
+        # discarded on the next batch — and again per requeue retry),
+        # and the server's own error has no file path in it
+        limit = self.server.max_len - self.max_new_tokens
+        for p, prompt in zip(paths, prompts):
+            if prompt.size > limit:
+                raise ValueError(
+                    f"{p}: prompt of {prompt.size} tokens + budget "
+                    f"{self.max_new_tokens} exceeds the server's "
+                    f"max_len {self.server.max_len}"
+                )
+        t0 = time.monotonic()
+        with self._serve_lock:
+            rids = [
+                self.server.submit(prompt, self.max_new_tokens)
+                for prompt in prompts
+            ]
+            done = self.server.run()
+        infer_time = time.monotonic() - t0
+        if paths:
+            self._per_query = infer_time / len(paths)
+        results = {
+            p: {"tokens": [int(t) for t in done[rid]]}
+            for p, rid in zip(paths, rids)
+        }
+        return results, infer_time, self.cost_constants()
+
+    async def backend(
+        self, model: str, paths: Sequence[str]
+    ) -> Tuple[Dict[str, Any], float, Dict[str, float]]:
+        """JobService-compatible coroutine; the blocking decode runs in
+        a thread so the node's event loop stays live (same pattern as
+        the engine's infer_files_async)."""
+        del model
+        return await asyncio.to_thread(self.serve_files, paths)
+
+    def cost_constants(self) -> Dict[str, float]:
+        return {
+            "load_time": 0.0,
+            "first_query": self._per_query,
+            "per_query": self._per_query,
+            "batch_size": self.server.max_slots,
+        }
+
+    def cost(self) -> ModelCost:
+        """Initial scheduler cost (refined from ACK measurements)."""
+        return ModelCost(
+            load_time=0.0,
+            first_query=self._per_query,
+            per_query=self._per_query,
+            download_time=0.0,
+            batch_size=self.server.max_slots,
+        )
+
+
+def write_prompt_file(path: str, tokens: Sequence[int]) -> None:
+    """Inverse of parse_prompt_file — the client-side helper for
+    seeding prompt files into the store."""
+    with open(path, "w") as f:
+        f.write(" ".join(str(int(t)) for t in tokens))

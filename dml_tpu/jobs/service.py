@@ -66,6 +66,11 @@ class JobService:
         self.store = store
         self.image_patterns = image_patterns
         self._backend = infer_backend or self._engine_backend
+        # LM (or other non-CNN) serving models registered on this node:
+        # per-model worker backend + per-model input-file patterns
+        # (image jobs sample *.jpeg; LM jobs sample prompt-token files)
+        self._extra_backends: Dict[str, InferBackend] = {}
+        self.model_patterns: Dict[str, Tuple[str, ...]] = {}
         self._engine = None  # lazy InferenceEngine (imports jax on first use)
         self.scheduler = Scheduler(costs=self._seed_costs())
         self._current: Optional[Tuple[Tuple[int, int], asyncio.Task]] = None
@@ -231,6 +236,50 @@ class JobService:
     # predict-locally, worker.py:1744-1997)
     # ------------------------------------------------------------------
 
+    def _canon(self, model: str) -> str:
+        """Canonical model name: registry aliases resolve (resnet ->
+        ResNet50); names registered via `register_lm` resolve
+        case-insensitively (matching the registry's convention), and
+        an unknown name's error lists them."""
+        try:
+            return get_model(model).name
+        except KeyError:
+            lm_names = set(self._extra_backends) | set(self.model_patterns)
+            hit = {n.lower(): n for n in sorted(lm_names)}.get(model.lower())
+            if hit is not None:
+                return hit
+            raise KeyError(
+                f"unknown model {model!r}; registered LM models: "
+                f"{sorted(lm_names) or 'none'}; CNN registry: "
+                f"{sorted({s.name for s in MODEL_REGISTRY.values()})}"
+            ) from None
+
+    def register_lm(
+        self,
+        name: str,
+        backend: Optional[InferBackend] = None,
+        cost: Optional[Any] = None,
+        patterns: Tuple[str, ...] = ("*.tokens.txt", "*.prompt.txt"),
+    ) -> None:
+        """Register an LM serving model as a first-class job type.
+
+        Call on EVERY node with the same arguments (like the engine's
+        CNN registry, which is implicitly shared): `backend` makes
+        this node able to EXECUTE the model's batches (worker role),
+        `cost` seeds the fair-share scheduler's plan wherever this
+        node coordinates (leader or promoted standby; refined from
+        ACK measurements either way), `patterns` tells the intake
+        which store files are this model's inputs. After this,
+        `submit-job <name> <N>` flows through the identical pipeline
+        as image jobs — same batching, fair-share split, preemption,
+        requeue-on-failure, standby relays, and get-output merge.
+        """
+        if backend is not None:
+            self._extra_backends[name] = backend
+        self.model_patterns[name] = tuple(patterns)
+        if cost is not None:
+            self.scheduler.set_cost(name, cost)
+
     async def submit_job(
         self, model: str, n_queries: int, timeout: float = 20.0, retries: int = 3
     ) -> int:
@@ -240,7 +289,7 @@ class JobService:
         The request carries an idempotency token and is retried on
         timeout (the transport is at-most-once UDP); the coordinator
         dedups by token so a retry can't mint a second job."""
-        model = get_model(model).name
+        model = self._canon(model)
         token = self.node.new_rid()
         reply = await leader_retry(
             self.node,
@@ -331,7 +380,9 @@ class JobService:
     async def predict_locally(self, model: str, files: List[str]) -> Dict[str, Any]:
         """`predict-locally <model> <files...>` (reference
         worker.py:1573-1585): run inference on this node, no cluster."""
-        results, exec_time, _ = await self._backend(get_model(model).name, files)
+        model = self._canon(model)
+        be = self._extra_backends.get(model, self._backend)
+        results, exec_time, _ = await be(model, files)
         return {"results": results, "exec_time": exec_time}
 
     async def set_batch_size(self, model: str, batch_size: int) -> None:
@@ -339,7 +390,7 @@ class JobService:
         SET_BATCH_SIZE, worker.py:1028-1037)."""
         await self.node.leader_request(
             MsgType.SET_BATCH_SIZE,
-            {"model": get_model(model).name, "batch_size": int(batch_size)},
+            {"model": self._canon(model), "batch_size": int(batch_size)},
         )
 
     async def c2_stats(self, model: str) -> Dict[str, float]:
@@ -347,7 +398,7 @@ class JobService:
         fetchable from any node (reference GET_C2_COMMAND,
         worker.py:1039-1059)."""
         reply = await self.node.leader_request(
-            MsgType.GET_C2_COMMAND, {"model": get_model(model).name}
+            MsgType.GET_C2_COMMAND, {"model": self._canon(model)}
         )
         return reply.get("stats", {})
 
@@ -489,14 +540,15 @@ class JobService:
             return
         model = msg.data.get("model", "")
         n = int(msg.data.get("n", 0))
+        patterns = self.model_patterns.get(model, self.image_patterns)
         files = sorted({
-            f for p in self.image_patterns for f in self.store.metadata.matching(p)
+            f for p in patterns for f in self.store.metadata.matching(p)
         })
         error = None
         if n <= 0:
             error = f"n_queries must be positive, got {n}"
         elif not files:
-            error = f"no {'/'.join(self.image_patterns)} files in the store"
+            error = f"no {'/'.join(patterns)} files in the store"
         if error is not None:
             self.node.send_unique(
                 msg.sender,
@@ -969,7 +1021,8 @@ class JobService:
             t_fetch = time.monotonic() - t0
             t1 = time.monotonic()
             with span("worker.inference"):
-                results, infer_time, cost = await self._backend(batch.model, paths)
+                be = self._extra_backends.get(batch.model, self._backend)
+                results, infer_time, cost = await be(batch.model, paths)
             t_backend = time.monotonic() - t1
             # backends key results by the LOCAL path (the engine uses
             # the full path, others may use the basename), which
