@@ -46,7 +46,20 @@ RMS_EPS = 1e-6  # flax nn.RMSNorm default, as used by TransformerLM
 
 @dataclass(frozen=True)
 class LMConfig:
-    """Shape config mirroring TransformerLM's fields."""
+    """Shape config mirroring TransformerLM's fields.
+
+    `kv_quant=True` stores the KV cache as int8 with one f32 scale per
+    (position, kv-head) — ~1.9x less cache HBM than bf16, i.e. ~2x the
+    contexts/slots per chip. On the current v5e toolchain it is a
+    CAPACITY feature only: XLA does not fuse the cache dequant into
+    the attention matvec, so decode measures ~0.66x bf16-cache (bench
+    `lm.kv_cache_int8_4k_ctx_b8`, re-measured every round — the same
+    fusion flipped across toolchains for int8 weights).
+    Numerics: symmetric per-vector rounding on K and V (~0.4% each);
+    greedy outputs can differ from the bf16-cache path on near-ties,
+    so the serving stack treats kv_quant as a MODEL CONFIG, not a
+    transparent switch (the batching-exactness contract holds within
+    a config)."""
 
     vocab_size: int
     d_model: int
@@ -55,6 +68,7 @@ class LMConfig:
     d_ff: int
     dtype: Any = jnp.bfloat16
     n_kv_heads: Optional[int] = None  # GQA; None = MHA
+    kv_quant: bool = False
 
     def __post_init__(self):
         kv = self.n_kv_heads
@@ -76,8 +90,21 @@ class LMConfig:
 def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
     """Pre-allocated KV cache: one [B, max_len, KV, D] pair per layer
     — KV = n_kv_heads under GQA, so the cache (and each decode step's
-    HBM reads of it) shrinks n_heads/n_kv_heads-fold."""
+    HBM reads of it) shrinks n_heads/n_kv_heads-fold. Under
+    `cfg.kv_quant` each tensor is int8 plus a [B, max_len, KV, 1] f32
+    scale (symmetric per-(position, head) quantization)."""
     shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    if cfg.kv_quant:
+        sshape = (batch, max_len, cfg.kv_heads, 1)
+        return {
+            f"block_{i}": {
+                "k_q": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.zeros(sshape, jnp.float32),
+                "v_q": jnp.zeros(shape, jnp.int8),
+                "v_s": jnp.zeros(sshape, jnp.float32),
+            }
+            for i in range(cfg.n_layers)
+        }
     return {
         f"block_{i}": {
             "k": jnp.zeros(shape, cfg.dtype),
@@ -85,6 +112,25 @@ def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
         }
         for i in range(cfg.n_layers)
     }
+
+
+def _kv_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[..., D] -> (int8 values, f32 scale over the last axis)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _kv_dequant(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """int8 + scale -> f32 (the read side). Whether XLA fuses this
+    into the consuming attention contraction decides kv_quant's
+    throughput story — it does for int8 WEIGHTS on the current
+    toolchain but measurably not for the cache (bench
+    `lm.kv_cache_int8_4k_ctx_b8`: ~0.66x bf16-cache), so kv_quant is
+    a capacity feature until that flips."""
+    return q.astype(jnp.float32) * scale
 
 
 def _rms_norm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
@@ -225,7 +271,9 @@ def batched_decode_step(
     grp = cfg.n_heads // cfg.kv_heads
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)[:, None, :]
     positions = pos[:, None]  # [B, 1] — rope's per-example form
-    max_len = next(iter(cache.values()))["k"].shape[1]
+    # layout-generic (bf16 {k, v} or kv_quant {k_q, ...}): every leaf
+    # carries [B, max_len, ...]
+    max_len = next(iter(next(iter(cache.values())).values())).shape[1]
     # per-slot validity: slot b sees cache positions <= pos[b]
     valid = jnp.arange(max_len)[None, :] <= pos[:, None]  # [B, T]
 
@@ -239,9 +287,22 @@ def batched_decode_step(
                     c, u, p, axis=0
                 )
             )
-            ck = upd(cache[name]["k"], k.astype(cfg.dtype), pos)
-            cv = upd(cache[name]["v"], v.astype(cfg.dtype), pos)
-            new_cache[name] = {"k": ck, "v": cv}
+            if cfg.kv_quant:
+                kq, ks = _kv_quantize(k)
+                vq, vs = _kv_quantize(v)
+                lay = {
+                    "k_q": upd(cache[name]["k_q"], kq, pos),
+                    "k_s": upd(cache[name]["k_s"], ks, pos),
+                    "v_q": upd(cache[name]["v_q"], vq, pos),
+                    "v_s": upd(cache[name]["v_s"], vs, pos),
+                }
+                new_cache[name] = lay
+                ck = _kv_dequant(lay["k_q"], lay["k_s"])
+                cv = _kv_dequant(lay["v_q"], lay["v_s"])
+            else:
+                ck = upd(cache[name]["k"], k.astype(cfg.dtype), pos)
+                cv = upd(cache[name]["v"], v.astype(cfg.dtype), pos)
+                new_cache[name] = {"k": ck, "v": cv}
             qg = q.astype(jnp.float32).reshape(b, 1, cfg.kv_heads, grp, hd)
             s = jnp.einsum(
                 "bqkgd,btkd->bkgqt", qg, ck.astype(jnp.float32)
@@ -297,16 +358,23 @@ def prefill(
         return flash_attention(q, k, v, causal=True)
 
     cache: Dict[str, Any] = {}
+    pad4 = ((0, 0), (0, pad), (0, 0), (0, 0))
     for i in range(cfg.n_layers):
         x, k, v = _apply_block(
             params[f"block_{i}"], cfg, x, positions, attn_fn
         )
-        cache[f"block_{i}"] = {
-            "k": jnp.pad(k.astype(cfg.dtype),
-                         ((0, 0), (0, pad), (0, 0), (0, 0))),
-            "v": jnp.pad(v.astype(cfg.dtype),
-                         ((0, 0), (0, pad), (0, 0), (0, 0))),
-        }
+        if cfg.kv_quant:
+            kq, ks = _kv_quantize(k)
+            vq, vs = _kv_quantize(v)
+            cache[f"block_{i}"] = {
+                "k_q": jnp.pad(kq, pad4), "k_s": jnp.pad(ks, pad4),
+                "v_q": jnp.pad(vq, pad4), "v_s": jnp.pad(vs, pad4),
+            }
+        else:
+            cache[f"block_{i}"] = {
+                "k": jnp.pad(k.astype(cfg.dtype), pad4),
+                "v": jnp.pad(v.astype(cfg.dtype), pad4),
+            }
 
     if logits_index is None:
         x_last = x[:, -1:]

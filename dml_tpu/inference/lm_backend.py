@@ -120,14 +120,17 @@ class LMBackend:
                     f"{self.max_new_tokens} exceeds the server's "
                     f"max_len {self.server.max_len}"
                 )
-        t0 = time.monotonic()
         with self._serve_lock:
+            # clock starts INSIDE the lock: waiting out an orphaned
+            # preempted decode is queueing, not this batch's cost —
+            # it must not inflate the scheduler's per_query model
+            t0 = time.monotonic()
             rids = [
                 self.server.submit(prompt, self.max_new_tokens)
                 for prompt in prompts
             ]
             done = self.server.run()
-        infer_time = time.monotonic() - t0
+            infer_time = time.monotonic() - t0
         if paths:
             self._per_query = infer_time / len(paths)
         results = {

@@ -35,9 +35,9 @@ from `generate`'s split-chain, which is shape-coupled by design.
 
 Measured on v5e (12-layer 1024d GQA-4 LM, bf16, 1k cache;
 re-captured every bench run — `lm.continuous_batching` in the latest
-BENCH_r* artifact): 1 slot decodes at ~1923 tok/s, 8 slots at ~7214
-tok/s aggregate — ~3.8x, because the weight stream (the per-step HBM
-bill) is shared by every slot.
+BENCH_r* artifact): 1 slot decodes at ~1.9-2k tok/s, 8 slots at
+~7-7.2k tok/s aggregate — ~3.5-3.9x, because the weight stream (the
+per-step HBM bill) is shared by every slot.
 Caveat for remoted chips: the server makes several dispatches per
 request (prefill, insert, chunks); through a high-latency tunnel the
 round trips dominate and a single fused `generate` call can win —
@@ -157,15 +157,15 @@ class LMServer:
         insert or unclamped scatter would break the pairing; keep both
         sides together."""
         del n_valid
-        out = {}
-        for name, kv in cache.items():
-            src_k = pcache[name]["k"][0]
-            src_v = pcache[name]["v"][0]
-            out[name] = {
-                "k": kv["k"].at[slot].set(src_k),
-                "v": kv["v"].at[slot].set(src_v),
+        # generic over the cache layout (bf16 {k, v} or kv_quant
+        # {k_q, k_s, v_q, v_s}) — every leaf copies the same way
+        return {
+            name: {
+                key: kv[key].at[slot].set(pcache[name][key][0])
+                for key in kv
             }
-        return out
+            for name, kv in cache.items()
+        }
 
     def _sample_slots(self, logits, rid, write_pos):
         """Per-slot sampling: the token that will occupy position

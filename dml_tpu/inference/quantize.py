@@ -9,24 +9,23 @@ accuracy loss is bounded by per-channel rounding (~0.4%).
 
 What this buys, measured on v5e (198M-param GQA-4 LM, B=1, 512-token
 cache; re-captured every bench run — `lm.decode_weight_forms_b1` in
-the latest BENCH_r* artifact, first landed in BENCH_r03_preview.json):
+the latest BENCH_r* artifact):
 
-- f32-resident weights:  ~1082 tok/s (0.93 ms/tok)
-- bf16-resident weights: ~2062 tok/s (0.49 ms/tok)
-- int8 + dequant-at-use: ~4172 tok/s (0.24 ms/tok)
+- f32-resident weights:  ~1.1-1.5k tok/s
+- bf16-resident weights: ~1.8-2.2k tok/s (stable across captures)
+- int8 + dequant-at-use: ~2.3-4.5k tok/s (BIMODAL across captures)
 
-i.e. int8 is BOTH a throughput and a capacity feature on the current
-toolchain: XLA fuses the int8 read + dequant into the matvec, so the
-per-token HBM bill drops with the weight bytes (~2x vs bf16 on the
-matmul-kernel stream). The capacity side is bounded by what stays
-float: 1.33x less HBM than the bf16 tree end-to-end (the f32 embed
-dominates the remainder). TWO caveats this bench exists to keep
-honest: (a) an earlier toolchain materialized the dequantized buffer
-per scan step and int8 LOST to bf16; (b) the fused-read speed needs
-HBM headroom — with ~1 GB of CNN weights co-resident the same program
-measured ~1056 tok/s (r3), so the bench frees the chip first.
-`LongContextLM.generate` serves bf16-cast weights by default and
-offers `quantize_weights=True`.
+i.e. int8 never loses to bf16 on the current toolchain and often wins
+~2x (when XLA fuses the int8 read + dequant into the matvec the
+per-token HBM bill drops with the weight bytes), but the fusion is
+memory-state sensitive: with ~1 GB of CNN weights co-resident the
+same program measured ~1056 tok/s (the bench frees the chip first),
+and even clean-chip captures split between ~2.3k and ~4.5k. On an
+earlier toolchain the dequant materialized per scan step and int8
+LOST outright. The capacity side is deterministic: 1.33x less HBM
+than the bf16 tree end-to-end (the f32 embed dominates the
+remainder). `LongContextLM.generate` serves bf16-cast weights by
+default and offers `quantize_weights=True`.
 
 Scope: the 2-D matmul kernels of TransformerLM blocks (qkv, proj,
 up, down, lm_head) and the stacked MoE expert tensors (w_up, w_down,

@@ -188,19 +188,24 @@ class LongContextLM:
         seed: int = 0,
         quantize_weights: bool = False,
         serve_dtype_cast: bool = True,
+        kv_quant: bool = False,
     ) -> np.ndarray:
         """Autoregressive decoding with the trained weights (KV-cache
         path, inference/generate.py); MoE blocks decode with exact
         per-token top-2 routing.
 
         Decode is HBM-bound, so by default the f32 master weights are
-        cast once to the model dtype for serving (measured 1.36x
-        tok/s on v5e) — that keeps a second parameter copy resident;
+        cast once to the model dtype for serving (~1.9x tok/s on v5e,
+        re-measured per round: bench `lm.decode_weight_forms_b1`) —
+        that keeps a second parameter copy resident;
         pass `serve_dtype_cast=False` to stream the training tree
         directly when HBM is too tight for the copy.
         `quantize_weights=True` serves weight-only int8 instead
-        (inference/quantize.py): 1.57x less weight HBM than bf16, for
-        models that otherwise don't fit. Serving forms are cached per
+        (inference/quantize.py; capacity AND ~2x decode on the
+        current toolchain — bench `lm.decode_weight_forms_b1`);
+        `kv_quant=True` stores the KV cache as int8 + per-position
+        scales (~1.9x less cache HBM — bench
+        `lm.kv_cache_int8_4k_ctx_b8`). Serving forms are cached per
         training step."""
         from ..inference.generate import LMConfig, generate as _generate
 
@@ -208,12 +213,12 @@ class LongContextLM:
         cfg = LMConfig(
             vocab_size=m.vocab_size, d_model=m.d_model, n_heads=m.n_heads,
             n_layers=m.n_layers, d_ff=m.d_ff, dtype=m.dtype,
-            n_kv_heads=m.n_kv_heads,
+            n_kv_heads=m.n_kv_heads, kv_quant=kv_quant,
         )
         # one jitted closure per decode config, cached — repeated
         # serving calls must not re-trace the n_layers decode graph
         key = (prompt.shape, max_new_tokens, temperature, top_k,
-               quantize_weights)
+               quantize_weights, kv_quant)
         fn = self._gen_cache.get(key)
         if fn is None:
             fn = jax.jit(
@@ -225,8 +230,9 @@ class LongContextLM:
             self._gen_cache[key] = fn
         # serving weights: decode is HBM-bound, so streaming f32 master
         # weights wastes half the bandwidth — serve a model-dtype
-        # (bf16) cast by default (measured 1.36x tok/s vs f32 on v5e),
-        # or the int8 tree when HBM capacity matters more than rate.
+        # (bf16) cast by default (~1.9x tok/s vs f32 on v5e, bench
+        # `lm.decode_weight_forms_b1`), or the int8 tree (now both a
+        # capacity AND a throughput win there).
         # All forms carry the training shardings through (XLA gathers
         # what each op needs; force-replicating would defeat tp
         # sharding for models that only fit partitioned).

@@ -205,6 +205,17 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
                 )
                 + f"; GQA-4 = {heads.get('gqa4_vs_mha_speedup', 'n/a')}× MHA",
             )
+        kq = lm.get("kv_cache_int8_4k_ctx_b8") or {}
+        if kq:
+            row(
+                "int8 KV cache at 4k context (B=8, GQA-4)",
+                "—",
+                f"bf16 cache {_num(kq.get('bf16_cache_tok_per_s'))} → "
+                f"int8 cache {_num(kq.get('int8_cache_tok_per_s'))} "
+                f"tok/s ({kq.get('speedup', 'n/a')}×); "
+                f"{kq.get('cache_mb_per_slot_bf16', 'n/a')} → "
+                f"{kq.get('cache_mb_per_slot_int8', 'n/a')} MB/slot",
+            )
         pf = lm.get("prefill_2k_prompt") or {}
         if pf:
             row(
@@ -267,6 +278,45 @@ def load_bench(bench_path: str) -> Dict[str, Any]:
             # enforce committed-table == regeneration
             return {"_unparseable_wrapper": True}
     return data
+
+
+def sanity_check(bench: Dict[str, Any]) -> List[str]:
+    """Plausibility screen for a bench artifact — catches degenerate
+    slope measurements (an r3 run recorded flash_fwd_ms = 0.0 and an
+    8.8e6x 'speedup' when tunnel jitter swallowed a short chain)
+    before they're committed into the published table. Returns a list
+    of violations; empty = plausible. Ranges are generous physical
+    bounds for one v5e-class chip, not expectations."""
+    m = bench.get("matrix", bench)
+    bad: List[str] = []
+
+    def rng(path, val, lo, hi):
+        if val is None:
+            return
+        if not isinstance(val, (int, float)) or not (lo <= val <= hi):
+            bad.append(f"{path} = {val!r} outside [{lo}, {hi}]")
+
+    hl = m.get("headline_resnet50_b32") or {}
+    rng("headline.qps", hl.get("qps"), 1e3, 1e5)
+    rng("headline.mfu", hl.get("mfu"), 0.05, 1.0)
+    pl = m.get("pallas_on_device") or {}
+    rng("pallas.flash_fwd_ms", pl.get("flash_fwd_ms"), 0.2, 50)
+    rng("pallas.flash_vs_naive_speedup",
+        pl.get("flash_vs_naive_speedup"), 1, 100)
+    rng("pallas.ring_flash_speedup", pl.get("ring_flash_speedup"), 1, 100)
+    lm = m.get("lm") or {}
+    for k, form in (lm.get("decode_weight_forms_b1") or {}).items():
+        if isinstance(form, dict):
+            rng(f"lm.forms.{k}.tok_per_s", form.get("tok_per_s"), 50, 5e4)
+    for k, h in (lm.get("decode_kv_heads_4k_ctx_b1") or {}).items():
+        if isinstance(h, dict):
+            rng(f"lm.heads.{k}.tok_per_s", h.get("tok_per_s"), 50, 5e4)
+    pf = lm.get("prefill_2k_prompt") or {}
+    rng("lm.prefill_ms", pf.get("prefill_ms"), 1, 500)
+    rng("lm.prefill_speedup", pf.get("speedup"), 2, 1000)
+    cb = lm.get("continuous_batching") or {}
+    rng("lm.cb.gain", cb.get("batching_gain_8_vs_1"), 0.5, 16)
+    return bad
 
 
 def generate(bench_path: str) -> str:

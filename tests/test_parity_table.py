@@ -57,3 +57,28 @@ def test_splice_roundtrip(tmp_path):
     assert again == spliced
     with pytest.raises(ValueError):
         pt.splice("no markers here", table)
+
+
+def test_committed_artifact_is_plausible():
+    """The artifact PARITY's table is generated from must pass the
+    plausibility screen — a degenerate slope measurement (0.0 ms
+    flash fwd, 8.8e6x speedup: seen in an r3 capture) must fail CI,
+    not get published."""
+    text = _read_parity()
+    m = pt.BEGIN_RE.search(text)
+    src = os.path.join(pt.REPO_ROOT, m.group("src"))
+    bench = pt.load_bench(src)
+    if "_unparseable_wrapper" in bench:
+        pytest.skip("source is a truncated driver wrapper")
+    violations = pt.sanity_check(bench)
+    assert not violations, f"implausible bench values: {violations}"
+
+
+def test_sanity_check_catches_degenerate_slope():
+    bad = {"matrix": {"pallas_on_device": {
+        "flash_fwd_ms": 0.0, "flash_vs_naive_speedup": 8864486.6,
+    }}}
+    v = pt.sanity_check(bad)
+    assert any("flash_fwd_ms" in x for x in v)
+    assert any("speedup" in x for x in v)
+    assert pt.sanity_check({"matrix": {}}) == []
