@@ -28,13 +28,30 @@ from .ring_attention import ring_attention
 from .sharding import partition_params
 
 
-def make_lm(mesh: Mesh, **config) -> TransformerLM:
-    """A TransformerLM with the right attention for `mesh`: the sp-ring
-    (KV rotation over ICI) when the sequence is sharded, the Pallas
-    flash kernel (ops/flash_attention.py) on a single sequence shard —
-    dp/tp sharding of the flash path is GSPMD's job."""
+def make_lm(mesh: Mesh, seq_parallel: str = "ring", **config) -> TransformerLM:
+    """A TransformerLM with the right attention for `mesh`: a
+    sequence-parallel strategy when the sequence is sharded —
+    `seq_parallel="ring"` (KV rotation over ICI, parallel/
+    ring_attention.py; works for any head count) or `"ulysses"`
+    (two all_to_all head<->seq reshards, parallel/ulysses.py; fewer
+    bigger collectives, needs heads % sp == 0 — tradeoff in the
+    ulysses module docstring) — and the Pallas flash kernel
+    (ops/flash_attention.py) on a single sequence shard, where dp/tp
+    sharding of the flash path is GSPMD's job."""
+    if seq_parallel not in ("ring", "ulysses"):
+        # validate regardless of mesh: a typo must not train silently
+        # on an sp=1 dev mesh and only explode on the real pod
+        raise ValueError(
+            f"seq_parallel must be 'ring' or 'ulysses', "
+            f"got {seq_parallel!r}"
+        )
     if mesh.shape.get("sp", 1) > 1:
-        attn = functools.partial(ring_attention, mesh=mesh)
+        if seq_parallel == "ulysses":
+            from .ulysses import ulysses_attention
+
+            attn = functools.partial(ulysses_attention, mesh=mesh)
+        else:
+            attn = functools.partial(ring_attention, mesh=mesh)
 
         def attention(q, k, v, causal=True):
             return attn(q, k, v, causal=causal)
@@ -195,9 +212,10 @@ class LongContextLM:
         per-token top-2 routing.
 
         Decode is HBM-bound, so by default the f32 master weights are
-        cast once to the model dtype for serving (~1.9x tok/s on v5e,
-        re-measured per round: bench `lm.decode_weight_forms_b1`) —
-        that keeps a second parameter copy resident;
+        cast once to the model dtype for serving (1.4-1.9x tok/s
+        across v5e captures, re-measured per round: bench
+        `lm.decode_weight_forms_b1`) — that keeps a second parameter
+        copy resident;
         pass `serve_dtype_cast=False` to stream the training tree
         directly when HBM is too tight for the copy.
         `quantize_weights=True` serves weight-only int8 instead
@@ -230,9 +248,9 @@ class LongContextLM:
             self._gen_cache[key] = fn
         # serving weights: decode is HBM-bound, so streaming f32 master
         # weights wastes half the bandwidth — serve a model-dtype
-        # (bf16) cast by default (~1.9x tok/s vs f32 on v5e, bench
-        # `lm.decode_weight_forms_b1`), or the int8 tree (now both a
-        # capacity AND a throughput win there).
+        # (bf16) cast by default (1.4-1.9x tok/s vs f32 across v5e
+        # captures, bench `lm.decode_weight_forms_b1`), or the int8
+        # tree (capacity always; throughput when the read fuses).
         # All forms carry the training shardings through (XLA gathers
         # what each op needs; force-replicating would defeat tp
         # sharding for models that only fit partitioned).

@@ -316,6 +316,12 @@ def sanity_check(bench: Dict[str, Any]) -> List[str]:
     rng("lm.prefill_speedup", pf.get("speedup"), 2, 1000)
     cb = lm.get("continuous_batching") or {}
     rng("lm.cb.gain", cb.get("batching_gain_8_vs_1"), 0.5, 16)
+    kq = lm.get("kv_cache_int8_4k_ctx_b8") or {}
+    rng("lm.kv_int8.bf16_tok_per_s",
+        kq.get("bf16_cache_tok_per_s"), 50, 1e5)
+    rng("lm.kv_int8.int8_tok_per_s",
+        kq.get("int8_cache_tok_per_s"), 50, 1e5)
+    rng("lm.kv_int8.speedup", kq.get("speedup"), 0.05, 20)
     return bad
 
 
@@ -354,6 +360,16 @@ def main() -> None:
     bench_path = args.bench or latest_bench_path()
     if bench_path is None:
         raise SystemExit("no BENCH_r*.json found")
+    # the plausibility screen gates generation, not just CI: a
+    # degenerate slope artifact must be refused here, before an
+    # implausible table can land in PARITY.md at all
+    violations = sanity_check(load_bench(bench_path))
+    if violations:
+        raise SystemExit(
+            f"{bench_path} fails the plausibility screen "
+            f"(degenerate measurement?): {violations} — re-run the "
+            "bench; see sanity_check()"
+        )
     table = generate(bench_path)
     if args.write:
         with open(PARITY_PATH) as f:
