@@ -55,7 +55,9 @@ file commands (replicated store):
   store                             files replicated on THIS node
   load-testfiles <dir> [n]          bulk-put *.jpeg from a directory
 job commands (ML inference):
-  submit-job <model> <N>            run N queries (ResNet50 | InceptionV3)
+  submit-job <model> <N>            run N queries (any registered model:
+                                    ResNet50 | InceptionV3 | ... | an
+                                    --lm-spec LM serving prompt files)
   get-output <jobid>                collect + merge a job's results
   predict-locally <model> <f...>    single-node inference on local files
   save-model <model>                publish weights into the store
@@ -80,7 +82,7 @@ class NodeApp:
     """One running cluster node: Node + StoreService + JobService +
     the interactive prompt."""
 
-    def __init__(self, spec: ClusterSpec, name: str):
+    def __init__(self, spec: ClusterSpec, name: str, lm_specs=()):
         me = spec.node_by_name(name) or spec.node_by_unique_name(name)
         if me is None:
             raise SystemExit(f"unknown node {name!r}; spec has {[n.name for n in spec.nodes]}")
@@ -88,8 +90,24 @@ class NodeApp:
         self.node = Node(spec, me)
         self.store = StoreService(self.node)
         self.jobs = JobService(self.node, self.store)
+        self._lm_specs = list(lm_specs)
 
     async def start(self) -> None:
+        # LM serving models from --lm-spec files: built BEFORE the
+        # node joins (model init can take seconds; a joined-but-
+        # unready worker would eat scheduled batches). Deterministic
+        # seed => every node loading the same spec serves the
+        # identical weights (see LMBackend.from_spec).
+        # getattr: tests construct NodeApp via __new__ without __init__
+        for lm_spec in getattr(self, "_lm_specs", []):
+            from .inference.lm_backend import LMBackend
+
+            be = await asyncio.to_thread(LMBackend.from_spec, lm_spec)
+            name = str(lm_spec.get("name", "LM"))
+            self.jobs.register_lm(name, backend=be.backend, cost=be.cost())
+            print(f"registered LM serving model {name!r} "
+                  f"({be.cfg.n_layers}L {be.cfg.d_model}d, "
+                  f"max_new_tokens={be.max_new_tokens})")
         await self.node.start()
         await self.store.start()
         await self.jobs.start()
@@ -300,7 +318,11 @@ async def _run_node(args) -> None:
         spec.testing = True
         if args.drop_pct is not None:
             spec.packet_drop_pct = args.drop_pct
-    app = NodeApp(spec, args.name)
+    lm_specs = []
+    for path in getattr(args, "lm_spec", []):
+        with open(path) as f:
+            lm_specs.append(json.load(f))
+    app = NodeApp(spec, args.name, lm_specs=lm_specs)
     await app.start()
     stop = asyncio.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -338,6 +360,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="packet drop %% in test mode")
     pn.add_argument("--no-repl", action="store_true",
                     help="headless: no interactive prompt")
+    pn.add_argument("--lm-spec", action="append", default=[],
+                    metavar="FILE",
+                    help="register an LM serving model from a JSON "
+                         "spec (repeatable; load the SAME file on "
+                         "every node — see LMBackend.from_spec)")
     pn.add_argument("-v", "--verbose", action="store_true")
 
     pi = sub.add_parser("introducer", help="run the introducer DNS")

@@ -166,6 +166,76 @@ class LMBackend:
             batch_size=self.server.max_slots,
         )
 
+    @classmethod
+    def from_spec(cls, spec: Dict[str, Any]) -> "LMBackend":
+        """Build from a JSON-able spec — the CLI's `--lm-spec` file,
+        so operators register LM serving without writing Python:
+
+            {"name": "LM", "vocab_size": 256, "d_model": 64,
+             "n_heads": 4, "n_kv_heads": 2, "n_layers": 2,
+             "max_new_tokens": 32, "max_slots": 4, "max_len": 1024,
+             "weights": null}
+
+        Weights are DETERMINISTIC from `seed` — every node that loads
+        the same spec builds the IDENTICAL tree (the LM analog of the
+        engine's deterministic CNN init; required for exactness across
+        workers) — unless `weights` names a local flax-msgpack file
+        produced by `params_io.variables_to_bytes({"params": ...})`
+        (e.g. fetched from the replicated store with `get`).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.transformer import TransformerLM
+
+        dtype = {
+            "bfloat16": jnp.bfloat16, "float32": jnp.float32,
+        }[spec.get("dtype", "bfloat16")]
+        d_model = int(spec["d_model"])
+        cfg = LMConfig(
+            vocab_size=int(spec["vocab_size"]),
+            d_model=d_model,
+            n_heads=int(spec.get("n_heads", 8)),
+            n_layers=int(spec.get("n_layers", 2)),
+            d_ff=int(spec.get("d_ff", 4 * d_model)),
+            dtype=dtype,
+            n_kv_heads=(
+                int(spec["n_kv_heads"])
+                if spec.get("n_kv_heads") is not None else None
+            ),
+            kv_quant=bool(spec.get("kv_quant", False)),
+        )
+        model = TransformerLM(
+            vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+            n_heads=cfg.n_heads, n_layers=cfg.n_layers, d_ff=cfg.d_ff,
+            dtype=cfg.dtype, n_kv_heads=cfg.n_kv_heads,
+        )
+        params = model.init(
+            jax.random.PRNGKey(int(spec.get("seed", 0))),
+            jnp.zeros((1, 8), jnp.int32),
+        )["params"]
+        if spec.get("weights"):
+            from ..models.params_io import variables_from_bytes
+
+            with open(spec["weights"], "rb") as f:
+                data = f.read()
+            params = variables_from_bytes(
+                data, {"params": params}
+            )["params"]
+        return cls(
+            params, cfg,
+            max_new_tokens=int(spec.get("max_new_tokens", 32)),
+            max_slots=int(spec.get("max_slots", 4)),
+            max_len=int(spec.get("max_len", 1024)),
+            chunk=int(spec.get("chunk", 16)),
+            temperature=float(spec.get("temperature", 0.0)),
+            top_k=(
+                int(spec["top_k"]) if spec.get("top_k") is not None
+                else None
+            ),
+            seed=int(spec.get("seed", 0)),
+        )
+
 
 def write_prompt_file(path: str, tokens: Sequence[int]) -> None:
     """Inverse of parse_prompt_file — the client-side helper for

@@ -53,8 +53,11 @@ def _ulysses_local(
     q, k, v, *, axis_name: str, causal: bool, scale: float,
     use_flash: bool,
 ):
-    """Per-device body (inside shard_map). q/k/v: [B, T/sp, H, D]
-    (k/v already broadcast to full heads by the wrapper).
+    """Per-device body (inside shard_map). q: [B, T/sp, H, D]; k/v
+    arrive either at full heads (MQA-ish cases the wrapper
+    pre-broadcast) or at their NATIVE kv head count when it divides
+    sp — then the cheap local `rep` broadcast below runs AFTER the
+    collective, so grouped caches don't inflate communication.
 
     all_to_all with tiled=True splits `split_axis` across the axis
     and concatenates the received pieces on `concat_axis`:
@@ -117,11 +120,13 @@ def ulysses_attention(
     if kv_h != h:
         if h % kv_h:
             raise ValueError(f"q heads {h} not a multiple of kv heads {kv_h}")
-        if kv_h % sp:
-            # kv heads don't split across sp (e.g. MQA on sp=4): the
-            # broadcast must happen BEFORE the reshard, paying
-            # n_heads/kv_heads x KV comm — ring_attention avoids this
-            # entirely and is usually the better strategy here
+        if sp == 1 or kv_h % sp:
+            # broadcast to full heads up front when there is no
+            # reshard at all (sp == 1: the local kernel needs matched
+            # heads) or when kv heads don't split across sp (e.g. MQA
+            # on sp=4 — pays n_heads/kv_heads x KV comm;
+            # ring_attention avoids that and is usually the better
+            # strategy there)
             k = jnp.repeat(k, h // kv_h, axis=2)
             v = jnp.repeat(v, h // kv_h, axis=2)
         # else: kv rides the all_to_all at its NATIVE head count and

@@ -29,31 +29,29 @@ import numpy as np
 
 
 async def run(args) -> None:
-    import jax
-    import jax.numpy as jnp
-
     from dml_tpu.cluster.introducer import IntroducerService
     from dml_tpu.cluster.node import Node
     from dml_tpu.cluster.store_service import StoreService
     from dml_tpu.config import ClusterSpec, StoreConfig, Timing
-    from dml_tpu.inference.generate import LMConfig
     from dml_tpu.inference.lm_backend import LMBackend, write_prompt_file
     from dml_tpu.jobs.service import JobService
-    from dml_tpu.models.transformer import TransformerLM
 
-    cfg = LMConfig(
-        vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
-        n_layers=args.layers, d_ff=4 * args.d_model,
-        dtype=jnp.bfloat16 if args.bf16 else jnp.float32, n_kv_heads=2,
-    )
-    model = TransformerLM(
-        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
-        n_heads=cfg.n_heads, n_layers=cfg.n_layers, d_ff=cfg.d_ff,
-        dtype=cfg.dtype, n_kv_heads=cfg.n_kv_heads,
-    )
-    params = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
+    # the SAME spec dict the CLI's --lm-spec flag consumes — one
+    # source of truth for the deterministic build (LMBackend.from_spec)
+    lm_spec = {
+        "name": "LM",
+        "vocab_size": args.vocab,
+        "d_model": args.d_model,
+        "n_heads": 4,
+        "n_kv_heads": 2,
+        "n_layers": args.layers,
+        "d_ff": 4 * args.d_model,
+        "dtype": "bfloat16" if args.bf16 else "float32",
+        "max_new_tokens": args.new_tokens,
+        "max_slots": 4,
+        "max_len": args.max_len,
+        "seed": 0,
+    }
 
     tmp = tempfile.mkdtemp(prefix="dml_tpu_lm_cluster_")
     spec = ClusterSpec.localhost(
@@ -71,11 +69,10 @@ async def run(args) -> None:
         node = Node(spec, n)
         store = StoreService(node, root=os.path.join(tmp, f"st_{n.port}"))
         jobs = JobService(node, store)
-        be = LMBackend(
-            params, cfg, max_new_tokens=args.new_tokens,
-            max_slots=4, max_len=args.max_len,
+        be = LMBackend.from_spec(lm_spec)
+        jobs.register_lm(
+            lm_spec["name"], backend=be.backend, cost=be.cost()
         )
-        jobs.register_lm("LM", backend=be.backend, cost=be.cost())
         await node.start()
         await store.start()
         await jobs.start()
@@ -93,7 +90,7 @@ async def run(args) -> None:
         client_store, client_jobs = stack[-1][1], stack[-1][2]
         rng = np.random.RandomState(args.seed)
         for i in range(args.prompts):
-            prompt = rng.randint(0, cfg.vocab_size, rng.randint(4, 24))
+            prompt = rng.randint(0, lm_spec["vocab_size"], rng.randint(4, 24))
             p = os.path.join(tmp, f"prompt_{i}.tokens.txt")
             write_prompt_file(p, prompt)
             await client_store.put(p, f"prompt_{i}.tokens.txt")

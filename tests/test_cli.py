@@ -124,3 +124,75 @@ async def test_nodeapp_commands(tmp_path, capsys):
         for a in apps:
             await a.stop()
         await dns.stop()
+
+
+async def test_nodeapp_lm_spec_serving(tmp_path, capsys):
+    """The operator path for distributed LM serving: nodes boot with
+    an --lm-spec (deterministic weights from the seed, identical on
+    every node), prompts go in via `put`, and the standard
+    submit-job/get-output verbs drive the LM job end-to-end."""
+    from dml_tpu.cluster.introducer import IntroducerService
+    from dml_tpu.cluster.node import Node
+    from dml_tpu.cluster.store_service import StoreService
+    from dml_tpu.inference.lm_backend import write_prompt_file
+    from dml_tpu.jobs.service import JobService
+
+    lm_spec = {
+        "name": "CliLM", "vocab_size": 61, "d_model": 32,
+        "n_heads": 4, "n_kv_heads": 2, "n_layers": 2, "d_ff": 64,
+        "dtype": "float32", "max_new_tokens": 6, "max_slots": 2,
+        "max_len": 64, "chunk": 4, "seed": 3,
+    }
+    spec = ClusterSpec.localhost(
+        2, base_port=23151, introducer_port=23150, timing=FAST,
+        store=StoreConfig(root=str(tmp_path / "roots"),
+                          download_dir=str(tmp_path / "dl")),
+    )
+    dns = IntroducerService(spec)
+    await dns.start()
+    apps = []
+    try:
+        for n in spec.nodes:
+            app = NodeApp.__new__(NodeApp)
+            app.spec = spec
+            app.node = Node(spec, n)
+            app.store = StoreService(app.node, root=str(tmp_path / f"st_{n.port}"))
+            app.jobs = JobService(app.node, app.store)
+            app._lm_specs = [dict(lm_spec)]
+            await app.start()
+            apps.append(app)
+        for _ in range(100):
+            if all(a.node.joined and a.node.leader_unique for a in apps):
+                break
+            await asyncio.sleep(0.05)
+
+        out = capsys.readouterr().out
+        assert "registered LM serving model 'CliLM'" in out
+
+        app = apps[-1]
+        p = tmp_path / "p0.tokens.txt"
+        write_prompt_file(str(p), [3, 1, 4, 1, 5])
+        assert await app.handle(f"put {p} p0.tokens.txt")
+        # case-insensitive model resolution through the CLI verb
+        assert await app.handle("submit-job clilm 3")
+        out = capsys.readouterr().out
+        assert "DONE: 3 queries" in out
+        assert await app.handle("get-output 1")
+        out = capsys.readouterr().out
+        assert "ok 1 results" in out
+        # the merged output file holds the completion tokens
+        import json as _json
+
+        with open("final_1.json") as f:
+            merged = _json.load(f)
+        assert list(merged) == ["p0.tokens.txt"]
+        assert len(merged["p0.tokens.txt"]["tokens"]) == 6
+    finally:
+        import contextlib
+        import os as _os
+
+        with contextlib.suppress(FileNotFoundError):
+            _os.unlink("final_1.json")
+        for app in reversed(apps):
+            await app.stop()
+        await dns.stop()

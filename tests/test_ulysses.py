@@ -91,6 +91,16 @@ def test_degenerate_single_shard():
     out = np.asarray(ulysses_attention(q, k, v, mesh, causal=True))
     ref = np.asarray(reference_attention(q, k, v, causal=True))
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    # GQA on the degenerate mesh: kv_h % 1 == 0 must NOT skip the
+    # broadcast (r3 review: silently wrong on TPU, crash on CPU)
+    q8, _, _ = _qkv(h=8)
+    _, k2, v2 = _qkv(h=2, seed=1)
+    out = np.asarray(ulysses_attention(q8, k2, v2, mesh, causal=True))
+    ref = np.asarray(reference_attention(
+        q8, jnp.repeat(k2, 4, axis=2), jnp.repeat(v2, 4, axis=2),
+        causal=True,
+    ))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 
 def test_long_context_lm_ulysses_trains_and_generates():
