@@ -212,7 +212,7 @@ def _bench_dual_c4(engine, out):
 
 def _bench_cluster_serving(engine, out, *, model="ResNet50",
                            batch=32, big_batch=128, n_queries=512,
-                           base_port=28801):
+                           failure_model=None, base_port=28801):
     """BASELINE config 4's shape on available hardware: a real
     localhost cluster (UDP control plane + TCP data plane + SDFS
     replication) serving a batch=32 ResNet50 job with THE REAL ENGINE
@@ -334,7 +334,32 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
             # worker mid-job ABRUPTLY (transport closed, no goodbye:
             # the reference's crash case, worker.py:1279-1306) and
             # record completion, requeues, and detection latency.
-            await client_jobs.set_batch_size(model, batch)
+            # Config 5 names EfficientNet-B4 as the model under
+            # failure, exercising model switch + dynamic batching in
+            # the same pass (the engine keeps every model resident —
+            # switching costs nothing, unlike the reference's reload)
+            fmodel = failure_model or model
+            if fmodel != model:
+                # (re)load the failure model at this job's batch size
+                # (the sweep leaves it at b128; padding 32 -> 128 would
+                # quadruple each batch's upload through the tunnel).
+                # to_thread: a multi-second compile on the event loop
+                # would stall SWIM heartbeats past cleanup_time and
+                # make the live nodes falsely suspect each other
+                await asyncio.to_thread(
+                    engine.load_model, fmodel, batch_size=batch,
+                    warmup=True,
+                )
+            await client_jobs.set_batch_size(fmodel, batch)
+            # healthy baseline for THIS model (the b32 run above is a
+            # different model when failure_model is set — comparing
+            # against it would report model-speed delta as failure
+            # cost)
+            t0 = time.monotonic()
+            job_id = await client_jobs.submit_job(fmodel, n_q)
+            done = await client_jobs.wait_job(job_id, timeout=600.0)
+            healthy_f = time.monotonic() - t0
+            assert done["total_queries"] == n_q
             leader_jobs = leader[2]
             standby = leader[1].standby_node()
             client_node = stack[-1][0]
@@ -346,7 +371,7 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
             victim_name = victim[0].me.unique_name
             requeues_before = leader_jobs.scheduler.requeue_count
             t0 = time.monotonic()
-            job_id = await client_jobs.submit_job(model, n_q)
+            job_id = await client_jobs.submit_job(fmodel, n_q)
             # kill once the victim is actually running a batch
             for _ in range(500):
                 if victim_name in leader_jobs.scheduler.in_progress:
@@ -374,6 +399,7 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
             assert done["total_queries"] == n_q, "completion under failure"
             requeues = leader_jobs.scheduler.requeue_count - requeues_before
             out["cluster_serving_failure"] = {
+                "model": fmodel,
                 "queries": n_q,
                 "completed": done["total_queries"],
                 # False = the victim's work completed before the kill
@@ -387,7 +413,7 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
                 "requeues": requeues,
                 "wall_s": round(wall_f, 2),
                 "qps_end_to_end": round(n_q / wall_f, 1),
-                "healthy_wall_s": round(wall, 2),
+                "healthy_wall_s": round(healthy_f, 2),
                 "note": "worker killed abruptly mid-job (no leave msg); "
                         "100% completion via SWIM detect -> requeue-at-"
                         "front -> reschedule",
@@ -793,7 +819,7 @@ def main() -> None:
 
     _bench_models(engine, out)
     _bench_dual_c4(engine, out)
-    _bench_cluster_serving(engine, out)
+    _bench_cluster_serving(engine, out, failure_model="EfficientNetB4")
     _bench_pallas(out)
     _bench_lm(out, engine=engine)
 

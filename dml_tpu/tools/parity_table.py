@@ -154,7 +154,8 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
         fi_txt = ""
         if fi:
             fi_txt = (
-                f"; worker killed mid-job: "
+                f"; worker killed mid-job "
+                f"({fi.get('model', 'same model')}): "
                 f"{fi.get('completed', 'n/a')}/{fi.get('queries', 'n/a')} "
                 f"completed, detect→requeue "
                 f"{fi.get('detect_to_requeue_s', 'n/a')} s, wall "

@@ -65,11 +65,14 @@ async def run(args) -> None:
     dns = IntroducerService(spec)
     await dns.start()
     stack = []
+    # ONE backend shared by every in-process node (the serve lock
+    # serializes concurrent workers); N separate builds would hold N
+    # weight copies for no reason in a single-process example
+    be = LMBackend.from_spec(lm_spec)
     for n in spec.nodes:
         node = Node(spec, n)
         store = StoreService(node, root=os.path.join(tmp, f"st_{n.port}"))
         jobs = JobService(node, store)
-        be = LMBackend.from_spec(lm_spec)
         jobs.register_lm(
             lm_spec["name"], backend=be.backend, cost=be.cost()
         )
