@@ -15,12 +15,14 @@ the latest BENCH_r* artifact):
 - bf16-resident weights: ~1.8-2.2k tok/s (stable across captures)
 - int8 + dequant-at-use: ~2.3-4.5k tok/s (BIMODAL across captures)
 
-i.e. int8 never loses to bf16 on the current toolchain and often wins
-~2x (when XLA fuses the int8 read + dequant into the matvec the
-per-token HBM bill drops with the weight bytes), but the fusion is
-memory-state sensitive: with ~1 GB of CNN weights co-resident the
-same program measured ~1056 tok/s (the bench frees the chip first),
-and even clean-chip captures split between ~2.3k and ~4.5k. On an
+i.e. on a clean chip int8 has not lost to bf16 on the current
+toolchain and often wins ~2x (when XLA fuses the int8 read + dequant
+into the matvec the per-token HBM bill drops with the weight bytes) —
+but the fusion is memory-state sensitive and the claim does NOT hold
+unconditionally: with ~1 GB of CNN weights co-resident the same
+program measured ~1056 tok/s, below the bf16 range (the bench frees
+the chip first), and even clean-chip captures split between ~2.3k
+and ~4.5k. On an
 earlier toolchain the dequant materialized per scan step and int8
 LOST outright. The capacity side is deterministic: 1.33x less HBM
 than the bf16 tree end-to-end (the f32 embed dominates the

@@ -540,15 +540,39 @@ class JobService:
             return
         model = msg.data.get("model", "")
         n = int(msg.data.get("n", 0))
-        patterns = self.model_patterns.get(model, self.image_patterns)
-        files = sorted({
-            f for p in patterns for f in self.store.metadata.matching(p)
-        })
+        # case-insensitive like _canon: the submitting node may have
+        # registered a different casing than the leader
+        lm_hit = {k.lower(): k for k in self.model_patterns}.get(model.lower())
+        if lm_hit is not None:
+            model = lm_hit
+            patterns = self.model_patterns[lm_hit]
+            known = True
+        else:
+            # only registry CNNs may take the image-pattern default; an
+            # LM whose register_lm was skipped on the leader must fail
+            # fast here, not burn max_batch_failures on *.jpeg batches
+            patterns = self.image_patterns
+            try:
+                get_model(model)
+                known = True
+            except KeyError:
+                known = False
         error = None
-        if n <= 0:
+        if not known:
+            error = (
+                f"model {model!r} is neither a registry CNN nor "
+                "registered via register_lm on the leader; register it "
+                "on every node (including the leader) before submitting"
+            )
+        elif n <= 0:
             error = f"n_queries must be positive, got {n}"
-        elif not files:
-            error = f"no {'/'.join(patterns)} files in the store"
+        files: list = []
+        if error is None:
+            files = sorted({
+                f for p in patterns for f in self.store.metadata.matching(p)
+            })
+            if not files:
+                error = f"no {'/'.join(patterns)} files in the store"
         if error is not None:
             self.node.send_unique(
                 msg.sender,

@@ -93,7 +93,10 @@ def normalize_sharded(
         return fused_normalize(x, mode, dtype)
     from functools import partial
 
-    from jax import shard_map
+    try:  # jax >= 0.8 promotes shard_map out of experimental
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P("dp", *(None,) * (x.ndim - 1))

@@ -323,6 +323,13 @@ def sanity_check(bench: Dict[str, Any]) -> List[str]:
     rng("lm.kv_int8.int8_tok_per_s",
         kq.get("int8_cache_tok_per_s"), 50, 1e5)
     rng("lm.kv_int8.speedup", kq.get("speedup"), 0.05, 20)
+    # a numerically broken kernel must not publish its speedup rows:
+    # parity_pass=False is a hard refusal, not a table footnote
+    if pl and pl.get("parity_pass", True) is False:
+        bad.append(
+            "pallas_on_device.parity_pass = False (kernel output "
+            "diverged from the XLA oracle; timings are meaningless)"
+        )
     return bad
 
 

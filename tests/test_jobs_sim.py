@@ -13,6 +13,8 @@ import contextlib
 import json
 import os
 
+import pytest
+
 from dml_tpu.config import ClusterSpec, StoreConfig, Timing
 from dml_tpu.cluster.introducer import IntroducerService
 from dml_tpu.cluster.node import Node
@@ -180,6 +182,23 @@ async def test_submit_job_end_to_end(tmp_path):
         # C1 on the coordinator counted all 10 queries
         coord = sim.coordinator_jobs()
         assert coord.c1_stats()["ResNet50"]["total_queries"] == 10.0
+
+
+async def test_submit_unknown_to_leader_fails_fast(tmp_path):
+    """register_lm is per-node; if the leader never saw it, a submit
+    for that model must be rejected at intake — not silently fed
+    *.jpeg files until max_batch_failures burns the job."""
+    async with cluster(3, tmp_path, 22150) as sim:
+        await sim.wait_converged()
+        coord_u = sim.coordinator_jobs().node.me.unique_name
+        client_u = next(u for u in sim.jobs if u != coord_u)
+        await sim.seed_images(client_u, 2)
+        client = sim.jobs[client_u]
+        # registered on the client only — the leader has no backend,
+        # no patterns, and no registry entry for it
+        client.register_lm("GhostLM", patterns=("*.tokens.txt",))
+        with pytest.raises(RuntimeError, match="neither a registry CNN"):
+            await client.submit_job("GhostLM", 4)
 
 
 async def test_dual_model_jobs_complete(tmp_path):

@@ -82,3 +82,19 @@ def test_sanity_check_catches_degenerate_slope():
     assert any("flash_fwd_ms" in x for x in v)
     assert any("speedup" in x for x in v)
     assert pt.sanity_check({"matrix": {}}) == []
+
+
+def test_sanity_check_refuses_failed_parity():
+    """A kernel whose output diverged from the XLA oracle must be
+    refused outright — not published with a footnote on one row."""
+    bad = {"matrix": {"pallas_on_device": {
+        "flash_fwd_ms": 1.5, "flash_vs_naive_speedup": 5.0,
+        "parity_pass": False,
+    }}}
+    v = pt.sanity_check(bad)
+    assert any("parity_pass" in x for x in v)
+    ok = {"matrix": {"pallas_on_device": {
+        "flash_fwd_ms": 1.5, "flash_vs_naive_speedup": 5.0,
+        "parity_pass": True,
+    }}}
+    assert pt.sanity_check(ok) == []
