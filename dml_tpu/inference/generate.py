@@ -32,6 +32,7 @@ prefill so the dense-dispatch intermediate stays bounded).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -50,11 +51,13 @@ class LMConfig:
 
     `kv_quant=True` stores the KV cache as int8 with one f32 scale per
     (position, kv-head) — ~1.9x less cache HBM than bf16, i.e. ~2x the
-    contexts/slots per chip. On the current v5e toolchain it is a
-    CAPACITY feature only: XLA does not fuse the cache dequant into
-    the attention matvec, so decode measures ~0.66x bf16-cache (bench
-    `lm.kv_cache_int8_4k_ctx_b8`, re-measured every round — the same
-    fusion flipped across toolchains for int8 weights).
+    contexts/slots per chip, AND faster decode: the Pallas decode
+    kernel (ops/decode_attention.py) dequantizes inline while
+    streaming the int8 cache through VMEM, so the bandwidth saving is
+    real — ~1.2-1.4x bf16-cache decode at b8/4k on v5e (bench
+    `lm.kv_cache_int8_4k_ctx_b8`, re-measured every round; on the
+    XLA einsum path the dequant materializes in HBM and int8 LOSES
+    ~0.7x, which is why the kernel owns this config).
     Numerics: symmetric per-vector rounding on K and V (~0.4% each);
     greedy outputs can differ from the bf16-cache path on near-ties,
     so the serving stack treats kv_quant as a MODEL CONFIG, not a
@@ -88,14 +91,23 @@ class LMConfig:
 
 
 def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
-    """Pre-allocated KV cache: one [B, max_len, KV, D] pair per layer
+    """Pre-allocated KV cache: one [B, KV, max_len, D] pair per layer
     — KV = n_kv_heads under GQA, so the cache (and each decode step's
     HBM reads of it) shrinks n_heads/n_kv_heads-fold. Under
-    `cfg.kv_quant` each tensor is int8 plus a [B, max_len, KV, 1] f32
-    scale (symmetric per-(position, head) quantization)."""
-    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    `cfg.kv_quant` each tensor is int8 plus a [B, KV, max_len, 1] f32
+    scale (symmetric per-(position, head) quantization).
+
+    Layout is head-major ([B, KV, T, D], not [B, T, KV, D]): each
+    head's rows are a contiguous [T, D] plane, which is what the
+    Pallas decode kernel streams block-by-block (ops/
+    decode_attention.py — Mosaic wants the blocked axes last) and
+    makes every per-step cache write one contiguous D-row per head.
+    Scales live time-on-lanes ([B, KV, 1, max_len]) because the
+    kernel folds them into [G, T-block] score rows — storing them
+    that way saves a per-step transpose of every scale plane."""
+    shape = (batch, cfg.kv_heads, max_len, cfg.head_dim)
     if cfg.kv_quant:
-        sshape = (batch, max_len, cfg.kv_heads, 1)
+        sshape = (batch, cfg.kv_heads, 1, max_len)
         return {
             f"block_{i}": {
                 "k_q": jnp.zeros(shape, jnp.int8),
@@ -124,12 +136,12 @@ def _kv_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 
 def _kv_dequant(q: jax.Array, scale: jax.Array) -> jax.Array:
-    """int8 + scale -> f32 (the read side). Whether XLA fuses this
-    into the consuming attention contraction decides kv_quant's
-    throughput story — it does for int8 WEIGHTS on the current
-    toolchain but measurably not for the cache (bench
-    `lm.kv_cache_int8_4k_ctx_b8`: ~0.66x bf16-cache), so kv_quant is
-    a capacity feature until that flips."""
+    """int8 + scale -> f32 — the EINSUM-path read side only (CPU/test
+    mesh, or DML_TPU_DECODE_KERNEL=0). XLA materializes this dequant
+    in HBM before the attention contraction, which is exactly why the
+    TPU path hands int8 caches to the Pallas kernel instead (inline
+    dequant in VMEM; see the dispatch policy in
+    batched_decode_step)."""
     return q.astype(jnp.float32) * scale
 
 
@@ -272,44 +284,95 @@ def batched_decode_step(
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)[:, None, :]
     positions = pos[:, None]  # [B, 1] — rope's per-example form
     # layout-generic (bf16 {k, v} or kv_quant {k_q, ...}): every leaf
-    # carries [B, max_len, ...]
-    max_len = next(iter(next(iter(cache.values())).values())).shape[1]
+    # carries [B, KV, max_len, ...]
+    max_len = next(iter(next(iter(cache.values())).values())).shape[2]
     # per-slot validity: slot b sees cache positions <= pos[b]
     valid = jnp.arange(max_len)[None, :] <= pos[:, None]  # [B, T]
+    # the Pallas cache-attention kernel replaces the einsum on TPU
+    # where it measured faster (v5e, r4 dispersion A/B, median of 5
+    # paired slopes): int8 caches (6662 vs 4482 tok/s b8/4k — the
+    # einsum path materializes the dequantized cache in HBM first),
+    # MHA (1057 vs 790 b1/4k — the full-width cache is the most
+    # bandwidth-bound) and MQA (1950 vs 1792). Grouped bf16 caches
+    # (1 < KV < H) stay on the einsum: XLA's batched-matmul schedule
+    # held 5676 vs 4912 at b8/4k. DML_TPU_DECODE_KERNEL=0/1 forces
+    # the path — the A/B lever the bench uses to re-verify the policy
+    # every round.
+    force = os.environ.get("DML_TPU_DECODE_KERNEL")
+    use_kernel = jax.default_backend() == "tpu" and (
+        force == "1"
+        or (
+            force != "0"
+            and (
+                cfg.kv_quant
+                or cfg.kv_heads == 1
+                or cfg.kv_heads == cfg.n_heads
+            )
+        )
+    )
 
     new_cache: Dict[str, Any] = {}
     for i in range(cfg.n_layers):
         name = f"block_{i}"
 
         def attn_fn(q, k, v, name=name):
-            upd = jax.vmap(
-                lambda c, u, p: jax.lax.dynamic_update_slice_in_dim(
-                    c, u, p, axis=0
-                )
-            )
+            # k/v arrive [B, 1, KV, D]; the cache is head-major.
+            # Per-slot writes are an UNROLLED chain of
+            # dynamic_update_slice — a vmap over per-slot positions
+            # lowers to a scatter, and XLA scatters on TPU copy the
+            # whole operand (measured: the copy tripled decode's
+            # cache traffic)
+            def upd(c, u, axis):
+                for bi in range(b):
+                    start = [bi] + [0] * (c.ndim - 1)
+                    start[axis] = pos[bi]
+                    c = jax.lax.dynamic_update_slice(
+                        c, u[bi : bi + 1], start
+                    )
+                return c
+
+            kh = jnp.swapaxes(k, 1, 2)  # [B, KV, 1, D]
+            vh = jnp.swapaxes(v, 1, 2)
             if cfg.kv_quant:
-                kq, ks = _kv_quantize(k)
-                vq, vs = _kv_quantize(v)
+                kq, ks = _kv_quantize(kh)
+                vq, vs = _kv_quantize(vh)
                 lay = {
-                    "k_q": upd(cache[name]["k_q"], kq, pos),
-                    "k_s": upd(cache[name]["k_s"], ks, pos),
-                    "v_q": upd(cache[name]["v_q"], vq, pos),
-                    "v_s": upd(cache[name]["v_s"], vs, pos),
+                    "k_q": upd(cache[name]["k_q"], kq, axis=2),
+                    "k_s": upd(cache[name]["k_s"],
+                               jnp.swapaxes(ks, 2, 3), axis=3),
+                    "v_q": upd(cache[name]["v_q"], vq, axis=2),
+                    "v_s": upd(cache[name]["v_s"],
+                               jnp.swapaxes(vs, 2, 3), axis=3),
                 }
                 new_cache[name] = lay
-                ck = _kv_dequant(lay["k_q"], lay["k_s"])
-                cv = _kv_dequant(lay["v_q"], lay["v_s"])
+                if use_kernel:
+                    from ..ops.decode_attention import decode_attention
+
+                    return decode_attention(
+                        q, lay["k_q"], lay["v_q"], pos,
+                        k_scale=lay["k_s"], v_scale=lay["v_s"],
+                    )
+                ck = _kv_dequant(
+                    lay["k_q"], jnp.swapaxes(lay["k_s"], 2, 3)
+                )
+                cv = _kv_dequant(
+                    lay["v_q"], jnp.swapaxes(lay["v_s"], 2, 3)
+                )
             else:
-                ck = upd(cache[name]["k"], k.astype(cfg.dtype), pos)
-                cv = upd(cache[name]["v"], v.astype(cfg.dtype), pos)
+                ck = upd(cache[name]["k"], kh.astype(cfg.dtype), axis=2)
+                cv = upd(cache[name]["v"], vh.astype(cfg.dtype), axis=2)
                 new_cache[name] = {"k": ck, "v": cv}
+                if use_kernel:
+                    from ..ops.decode_attention import decode_attention
+
+                    return decode_attention(q, ck, cv, pos)
             qg = q.astype(jnp.float32).reshape(b, 1, cfg.kv_heads, grp, hd)
             s = jnp.einsum(
-                "bqkgd,btkd->bkgqt", qg, ck.astype(jnp.float32)
+                "bqkgd,bktd->bkgqt", qg, ck.astype(jnp.float32)
             ) * (hd**-0.5)
             s = jnp.where(valid[:, None, None, None, :], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            attn = jnp.einsum("bkgqt,btkd->bqkgd", p, cv.astype(jnp.float32))
+            attn = jnp.einsum("bkgqt,bktd->bqkgd", p, cv.astype(jnp.float32))
             return attn.reshape(b, 1, cfg.n_heads, hd)
 
         x, _, _ = _apply_block(params[name], cfg, x, positions, attn_fn)
@@ -358,22 +421,27 @@ def prefill(
         return flash_attention(q, k, v, causal=True)
 
     cache: Dict[str, Any] = {}
-    pad4 = ((0, 0), (0, pad), (0, 0), (0, 0))
+    pad4 = ((0, 0), (0, 0), (0, pad), (0, 0))  # head-major: pad T axis 2
     for i in range(cfg.n_layers):
         x, k, v = _apply_block(
             params[f"block_{i}"], cfg, x, positions, attn_fn
         )
+        kh = jnp.swapaxes(k, 1, 2)  # [B, KV, Tp, D] — cache layout
+        vh = jnp.swapaxes(v, 1, 2)
         if cfg.kv_quant:
-            kq, ks = _kv_quantize(k)
-            vq, vs = _kv_quantize(v)
+            kq, ks = _kv_quantize(kh)
+            vq, vs = _kv_quantize(vh)
+            padT = ((0, 0), (0, 0), (0, 0), (0, pad))  # scales: T on lanes
             cache[f"block_{i}"] = {
-                "k_q": jnp.pad(kq, pad4), "k_s": jnp.pad(ks, pad4),
-                "v_q": jnp.pad(vq, pad4), "v_s": jnp.pad(vs, pad4),
+                "k_q": jnp.pad(kq, pad4),
+                "k_s": jnp.pad(jnp.swapaxes(ks, 2, 3), padT),
+                "v_q": jnp.pad(vq, pad4),
+                "v_s": jnp.pad(jnp.swapaxes(vs, 2, 3), padT),
             }
         else:
             cache[f"block_{i}"] = {
-                "k": jnp.pad(k.astype(cfg.dtype), pad4),
-                "v": jnp.pad(v.astype(cfg.dtype), pad4),
+                "k": jnp.pad(kh.astype(cfg.dtype), pad4),
+                "v": jnp.pad(vh.astype(cfg.dtype), pad4),
             }
 
     if logits_index is None:

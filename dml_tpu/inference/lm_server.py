@@ -6,7 +6,9 @@ serving workload has requests of different prompt lengths and budgets
 arriving while others are mid-decode. This server keeps `max_slots`
 sequences decoding together in ONE compiled program:
 
-- a fixed slot grid: per-layer KV cache [slots, max_len, KV, D] plus
+- a fixed slot grid: per-layer KV cache [slots, KV, max_len, D]
+  (head-major — init_cache's layout, which the Pallas decode kernel
+  streams; this module only ever indexes the slot axis 0) plus
   per-slot position/current-token vectors — static shapes, so one
   compilation serves every mix of requests;
 - `submit()` prefills the new request's prompt in one flash-attention
@@ -35,9 +37,12 @@ from `generate`'s split-chain, which is shape-coupled by design.
 
 Measured on v5e (12-layer 1024d GQA-4 LM, bf16, 1k cache;
 re-captured every bench run — `lm.continuous_batching` in the latest
-BENCH_r* artifact): 1 slot decodes at ~1.9-2k tok/s, 8 slots at
-~7-7.2k tok/s aggregate — ~3.5-3.9x, because the weight stream (the
-per-step HBM bill) is shared by every slot.
+BENCH_r* artifact): 1 slot decodes at ~2.1-2.4k tok/s, 8 slots at
+~9-9.7k tok/s aggregate — ~4.4-4.6x, because the weight stream (the
+per-step HBM bill) is shared by every slot and the per-slot cache
+writes are an unrolled dynamic_update_slice chain (a vmap'd update
+lowers to an XLA scatter that copies the whole cache; fixing that
+took 8 slots from 1.32 to 0.83 ms/step, r4).
 Caveat for remoted chips: the server makes several dispatches per
 request (prefill, insert, chunks); through a high-latency tunnel the
 round trips dominate and a single fused `generate` call can win —

@@ -1,0 +1,128 @@
+"""Pallas decode-step cache attention (ops/decode_attention.py):
+parity with the einsum path it replaces on TPU, both cache forms,
+per-slot validity. Runs the Mosaic interpreter on the CPU test mesh
+(same `interpret` convention as the flash kernel tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dml_tpu.ops.decode_attention import decode_attention
+
+
+def oracle(q, ck, cv, pos):
+    b, _, h, d = q.shape
+    kv, t = ck.shape[1], ck.shape[2]
+    grp = h // kv
+    valid = jnp.arange(t)[None, :] <= pos[:, None]
+    qg = q.astype(jnp.float32).reshape(b, 1, kv, grp, d)
+    s = jnp.einsum(
+        "bqkgd,bktd->bkgqt", qg, ck.astype(jnp.float32)
+    ) * (d ** -0.5)
+    s = jnp.where(valid[:, None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgqt,bktd->bqkgd", p, cv.astype(jnp.float32))
+    return o.reshape(b, 1, h, d)
+
+
+def quantize(x):
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@pytest.mark.parametrize("kv,h", [(2, 4), (1, 4), (4, 4)])
+def test_parity_bf16(kv, h):
+    """GQA / MQA / MHA head layouts against the einsum oracle."""
+    b, t, d = 2, 40, 8
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.float32)
+    ck = jax.random.normal(ks[1], (b, kv, t, d), jnp.float32)
+    cv = jax.random.normal(ks[2], (b, kv, t, d), jnp.float32)
+    pos = jnp.asarray([t - 1, 7], jnp.int32)
+    got = decode_attention(q, ck, cv, pos)
+    want = oracle(q, ck, cv, pos)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5
+    )
+
+
+def test_parity_int8_inline_dequant():
+    b, kv, t, h, d = 2, 2, 64, 4, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.float32)
+    ck = jax.random.normal(ks[1], (b, kv, t, d), jnp.float32)
+    cv = jax.random.normal(ks[2], (b, kv, t, d), jnp.float32)
+    pos = jnp.asarray([t - 2, 11], jnp.int32)
+    ckq, cks = quantize(ck)
+    cvq, cvs = quantize(cv)
+    got = decode_attention(
+        q, ckq, cvq, pos,
+        k_scale=jnp.swapaxes(cks, 2, 3),
+        v_scale=jnp.swapaxes(cvs, 2, 3),
+    )
+    want = oracle(
+        q, ckq.astype(jnp.float32) * cks,
+        cvq.astype(jnp.float32) * cvs, pos,
+    )
+    # int8 path folds scales into score rows and dots via bf16 —
+    # tolerance covers the summation-order difference, which is far
+    # below the ~0.4% the quantization itself costs
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=5e-3
+    )
+
+
+def test_per_slot_positions_mask_stale_cache():
+    """Cache rows past a slot's pos must be invisible: garbage there
+    cannot change the output (the continuous-batching contract —
+    slots at different positions share one program)."""
+    b, kv, t, h, d = 2, 2, 32, 4, 8
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.float32)
+    ck = jax.random.normal(ks[1], (b, kv, t, d), jnp.float32)
+    cv = jax.random.normal(ks[2], (b, kv, t, d), jnp.float32)
+    pos = jnp.asarray([5, 20], jnp.int32)
+    base = decode_attention(q, ck, cv, pos)
+    poisoned_k = ck.at[0, :, 6:].set(1e4).at[1, :, 21:].set(-1e4)
+    poisoned_v = cv.at[0, :, 6:].set(7e3).at[1, :, 21:].set(-7e3)
+    got = decode_attention(q, poisoned_k, poisoned_v, pos)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(base), atol=1e-6
+    )
+
+
+def test_blocked_path_matches_single_block():
+    """T spanning multiple k-blocks (online softmax across blocks)
+    must equal the one-block result."""
+    b, kv, t, h, d = 1, 2, 96, 4, 8
+    ks = jax.random.split(jax.random.PRNGKey(3), 4)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.float32)
+    ck = jax.random.normal(ks[1], (b, kv, t, d), jnp.float32)
+    cv = jax.random.normal(ks[2], (b, kv, t, d), jnp.float32)
+    pos = jnp.asarray([t - 1], jnp.int32)
+    one = decode_attention(q, ck, cv, pos, block_k=128)
+    many = decode_attention(q, ck, cv, pos, block_k=32)
+    np.testing.assert_allclose(
+        np.asarray(many), np.asarray(one), atol=2e-5
+    )
+
+
+def test_validation_errors():
+    q = jnp.zeros((2, 1, 4, 8))
+    ck = jnp.zeros((2, 3, 16, 8))  # 4 heads % 3 kv != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        decode_attention(q, ck, ck, jnp.zeros(2, jnp.int32))
+    with pytest.raises(ValueError, match="B,1,H,D"):
+        decode_attention(
+            jnp.zeros((2, 2, 4, 8)), ck, ck, jnp.zeros(2, jnp.int32)
+        )
+    ok = jnp.zeros((2, 2, 16, 8))
+    with pytest.raises(ValueError, match="both k_scale"):
+        decode_attention(
+            q, ok, ok, jnp.zeros(2, jnp.int32),
+            k_scale=jnp.zeros((2, 2, 1, 16)),
+        )

@@ -62,8 +62,8 @@ def test_prefill_matches_full_forward_and_decode_cache(lm):
     for blk in cache:
         for kv in ("k", "v"):
             np.testing.assert_allclose(
-                np.asarray(cache[blk][kv][:, :8]),
-                np.asarray(ref_cache[blk][kv][:, :8]),
+                np.asarray(cache[blk][kv][:, :, :8]),
+                np.asarray(ref_cache[blk][kv][:, :, :8]),
                 atol=2e-4, err_msg=f"{blk}.{kv}",
             )
 
@@ -156,7 +156,7 @@ def test_gqa_decode_and_prefill_match_full_forward(n_kv):
     full = np.asarray(model.apply({"params": params}, tokens))
 
     cache = init_cache(cfg, 2, 10)
-    assert cache["block_0"]["k"].shape == (2, 10, n_kv, cfg.head_dim)
+    assert cache["block_0"]["k"].shape == (2, n_kv, 10, cfg.head_dim)
     for t in range(8):
         logits, cache = decode_step(
             params, cfg, cache, tokens[:, t], jnp.int32(t)
@@ -170,8 +170,8 @@ def test_gqa_decode_and_prefill_match_full_forward(n_kv):
     np.testing.assert_allclose(np.asarray(plogits), full[:, -1], atol=2e-4)
     for blk in pcache:
         np.testing.assert_allclose(
-            np.asarray(pcache[blk]["k"][:, :8]),
-            np.asarray(cache[blk]["k"][:, :8]), atol=2e-4,
+            np.asarray(pcache[blk]["k"][:, :, :8]),
+            np.asarray(cache[blk]["k"][:, :, :8]), atol=2e-4,
         )
 
 

@@ -83,7 +83,7 @@ def test_quantized_prefill_close_to_float(moe):
     corr = float(np.corrcoef(a, b)[0, 1])
     assert corr > 0.999, corr
     # cache shapes identical (decode continues transparently)
-    assert cache_q["block_0"]["k"].shape == (2, 16, CFG.n_heads, CFG.head_dim)
+    assert cache_q["block_0"]["k"].shape == (2, CFG.n_heads, 16, CFG.head_dim)
 
 
 def test_moe_scales_are_per_expert():
