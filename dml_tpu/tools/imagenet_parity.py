@@ -8,11 +8,15 @@ top-5 [wnid, label, score] lists over testfiles_more/ JPEGs).
 
 This tool closes the loop on real weights:
 
-1. *Acquire* imagenet weights — from the Keras cache, from a directory
-   given via ``DML_TPU_KERAS_WEIGHTS_DIR``, or by letting Keras
-   download them when the environment has egress. Hermetic sandboxes
-   have none of these; the tool then reports ``skipped`` with the
-   reason rather than failing (the bench embeds that verbatim).
+1. *Acquire* imagenet weights — from a single-file pre-converted
+   ``.npz`` fixture (``params_io.save_npz_fixture``: tree + embedded
+   class index — ONE file dropped anywhere in the search set runs the
+   whole report), from a stock Keras ``.h5`` in the Keras cache or a
+   directory given via ``DML_TPU_KERAS_WEIGHTS_DIR`` (read TF-free
+   with h5py), or by letting Keras download when the environment has
+   egress. Hermetic sandboxes have none of these; the tool then
+   reports ``skipped`` with the reason rather than failing (the bench
+   embeds that verbatim).
 2. *Convert* them into the Flax trees with
    `models.params_io.from_keras_model` (the converter whose
    architecture-level correctness is already pinned by
@@ -73,6 +77,25 @@ def candidate_weight_paths(model: str) -> List[str]:
 def weight_sources(model: str) -> List[str]:
     """Candidate .h5 paths for `model`, existing ones only."""
     return [p for p in candidate_weight_paths(model) if os.path.exists(p)]
+
+
+def candidate_npz_paths(model: str) -> List[str]:
+    """Every path probed for a pre-converted single-file fixture
+    (params_io.save_npz_fixture: converted tree + embedded class
+    index) — the ONE-file drop-in that runs the report in hermetic
+    environments (VERDICT r3 item 9)."""
+    fname = f"dml_tpu_{model}.npz"
+    out = []
+    env_dir = os.environ.get("DML_TPU_KERAS_WEIGHTS_DIR")
+    if env_dir:
+        out.append(os.path.join(env_dir, fname))
+    out.append(os.path.join(_keras_cache_dir(), fname))
+    out.append(os.path.expanduser(f"~/.dml_tpu/{fname}"))
+    return out
+
+
+def npz_sources(model: str) -> List[str]:
+    return [p for p in candidate_npz_paths(model) if os.path.exists(p)]
 
 
 def _try_build_keras(model: str):
@@ -232,14 +255,27 @@ def run_parity(
             "reason": f"golden images not found: {missing[:5]}",
         }
 
-    # acquire weights per model: a local .h5 is read DIRECTLY with
-    # h5py (no TensorFlow anywhere in that path); the TF builder is
-    # only the last-resort downloader for egress-ful environments
+    # acquire weights per model, in preference order: (1) a
+    # pre-converted single-file .npz fixture (tree + embedded class
+    # index — one file, zero deps); (2) a stock Keras .h5 read
+    # DIRECTLY with h5py (no TensorFlow anywhere in that path);
+    # (3) the TF builder as last-resort downloader for egress-ful
+    # environments
     kmodels: Dict[str, Any] = {}
     trees: Dict[str, Any] = {}
+    embedded_class_index: Optional[str] = None
     for m in models:
         spec = get_model(m)
         variables = init_variables(spec, dtype=engine.dtype)
+        npz = npz_sources(m)
+        if npz:
+            from ..models.params_io import load_npz_fixture
+
+            trees[m], cij = load_npz_fixture(npz[0], variables)
+            if cij:
+                embedded_class_index = cij
+            report["models"][m] = {"weights": f"npz fixture: {npz[0]}"}
+            continue
         local = weight_sources(m)
         if local:
             trees[m] = from_keras_h5(local[0], variables)
@@ -250,9 +286,10 @@ def run_parity(
             return {
                 "skipped": True,
                 "reason": (
-                    f"{m}: no local .h5 at any of "
-                    f"{candidate_weight_paths(m)} "
-                    f"(drop the stock Keras file there, or set "
+                    f"{m}: no fixture .npz at any of "
+                    f"{candidate_npz_paths(m)} and no local .h5 at any "
+                    f"of {candidate_weight_paths(m)} "
+                    f"(drop either file there, or set "
                     f"DML_TPU_KERAS_WEIGHTS_DIR); TF download fallback "
                     f"also failed: {reason}"
                 ),
@@ -267,6 +304,19 @@ def run_parity(
     # would read 0% — indistinguishable from a broken converter. Skip
     # with the exact drop-in paths instead of reporting that lie.
     class_index_path = _ensure_class_index()
+    tmp_class_index: Optional[str] = None
+    if class_index_path is None and embedded_class_index is not None:
+        # the npz fixture carries the class index; materialize it so
+        # the engine's label table (path-based) can read it (deleted
+        # in the finally below — the pinned global is reset with it)
+        import tempfile
+
+        fd, class_index_path = tempfile.mkstemp(
+            suffix="_imagenet_class_index.json"
+        )
+        with os.fdopen(fd, "w") as f:
+            f.write(embedded_class_index)
+        tmp_class_index = class_index_path
     if class_index_path is None:
         return {
             "skipped": True,
@@ -274,7 +324,8 @@ def run_parity(
                 "imagenet_class_index.json not found at any of "
                 f"{candidate_class_index_paths()} and the TF download "
                 "fallback failed — drop the stock file (the one Keras "
-                "caches) next to the weights or in ~/.keras/models"
+                "caches) next to the weights or in ~/.keras/models, or "
+                "use an .npz fixture with the class index embedded"
             ),
         }
     # make the engine's label table read the file we just located even
@@ -282,6 +333,34 @@ def run_parity(
     from ..models.labels import set_class_index_path
 
     set_class_index_path(class_index_path)
+    try:
+        return _validate_models(
+            models, engine, trees, kmodels, paths, images, goldens,
+            report, class_index_path,
+        )
+    finally:
+        if tmp_class_index is not None:
+            # fixture-materialized index: unpin the process-global
+            # label path and remove the temp file (all label reads
+            # happened during inference above)
+            set_class_index_path(None)
+            try:
+                os.unlink(tmp_class_index)
+            except OSError:
+                pass
+
+
+def _validate_models(
+    models, engine, trees, kmodels, paths, images, goldens, report,
+    class_index_path,
+):
+    """Serve every model on the goldens' images and score agreement
+    (run_parity's validation half, split out so the fixture temp-file
+    cleanup wraps it)."""
+    import numpy as np
+
+    from ..models import get_model
+    from ..models.preprocess import load_images
 
     ours: Dict[str, Dict[str, List[str]]] = {}
     for m in models:

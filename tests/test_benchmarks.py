@@ -1,0 +1,50 @@
+"""Slope-timing helpers (dml_tpu/benchmarks.py): dispersion stats and
+the degenerate-rep guard (a jitter-swallowed rep must be counted, not
+clamped into the published min)."""
+
+import numpy as np
+
+from dml_tpu import benchmarks as bm
+
+
+def _fake_runner(times):
+    """A callable whose wall time is scripted: pops from `times`."""
+    import time as _t
+
+    it = iter(times)
+
+    def fn(*args):
+        _t.sleep(next(it))
+        return np.float32(0)
+
+    return fn
+
+
+def test_paired_slopes_stats():
+    # c1 sleeps ~0, c2 sleeps 20ms -> slope ~= 20ms/10 iters = 2ms
+    c1 = _fake_runner([0.0] * 4)
+    c2 = _fake_runner([0.02] * 4)
+    st = bm._paired_slopes(c1, c2, (), 10, 20, 3)
+    assert st["reps"] == 3
+    assert "degenerate_reps" not in st
+    assert 1e-3 < st["median"] < 4e-3
+    assert st["min"] <= st["median"] <= st["max"]
+
+
+def test_paired_slopes_degenerate_rep_excluded():
+    # one rep has t2 < t1 (negative slope): it must be excluded from
+    # min/max and counted, not published as min=1e-9 (an absurd qps
+    # range upper bound — r4 review finding)
+    c1 = _fake_runner([0.0, 0.03, 0.0])  # warmup + 2 reps
+    c2 = _fake_runner([0.0, 0.02, 0.02])
+    st = bm._paired_slopes(c1, c2, (), 10, 20, 2)
+    assert st["degenerate_reps"] == 1
+    assert st["min"] > 1e-4  # the valid rep, not the clamp
+
+
+def test_paired_slopes_all_degenerate():
+    c1 = _fake_runner([0.0, 0.03, 0.03])
+    c2 = _fake_runner([0.0, 0.0, 0.0])
+    st = bm._paired_slopes(c1, c2, (), 10, 20, 2)
+    assert st["degenerate_reps"] == 2
+    assert st["median"] == 1e-9  # sentinel; sanity screens catch it

@@ -1,0 +1,48 @@
+"""The ring-vs-ulysses collective-footprint tool
+(dml_tpu/tools/ring_vs_ulysses.py): HLO parsing and the analysis
+contract on the 8-device CPU mesh."""
+
+import json
+
+from dml_tpu.tools import ring_vs_ulysses as rvu
+
+
+def test_line_bytes_parses_hlo_shapes():
+    line = ("  %all-to-all.5 = bf16[2,512,8,64]{3,2,1,0} "
+            "all-to-all(bf16[2,512,8,64]{3,2,1,0} %p), dimensions={1}")
+    assert rvu._line_bytes(line) == 2 * 512 * 8 * 64 * 2
+    tup = ("  %cp = (f32[4,4]{1,0}, f32[4,4]{1,0}) "
+           "collective-permute(%a, %b)")
+    assert rvu._line_bytes(tup) == 2 * 16 * 4
+
+
+def test_collective_footprint_counts():
+    hlo = "\n".join([
+        "%a = bf16[8,8]{1,0} all-to-all(bf16[8,8] %x), dims={0}",
+        "%b = bf16[8,8]{1,0} all-to-all(bf16[8,8] %y), dims={0}",
+        "%c = f32[4]{0} all-reduce(f32[4] %z), to_apply=%add",
+        "%d = f32[4]{0} add(f32[4] %z, f32[4] %z)",  # not a collective
+    ])
+    fp = rvu.collective_footprint(hlo)
+    assert fp["ops"]["all-to-all"]["count"] == 2
+    assert fp["ops"]["all-reduce"]["count"] == 1
+    assert fp["total_count"] == 3
+
+
+def test_analysis_small_point():
+    """Compile both strategies at a small point on the CPU mesh: the
+    ulysses footprint must be the 4 one-shot all_to_alls, ring's must
+    sit inside the (sp-1)-round loop, and the impossible-heads case
+    must be recorded as such (the rule-of-thumb boundary)."""
+    p = rvu.analyze_point(T=256, heads=4, sp=4, head_dim=16, batch=2)
+    assert p["ring"]["dynamic_rounds"] == 3
+    assert p["ring"]["hlo_static"]["ops"].get("collective-permute")
+    u = p["ulysses"]["hlo_static"]["ops"]["all-to-all"]
+    assert u["count"] == 4
+    assert (p["ulysses"]["dynamic_total_mb"]
+            < p["ring"]["dynamic_total_mb"])
+
+    imp = rvu.analyze_point(T=256, heads=2, sp=4, head_dim=16, batch=2)
+    assert "skipped" in imp["ulysses"]
+    assert imp["winner_by_bytes"].startswith("ring")
+    assert json.dumps(p) and json.dumps(imp)  # bench embeds verbatim
