@@ -99,6 +99,10 @@ class MsgType(enum.IntEnum):
     # retired with an error; the shadow must drop it too or a failover
     # resurrects work the client was already told failed
     JOB_FAILED_RELAY = 76
+    # coordinator -> worker: revoke a STAGED (pipeline) batch that was
+    # pulled back into the queue when a second model's work arrived —
+    # the fair split must see it as schedulable, not pinned to a worker
+    WORKER_STAGE_CANCEL = 77
 
 
 @dataclass(frozen=True)

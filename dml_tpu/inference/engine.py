@@ -28,6 +28,7 @@ worker.py:57-89; we measure on the real device at warmup).
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -92,6 +93,7 @@ class InferenceEngine:
         # models evicted while serving EXPLICIT weights: a later lazy
         # load must not silently fall back to random init
         self._evicted_explicit: set = set()
+        self._reshape_lock = threading.Lock()
 
     # ---- loading ----
 
@@ -222,10 +224,17 @@ class InferenceEngine:
 
     def set_batch_size(self, name: str, batch_size: int) -> None:
         """C3 verb (reference SET_BATCH_SIZE, worker.py:1028-1037).
-        Triggers one recompile at the new shape on next use."""
-        lm = self._require(name)
-        lm.batch_size = batch_size
-        self._warmup(lm)
+        Triggers one recompile at the new shape on next use. No-op at
+        the current size; the lock makes that check-and-warmup atomic
+        (co-located services sharing one engine all fan the same C3 to
+        it within milliseconds — unserialized, every one of them would
+        pass the == check and run its own multi-minute warmup)."""
+        with self._reshape_lock:
+            lm = self._require(name)
+            if lm.batch_size == batch_size:
+                return
+            lm.batch_size = batch_size
+            self._warmup(lm)
 
     def cost_constants(self, name: str) -> Dict[str, float]:
         lm = self._require(name)
@@ -244,12 +253,18 @@ class InferenceEngine:
 
     # ---- serving ----
 
-    def _dispatch_chunk(self, lm: _LoadedModel, chunk: np.ndarray):
-        """Pad one <=batch_size slice to the compiled shape and enqueue
-        its forward (async dispatch — nothing blocks here). Returns
+    def _dispatch_chunk(self, lm: _LoadedModel, chunk: np.ndarray,
+                        bs: Optional[int] = None):
+        """Pad one <=bs slice to the compiled shape and enqueue its
+        forward (async dispatch — nothing blocks here). Returns
         (device probs, valid count). THE one pad/dispatch site shared
-        by the sync and nowait paths."""
-        bs = lm.batch_size
+        by the sync and nowait paths. Callers slicing a whole input at
+        a snapshot of lm.batch_size MUST pass that snapshot: a
+        concurrent C3 reshape (set_batch_size runs in a service
+        background thread) shrinking lm.batch_size mid-drain would
+        otherwise make pad negative on the already-sliced chunks."""
+        if bs is None:
+            bs = lm.batch_size
         pad = bs - chunk.shape[0]
         if pad:
             chunk = np.concatenate(
@@ -278,7 +293,7 @@ class InferenceEngine:
         out: List[np.ndarray] = []
         for start in range(0, n, bs):
             pending.append(
-                self._dispatch_chunk(lm, images_u8[start : start + bs])
+                self._dispatch_chunk(lm, images_u8[start : start + bs], bs)
             )
             if len(pending) >= window:
                 probs, valid = pending.pop(0)
@@ -314,7 +329,7 @@ class InferenceEngine:
         window = 4
         starts = list(range(0, n, bs))
         pending = [
-            self._dispatch_chunk(lm, images_u8[s : s + bs])
+            self._dispatch_chunk(lm, images_u8[s : s + bs], bs)
             for s in starts[:window]
         ]
         remaining = starts[window:]
@@ -335,7 +350,7 @@ class InferenceEngine:
                 if nxt < len(remaining):
                     s = remaining[nxt]
                     pending.append(
-                        self._dispatch_chunk(lm, src[0][s : s + bs])
+                        self._dispatch_chunk(lm, src[0][s : s + bs], bs)
                     )
                     nxt += 1
             cached.append(np.concatenate(out)[:n])

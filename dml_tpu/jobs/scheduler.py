@@ -93,6 +93,10 @@ class Assignment:
     worker: str
     batch: Batch
     preempted: Optional[Batch] = None
+    # staged=True: a PIPELINE assignment — the worker should fetch and
+    # decode this batch now but dispatch it only after its current
+    # batch's inference completes (depth-2 worker pipelining)
+    staged: bool = False
 
 
 class Scheduler:
@@ -108,6 +112,19 @@ class Scheduler:
         self.now = now
         self.queues: Dict[str, Deque[Batch]] = {}
         self.in_progress: Dict[str, Batch] = {}  # worker -> batch
+        # Worker pipelining (depth 2): with pipeline_depth > 1 the
+        # single-model scheduler STAGES one extra batch per busy worker
+        # so the worker overlaps batch N+1's store-fetch + host JPEG
+        # decode + device dispatch with batch N's in-flight inference.
+        # Default 1 preserves the reference's one-outstanding-batch-
+        # per-worker rule (workers_tasks_dict, worker.py:54) exactly;
+        # the service turns it up for serving. Dual-model rounds never
+        # stage (fair-share preemption and staging interact badly:
+        # a staged batch would instantly widen the preempting model's
+        # footprint beyond its computed share).
+        self.pipeline_depth = 1
+        self.prefetch: Dict[str, Batch] = {}  # worker -> staged batch
+        self._revoked_stages: List[Tuple[str, Tuple[int, int]]] = []
         self.jobs: Dict[int, JobState] = {}  # in-flight only
         # finished jobs, bounded: serves late status queries + duplicate
         # ACKs without growing with coordinator lifetime
@@ -236,6 +253,14 @@ class Scheduler:
         H3..H10 set, worker.py:52). Returns the assignments to send;
         in-progress state is updated as if they were delivered.
         """
+        # staged (pipeline) batches drain their model's queue ahead of
+        # execution; if a SECOND model's work shows up, un-stage them
+        # so the fair split sees the full picture — otherwise the new
+        # model waits behind work that hasn't even dispatched
+        staged_models = {b.model for b in self.prefetch.values()}
+        queued_models = {m for m, q in self.queues.items() if q}
+        if self.prefetch and len(staged_models | queued_models) > 1:
+            self._unstage_all()
         active = self.active_models()
         if not active or not workers:
             return []
@@ -245,6 +270,21 @@ class Scheduler:
         else:
             out = self._schedule_two(active[0], active[1], workers)
         self._record_rates(workers)
+        return out
+
+    def _unstage_all(self) -> None:
+        """Return every staged batch to its queue front and record the
+        revocation so the service can tell the workers (a worker whose
+        stage survives here would dispatch it anyway; completion dedup
+        makes that merely wasteful, not wrong)."""
+        for w, b in list(self.prefetch.items()):
+            self._queue(b.model).appendleft(b)
+            self._revoked_stages.append((w, b.key))
+        self.prefetch.clear()
+
+    def pop_revoked_stages(self) -> List[Tuple[str, Tuple[int, int]]]:
+        """(worker, batch key) stage revocations since the last call."""
+        out, self._revoked_stages = self._revoked_stages, []
         return out
 
     def _free_workers(self, workers: Sequence[str]) -> List[str]:
@@ -261,6 +301,14 @@ class Scheduler:
             batch = q.popleft()
             self.in_progress[w] = batch
             out.append(Assignment(worker=w, batch=batch))
+        if self.pipeline_depth > 1:
+            for w in workers:
+                if not q:
+                    break
+                if w in self.in_progress and w not in self.prefetch:
+                    batch = q.popleft()
+                    self.prefetch[w] = batch
+                    out.append(Assignment(worker=w, batch=batch, staged=True))
         return out
 
     def _schedule_two(
@@ -314,6 +362,8 @@ class Scheduler:
             for w in surplus:
                 if have >= want or not q:
                     break
+                # (no stage handling here: schedule() un-stages every
+                # prefetch batch before a dual-model round can run)
                 displaced = self.in_progress[w]
                 self._queue(displaced.model).appendleft(displaced)
                 batch = q.popleft()
@@ -348,6 +398,17 @@ class Scheduler:
         cur = self.in_progress.get(worker)
         if cur is not None and cur.key == (job_id, batch_id):
             del self.in_progress[worker]
+            # promote the staged batch: the worker moved on to it the
+            # moment its previous inference finished
+            nxt = self.prefetch.pop(worker, None)
+            if nxt is not None:
+                self.in_progress[worker] = nxt
+        elif self.prefetch.get(worker) is not None and self.prefetch[
+            worker
+        ].key == (job_id, batch_id):
+            # out-of-order ACK (the staged batch drained first): clear
+            # the stage; the primary is still in flight on this worker
+            del self.prefetch[worker]
         st = self.jobs.get(job_id)
         if st is None or batch_id in st.completed_batches:
             return None  # unknown job, already-finished job, or dup ACK
@@ -389,8 +450,19 @@ class Scheduler:
         batch key."""
         cur = self.in_progress.get(worker)
         if cur is None or cur.key != (job_id, batch_id):
-            return None
-        del self.in_progress[worker]
+            staged = self.prefetch.get(worker)
+            if staged is None or staged.key != (job_id, batch_id):
+                return None
+            # the STAGED batch failed (e.g. its prepare found no live
+            # replica): clear the stage; the primary keeps running
+            del self.prefetch[worker]
+            cur = staged
+        else:
+            del self.in_progress[worker]
+            nxt = self.prefetch.pop(worker, None)
+            if nxt is not None:
+                # worker proceeds to its staged batch after the failure
+                self.in_progress[worker] = nxt
         st = self.jobs.get(job_id)
         if st is None or batch_id in st.completed_batches:
             # unknown/retired job or already done elsewhere: free the
@@ -438,8 +510,14 @@ class Scheduler:
         """Worker died: requeue its in-flight batch at the FRONT
         (reference handle_failures_if_pending_status,
         worker.py:1279-1306). Returns the requeued batch, if any."""
+        staged = self.prefetch.pop(worker, None)
+        if staged is not None:
+            self._queue(staged.model).appendleft(staged)
+            self.requeue_count += 1
         batch = self.in_progress.pop(worker, None)
         if batch is not None:
+            # primary requeued after the staged batch so it lands at
+            # the very front (it was assigned first)
             self._queue(batch.model).appendleft(batch)
             self.requeue_count += 1
         return batch
@@ -448,6 +526,7 @@ class Scheduler:
         """Forget a worker without requeueing (voluntary leave after
         its batch was handled)."""
         self.in_progress.pop(worker, None)
+        self.prefetch.pop(worker, None)
 
     # ------------------------------------------------------------------
     # standby shadow maintenance (reference worker.py:887-897, 965-986)
@@ -520,10 +599,16 @@ class Scheduler:
 
     def c5_assignments(self) -> Dict[str, Any]:
         """Current worker -> batch map (reference C5, worker.py:1807-1808)."""
-        return {
+        out = {
             w: {"job": b.job_id, "batch": b.batch_id, "model": b.model, "images": len(b.files)}
             for w, b in sorted(self.in_progress.items())
         }
+        for w, b in sorted(self.prefetch.items()):
+            out[f"{w} (staged)"] = {
+                "job": b.job_id, "batch": b.batch_id, "model": b.model,
+                "images": len(b.files), "staged": True,
+            }
+        return out
 
     def queue_depths(self) -> Dict[str, int]:
         return {m: len(q) for m, q in self.queues.items() if q}
@@ -559,6 +644,10 @@ class Scheduler:
         queues: Dict[str, List[Dict[str, Any]]] = {
             m: [batch_dict(b) for b in q] for m, q in self.queues.items() if q
         }
+        # staged batches fold in first so the in-progress primaries end
+        # up ahead of them at the queue front
+        for worker, b in self.prefetch.items():
+            queues.setdefault(b.model, []).insert(0, batch_dict(b))
         for worker, b in self.in_progress.items():
             queues.setdefault(b.model, []).insert(0, batch_dict(b))
         return {
@@ -599,6 +688,7 @@ class Scheduler:
             for m, batches in snap.get("queues", {}).items()
         }
         self.in_progress = {}
+        self.prefetch = {}
         self.jobs = {}
         for j in snap.get("jobs", {}).values():
             completed = set(j.pop("completed_batches", []))

@@ -32,8 +32,9 @@ import itertools
 import json
 import logging
 import os
+import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Awaitable, Callable, Deque, Dict, List, Optional, Tuple
 
 from ..config import NodeId
@@ -61,19 +62,55 @@ class JobService:
         store: StoreService,
         infer_backend: Optional[InferBackend] = None,
         image_patterns: Tuple[str, ...] = ("*.jpeg", "*.jpg"),
+        engine=None,
+        pipeline_depth: int = 2,
     ):
+        """`engine` shares one InferenceEngine across co-located
+        services (one weights copy + one compile per model per chip);
+        `pipeline_depth` > 1 turns on depth-2 worker pipelining: the
+        coordinator stages batch N+1 on each busy worker so its
+        store-fetch + host JPEG decode + device dispatch overlap batch
+        N's in-flight inference. The reference's workers serialize
+        download -> infer per batch (worker.py:518-537); through a
+        high-latency device link the blocking per-batch round-trip is
+        the cluster-serving bottleneck, so overlap is where the
+        throughput is."""
         self.node = node
         self.store = store
         self.image_patterns = image_patterns
         self._backend = infer_backend or self._engine_backend
+        self._backend_is_engine = infer_backend is None
         # LM (or other non-CNN) serving models registered on this node:
         # per-model worker backend + per-model input-file patterns
         # (image jobs sample *.jpeg; LM jobs sample prompt-token files)
         self._extra_backends: Dict[str, InferBackend] = {}
         self.model_patterns: Dict[str, Tuple[str, ...]] = {}
-        self._engine = None  # lazy InferenceEngine (imports jax on first use)
+        self._engine = engine  # lazy InferenceEngine (imports jax on first use)
+        # Decoded-input cache for the worker prepare stage, keyed by
+        # (local path, mtime_ns, size, target hw). Store objects are
+        # immutable per version (a re-PUT mints a new version and a
+        # new local path), so hits are always coherent. The reference
+        # workload wrap-around-samples a small file set per job
+        # (worker.py:188-245) and its workers re-download + re-decode
+        # every occurrence; serving hot immutable objects from a
+        # decoded cache is the TPU-host analog of not doing that.
+        # Budget is bytes of decoded uint8; 0 disables.
+        self.decode_cache_bytes: int = 256 << 20
+        self._decode_cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        self._decode_cache_lock = threading.Lock()
+        self._decode_cache_used = 0
+        self.decode_cache_hits = 0
+        self.decode_cache_misses = 0
         self.scheduler = Scheduler(costs=self._seed_costs())
-        self._current: Optional[Tuple[Tuple[int, int], asyncio.Task]] = None
+        self.scheduler.pipeline_depth = max(1, int(pipeline_depth))
+        # worker-side execution state: running batches (primary + an
+        # early-promoted staged batch draining concurrently, <= depth)
+        # and the one staged batch whose prepare runs eagerly
+        self._running: Dict[Tuple[int, int], asyncio.Task] = {}
+        self._staged: Optional[
+            Tuple[Tuple[int, int], Batch, str, asyncio.Task]
+        ] = None
+        self._bg_tasks: set = set()
         # client-side completion futures; bounded so fire-and-forget
         # submitters don't leak (evicted callers fall back to polling)
         self._job_done: BoundedDict = BoundedDict(1000)
@@ -90,6 +127,7 @@ class JobService:
         # incarnation (keyed per sender as (inc, last_seq))
         self._incarnation = int(time.time() * 1000)
         self._assigned_at: Dict[str, Tuple[Tuple[int, int], float]] = {}
+        self._staged_at: Dict[str, Tuple[Tuple[int, int], float]] = {}
         # coordinator-side per-batch wall-time breakdown from ACKs
         # (fetch / backend / infer) — where cluster-serving time goes
         self.batch_timing: Deque[Dict[str, float]] = deque(maxlen=512)
@@ -200,7 +238,12 @@ class JobService:
             except (asyncio.CancelledError, Exception):
                 pass
             self._ckpt_task = None
-        for t in (self._sched_task, self._current[1] if self._current else None):
+        if self._staged is not None:
+            self._staged[3].cancel()
+            self._staged = None
+        for t in list(self._bg_tasks):
+            t.cancel()
+        for t in [self._sched_task] + list(self._running.values()):
             if t is not None:
                 t.cancel()
                 try:
@@ -208,7 +251,7 @@ class JobService:
                 except (asyncio.CancelledError, Exception):
                     pass
         self._sched_task = None
-        self._current = None
+        self._running.clear()
 
     # ------------------------------------------------------------------
     # roles
@@ -417,8 +460,13 @@ class JobService:
         `infer_ms` the engine's infer call — device forward PLUS
         dispatch, which on a remoted chip is dominated by the tunnel
         round-trips (device compute for a b32 ResNet batch is ~2.2 ms;
-        see the bench sweep) — and `other_ms` output PUT + ACK path
-        (exec − fetch − backend). Empty dict when no samples."""
+        see the bench sweep) — and `other_ms` the rest (exec − fetch −
+        backend): output PUT + ACK path, plus, for STAGED batches, the
+        time the prepared batch sat parked waiting for the previous
+        batch's inference (exec spans first touch to ACK). Per-batch
+        exec therefore sums across stages while the job's WALL tracks
+        max(stage) — overlap means the sum exceeds wall. Empty dict
+        when no samples."""
         if not self.batch_timing:
             return {}
         n = len(self.batch_timing)
@@ -445,6 +493,7 @@ class JobService:
         n.register(MsgType.JOBS_RESTORE_RELAY, self._h_restore_relay)
         n.register(MsgType.JOB_FAILED_RELAY, self._h_job_failed_relay)
         n.register(MsgType.WORKER_TASK_REQUEST, self._h_task_request)
+        n.register(MsgType.WORKER_STAGE_CANCEL, self._h_stage_cancel)
         n.register(MsgType.WORKER_TASK_REQUEST_ACK, self._h_task_ack)
         n.register(MsgType.WORKER_TASK_FAIL, self._h_task_fail)
         n.register(MsgType.WORKER_TASK_ACK_RELAY, self._h_ack_relay)
@@ -471,8 +520,18 @@ class JobService:
                 log.exception("%s: scheduling tick failed", self._me)
 
     def _run_schedule(self) -> None:
-        for a in self.scheduler.schedule(self.worker_pool()):
-            self._send_task(a.worker, a.batch)
+        assigns = self.scheduler.schedule(self.worker_pool())
+        for w, key in self.scheduler.pop_revoked_stages():
+            sat = self._staged_at.get(w)
+            if sat is not None and sat[0] == key:
+                del self._staged_at[w]
+            self.node.send_unique(
+                w, MsgType.WORKER_STAGE_CANCEL,
+                {"job": key[0], "batch": key[1],
+                 "seq": next(self._task_seq), "inc": self._incarnation},
+            )
+        for a in assigns:
+            self._send_task(a.worker, a.batch, staged=a.staged)
 
     def _resend_stale_assignments(self) -> None:
         """Re-send assignments in flight past the resend deadline: the
@@ -489,8 +548,16 @@ class JobService:
                     self._me, batch.key, worker,
                 )
                 self._send_task(worker, batch)
+        for worker, batch in list(self.scheduler.prefetch.items()):
+            key_t = self._staged_at.get(worker)
+            if (
+                key_t is None
+                or key_t[0] != batch.key
+                or now - key_t[1] > self.task_resend_after
+            ):
+                self._send_task(worker, batch, staged=True)
 
-    def _send_task(self, worker: str, b: Batch) -> None:
+    def _send_task(self, worker: str, b: Batch, staged: bool = False) -> None:
         # replicas are resolved at send time from the live metadata so
         # re-replication and failover promotions are reflected
         # (reference resolves at assignment, worker.py:290-297)
@@ -501,7 +568,10 @@ class JobService:
                 if reps:
                     b.replicas[f] = reps
                 versions[f] = self.store.metadata.latest_version(f)
-        self._assigned_at[worker] = (b.key, time.monotonic())
+        if staged:
+            self._staged_at[worker] = (b.key, time.monotonic())
+        else:
+            self._assigned_at[worker] = (b.key, time.monotonic())
         try:
             self.node.send_unique(
                 worker,
@@ -513,6 +583,7 @@ class JobService:
                     "files": b.files,
                     "replicas": b.replicas,
                     "versions": versions,
+                    "staged": staged,
                     "seq": next(self._task_seq),
                     "inc": self._incarnation,
                 },
@@ -624,10 +695,21 @@ class JobService:
         at = self._assigned_at.get(msg.sender)
         if at is not None and at[0] == (job_id, batch_id):
             del self._assigned_at[msg.sender]
+        sat = self._staged_at.get(msg.sender)
+        if sat is not None and sat[0] == (job_id, batch_id):
+            del self._staged_at[msg.sender]
         done = self.scheduler.on_batch_done(
             msg.sender, job_id, batch_id,
             float(d.get("exec_time", 0.0)), int(d.get("n_images", 0)),
         )
+        # promotion bookkeeping: the worker moved on to its staged
+        # batch when this one finished — carry the stage's send time
+        # over so the resend loop doesn't immediately re-send it
+        cur = self.scheduler.in_progress.get(msg.sender)
+        sat = self._staged_at.get(msg.sender)
+        if cur is not None and sat is not None and sat[0] == cur.key:
+            self._assigned_at[msg.sender] = sat
+            del self._staged_at[msg.sender]
         if "fetch_time" in d:
             self.batch_timing.append({
                 "model": d.get("model", ""),
@@ -698,8 +780,25 @@ class JobService:
             self.scheduler.set_batch_size(model, bs)
         except KeyError:
             pass
-        if self._engine is not None and model in self._engine.loaded_models:
-            self._engine.set_batch_size(model, bs)
+        eng = self._engine
+        if eng is not None and model in eng.loaded_models:
+            # the engine-side reshape warms up (compile + 2 forwards)
+            # — minutes through a remoted chip, so NEVER on the event
+            # loop (it would stall SWIM heartbeats into false
+            # suspicion and time out the C3 RPC). The scheduler's
+            # batch size above switches immediately; engine-side the
+            # new chunk shape takes effect at once (compiling lazily
+            # on first use) while in-flight nowait handles keep their
+            # dispatch-time size snapshot (engine._dispatch_chunk).
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                eng.set_batch_size(model, bs)
+            else:
+                self._spawn_bg(
+                    asyncio.to_thread(eng.set_batch_size, model, bs),
+                    f"batch-size warmup {model}@{bs}",
+                )
 
     async def _h_job_status(self, msg: Message, addr) -> None:
         """Pull-based completion fallback (no reference equivalent —
@@ -739,10 +838,21 @@ class JobService:
         hang."""
         if not self.node.is_leader:
             return
-        self._assigned_at.pop(msg.sender, None)
-        b = self.scheduler.on_batch_failed(
-            msg.sender, int(msg.data["job"]), int(msg.data["batch"])
-        )
+        failed_key = (int(msg.data["job"]), int(msg.data["batch"]))
+        at = self._assigned_at.get(msg.sender)
+        if at is not None and at[0] == failed_key:
+            del self._assigned_at[msg.sender]
+        sat = self._staged_at.get(msg.sender)
+        if sat is not None and sat[0] == failed_key:
+            del self._staged_at[msg.sender]
+        b = self.scheduler.on_batch_failed(msg.sender, *failed_key)
+        # a failed PRIMARY promotes the worker's staged batch (the
+        # worker does the same) — carry the stage's send time over
+        cur = self.scheduler.in_progress.get(msg.sender)
+        sat = self._staged_at.get(msg.sender)
+        if cur is not None and sat is not None and sat[0] == cur.key:
+            self._assigned_at[msg.sender] = sat
+            del self._staged_at[msg.sender]
         if b is not None:
             log.info(
                 "%s: batch %s failed on %s (%s); requeued",
@@ -776,6 +886,7 @@ class JobService:
         if not self.node.is_leader:
             return
         self._assigned_at.pop(uname, None)
+        self._staged_at.pop(uname, None)
         if self.scheduler.on_worker_failed(uname) is not None:
             log.info("%s: requeued batch from dead worker %s", self._me, uname)
         self._run_schedule()
@@ -1009,45 +1120,254 @@ class JobService:
         key = (int(d["job"]), int(d["batch"]))
         seq = int(d.get("seq", 0))
         inc = int(d.get("inc", 0))
+        stale = False
         if seq:
             prev_inc, prev_seq = self._last_seq.get(msg.sender, (0, 0))
-            if inc < prev_inc or (inc == prev_inc and seq <= prev_seq):
-                return  # reordered stale assignment: must not cancel newer work
-            self._last_seq[msg.sender] = (inc, seq)
-        if self._current is not None:
-            cur_key, cur_task = self._current
-            if cur_key == key and not cur_task.done():
-                return  # duplicate/re-sent delivery of the running batch
-            if not cur_task.done():
-                # preemption (reference worker.py:944-953): cancel the
-                # host-side task; the coordinator already requeued the
-                # displaced batch. Model weights stay resident in HBM.
-                cur_task.cancel()
+            stale = inc < prev_inc or (inc == prev_inc and seq <= prev_seq)
+            if not stale:
+                self._last_seq[msg.sender] = (inc, seq)
+        self._running = {k: t for k, t in self._running.items() if not t.done()}
         batch = Batch(
             job_id=key[0], batch_id=key[1], model=d["model"],
             files=list(d["files"]),
             replicas={f: list(r) for f, r in d.get("replicas", {}).items()},
             versions={f: int(v) for f, v in d.get("versions", {}).items()},
         )
+        if key in self._running:
+            return  # duplicate/re-sent delivery of a running batch
+        if d.get("staged"):
+            # pipeline assignment: start the prepare (store fetch +
+            # host decode) NOW; dispatch happens when the running
+            # batch's inference completes (promotion)
+            if stale:
+                return  # a reordered old stage; the resend tick re-stages
+            if self._staged is not None:
+                if self._staged[0] == key:
+                    return  # duplicate staged delivery
+                self._staged[3].cancel()
+            prep = asyncio.create_task(
+                self._prepare(batch),
+                name=f"{self.node.me}-prep-{key[0]}-{key[1]}",
+            )
+            self._staged = (key, batch, msg.sender, prep)
+            if not self._running:
+                # UDP reorder: the stage outran its same-round primary.
+                # Hold it staged (executing it now would later be
+                # cancelled as a 'preemption' when the primary lands);
+                # if the primary never arrives, self-promote after a
+                # beat so the batch isn't stranded until the resend.
+                self._spawn_bg(
+                    self._promote_orphaned_stage(key),
+                    f"orphan-stage promotion {key}",
+                )
+            return
+        if self._running:
+            # a different batch while busy = preemption (reference
+            # worker.py:944-953): cancel the host-side tasks; the
+            # coordinator already requeued the displaced batches
+            # (primary AND stage). Model weights stay resident in HBM.
+            # A STALE reordered request must not cancel newer work.
+            if stale:
+                return
+            for t in self._running.values():
+                t.cancel()
+            self._running.clear()
+            if self._staged is not None and self._staged[0] != key:
+                self._staged[3].cancel()
+                self._staged = None
+        # idle (or just preempted): run it — even a stale-seq request
+        # cancels nothing here, and completion dedup absorbs re-runs
+        if self._staged is not None and self._staged[0] == key:
+            # the primary for a batch we already staged (normal-order
+            # promotion resend, or the reordered-primary case above):
+            # reuse its in-flight prepare
+            _, sbatch, _, prep = self._staged
+            self._staged = None
+            task = asyncio.create_task(
+                self._execute(sbatch, coordinator=msg.sender, prep=prep),
+                name=f"{self.node.me}-task-{key[0]}-{key[1]}",
+            )
+        else:
+            task = asyncio.create_task(
+                self._execute(batch, coordinator=msg.sender),
+                name=f"{self.node.me}-task-{key[0]}-{key[1]}",
+            )
+        self._running[key] = task
+
+    async def _promote_orphaned_stage(self, key: Tuple[int, int]) -> None:
+        """Fallback for a stage whose primary was lost or reordered
+        away: after a beat, if the stage is still parked and the worker
+        is idle, run it rather than strand it until the coordinator's
+        resend timeout."""
+        await asyncio.sleep(2 * self.node.spec.timing.ping_interval)
+        self._running = {k: t for k, t in self._running.items() if not t.done()}
+        if (
+            self._staged is not None
+            and self._staged[0] == key
+            and not self._running
+        ):
+            log.info("%s: promoting orphaned stage %s", self._me, key)
+            self._promote_staged()
+
+    def _spawn_bg(self, coro, what: str) -> asyncio.Task:
+        """Fire-and-forget with a strong reference (the loop keeps only
+        weak refs — an untracked task can be GC'd before it runs) and
+        exception logging (otherwise failures vanish as 'exception was
+        never retrieved')."""
+        t = asyncio.create_task(coro)
+        self._bg_tasks.add(t)
+
+        def _done(task: asyncio.Task) -> None:
+            self._bg_tasks.discard(task)
+            if not task.cancelled() and task.exception() is not None:
+                log.error(
+                    "%s: background %s failed: %r",
+                    self._me, what, task.exception(),
+                )
+
+        t.add_done_callback(_done)
+        return t
+
+    async def _h_stage_cancel(self, msg: Message, addr) -> None:
+        """The coordinator revoked our staged batch (it went back to
+        the queue when a second model's work arrived). If it already
+        promoted to running, let it finish — completion dedup absorbs
+        the duplicate. Carries the same (inc, seq) staleness guard as
+        assignments so a reordered old cancel can't kill a NEWER
+        re-stage of the same batch."""
+        seq = int(msg.data.get("seq", 0))
+        inc = int(msg.data.get("inc", 0))
+        if seq:
+            prev_inc, prev_seq = self._last_seq.get(msg.sender, (0, 0))
+            if inc < prev_inc or (inc == prev_inc and seq <= prev_seq):
+                return
+            self._last_seq[msg.sender] = (inc, seq)
+        key = (int(msg.data["job"]), int(msg.data["batch"]))
+        if self._staged is not None and self._staged[0] == key:
+            self._staged[3].cancel()
+            self._staged = None
+
+    def _promote_staged(self) -> None:
+        """Start executing the staged batch (its prepare is already in
+        flight). Called the moment the current batch's inference is
+        dispatched (engine path) or finished (generic path): the
+        coordinator performs the matching in_progress promotion when
+        the current batch's ACK arrives."""
+        if self._staged is None:
+            return
+        key, batch, coordinator, prep = self._staged
+        self._staged = None
         task = asyncio.create_task(
-            self._execute(batch, coordinator=msg.sender),
+            self._execute(batch, coordinator=coordinator, prep=prep),
             name=f"{self.node.me}-task-{key[0]}-{key[1]}",
         )
-        self._current = (key, task)
+        self._running[key] = task
 
-    async def _execute(self, batch: Batch, coordinator: str) -> None:
+    async def _prepare(
+        self, batch: Batch
+    ) -> Tuple[List[str], Optional[Any], float, float, float]:
+        """Stage 1 of the worker pipeline: materialize the batch's
+        inputs locally and (for engine-served CNN models) decode them
+        to the uint8 batch array. Runs eagerly for staged batches so
+        it overlaps the previous batch's device time. Returns its own
+        start time so exec accounting spans the true first touch (for
+        a staged batch, _execute begins long after prepare did)."""
+        t0 = time.monotonic()
+        paths = await self._fetch_inputs(batch)
+        t_fetch = time.monotonic() - t0
+        imgs = None
+        t_decode = 0.0
+        if self._backend_is_engine and batch.model not in self._extra_backends:
+            try:
+                spec = get_model(batch.model)
+            except KeyError:
+                spec = None
+            if spec is not None:
+                t1 = time.monotonic()
+                imgs = await asyncio.to_thread(
+                    self._decode_cached, paths, spec.input_size
+                )
+                t_decode = time.monotonic() - t1
+        return paths, imgs, t_fetch, t_decode, t0
+
+    def _decode_cached(self, paths: List[str], size) -> Any:
+        """load_images through the per-file decoded cache (thread
+        context). Cache keys carry mtime+size so an overwritten local
+        file can never serve a stale decode."""
+        import numpy as np
+
+        from ..models.preprocess import load_images
+
+        if self.decode_cache_bytes <= 0:
+            return load_images(paths, size)
+        keys = []
+        for p in paths:
+            try:
+                st = os.stat(p)
+                keys.append((p, st.st_mtime_ns, st.st_size, tuple(size)))
+            except OSError:
+                keys.append(None)
+        out: List[Optional[Any]] = [None] * len(paths)
+        miss_idx = []
+        with self._decode_cache_lock:
+            for i, k in enumerate(keys):
+                hit = self._decode_cache.get(k) if k is not None else None
+                if hit is not None:
+                    self._decode_cache.move_to_end(k)
+                    self.decode_cache_hits += 1
+                    out[i] = hit
+                else:
+                    self.decode_cache_misses += 1
+                    miss_idx.append(i)
+        if miss_idx:
+            decoded = load_images([paths[i] for i in miss_idx], size)
+            with self._decode_cache_lock:
+                for j, i in enumerate(miss_idx):
+                    # copy the slice out of the batch array: caching the
+                    # view would pin the WHOLE decoded batch base while
+                    # the byte accounting counts only the slice
+                    arr = np.ascontiguousarray(decoded[j])
+                    out[i] = arr
+                    k = keys[i]
+                    if k is not None and k not in self._decode_cache:
+                        self._decode_cache[k] = arr
+                        self._decode_cache_used += arr.nbytes
+        with self._decode_cache_lock:
+            while (
+                self._decode_cache_used > self.decode_cache_bytes
+                and self._decode_cache
+            ):
+                _, old = self._decode_cache.popitem(last=False)
+                self._decode_cache_used -= old.nbytes
+        return np.stack(out)
+
+    async def _execute(
+        self,
+        batch: Batch,
+        coordinator: str,
+        prep: Optional[asyncio.Task] = None,
+    ) -> None:
         from ..observability import span
 
-        t0 = time.monotonic()
         try:
             with span("worker.fetch_inputs"):
-                paths = await self._fetch_inputs(batch)
-            t_fetch = time.monotonic() - t0
+                if prep is None:
+                    paths, imgs, t_fetch, t_decode, t0 = await self._prepare(batch)
+                else:
+                    paths, imgs, t_fetch, t_decode, t0 = await prep
             t1 = time.monotonic()
             with span("worker.inference"):
                 be = self._extra_backends.get(batch.model, self._backend)
-                results, infer_time, cost = await be(batch.model, paths)
-            t_backend = time.monotonic() - t1
+                if imgs is not None and self._backend_is_engine:
+                    results, infer_time, cost = await self._engine_infer_prepared(
+                        batch.model, paths, imgs
+                    )
+                else:
+                    results, infer_time, cost = await be(batch.model, paths)
+                    # generic path: promote once inference finished
+                    # (the engine path promoted at dispatch)
+                    self._promote_staged()
+            t_backend = (time.monotonic() - t1) + t_decode
             # backends key results by the LOCAL path (the engine uses
             # the full path, others may use the basename), which
             # differs by how the input materialized (store-replica hit
@@ -1090,6 +1410,10 @@ class JobService:
                     "cost": cost,
                 },
             )
+            # a staged batch that arrived while we were draining (the
+            # engine path promotes at dispatch, but the NEXT stage can
+            # land mid-drain) starts now
+            self._promote_staged()
         except asyncio.CancelledError:
             log.info("%s: batch %s preempted", self._me, batch.key)
             raise
@@ -1102,6 +1426,13 @@ class JobService:
                 MsgType.WORKER_TASK_FAIL,
                 {"job": batch.job_id, "batch": batch.batch_id, "error": str(e)},
             )
+            # the staged batch is independent work: run it (the
+            # coordinator's on_batch_failed does the same promotion)
+            self._promote_staged()
+        finally:
+            t = self._running.get(batch.key)
+            if t is not None and t is asyncio.current_task():
+                del self._running[batch.key]
 
     async def _fetch_inputs(self, batch: Batch) -> List[str]:
         """Materialize the batch's images locally: local store hit if
@@ -1314,9 +1645,7 @@ class JobService:
     # default inference backend: the TPU engine
     # ------------------------------------------------------------------
 
-    async def _engine_backend(
-        self, model: str, paths: List[str]
-    ) -> Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]:
+    async def _ensure_model_loaded(self, model: str):
         eng = self._ensure_engine()
         if model not in eng.loaded_models:
             if eng.evicted_with_explicit_weights(model):
@@ -1334,5 +1663,48 @@ class JobService:
                 await self.load_model_weights(model, version=pinned)
             else:
                 await asyncio.to_thread(eng.load_model, model)
+        return eng
+
+    async def _engine_backend(
+        self, model: str, paths: List[str]
+    ) -> Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]:
+        eng = await self._ensure_model_loaded(model)
         res = await eng.infer_files_async(model, paths)
         return res.to_json_dict(), res.infer_time, eng.cost_constants(model)
+
+    async def _engine_infer_prepared(
+        self, model: str, paths: List[str], imgs
+    ) -> Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]:
+        """Pipelined engine path: inputs are already decoded. Enqueues
+        the device forward WITHOUT blocking (infer_arrays_nowait),
+        promotes the staged batch so its dispatch overlaps this
+        batch's drain, then drains in a thread. Through a remoted
+        chip this turns the per-batch round-trip latency into
+        pipeline depth."""
+        from ..models.labels import decode_predictions
+
+        eng = await self._ensure_model_loaded(model)
+        t0 = time.monotonic()
+        loop = asyncio.get_running_loop()
+
+        def dispatch_and_drain():
+            # dispatch AND drain off the event loop: device_put + jit
+            # dispatch through a remoted chip block for tens of ms,
+            # which on the loop would stall the whole control plane
+            # (heartbeats, ACKs, scheduling) per batch
+            handle = eng.infer_arrays_nowait(model, imgs)
+            # batch N+1 dispatches while we drain batch N
+            loop.call_soon_threadsafe(self._promote_staged)
+            return handle()
+
+        probs = await asyncio.to_thread(dispatch_and_drain)
+        infer_time = time.monotonic() - t0
+        top5 = decode_predictions(probs)
+        results = {
+            p: [
+                {"wnid": w, "label": lbl, "score": s}
+                for (w, lbl, s) in t
+            ]
+            for p, t in zip(paths, top5)
+        }
+        return results, infer_time, eng.cost_constants(model)

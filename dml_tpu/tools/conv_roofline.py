@@ -21,8 +21,26 @@ one. Measured MFU should land between the implied bounds — if it
 sits below the pessimistic bound, something is actually wrong (a
 layout/algorithm problem), not "the architecture".
 
+**Round-4 revision (VERDICT r3 item 8):** the single 750 GB/s stream
+constant is wrong for EfficientNet's access patterns. Microbenched on
+the v5e (``--microbench``, slope-timed isolated convs at B4's own
+shapes): depthwise convs achieve 120–360 GB/s, dense 1x1s 250–570,
+scaling with working-set size — no B4 conv class comes near 750.
+``mfu_bound_serial_measured_bw`` recomputes the serial bound with the
+measured per-class bandwidths; for B4 b128 that bound is ~0.062 and
+the isolated sum-of-parts measurement (55 unique conv shapes, counted)
+is 179.8 ms → 0.032, while the FUSED forward measures 50.9 ms → 0.112
+conv-MFU. I.e. the fused model is 3.5x faster than its parts: XLA's
+fusion/overlap already exceeds every serial bound computable from
+measured per-op constants, and the r3 "gap to the 0.163 ceiling" was
+an artifact of the optimistic bandwidth constant, not an
+implementation gap. (b192/b256 were tried and do not beat b128:
+0.085/0.110 vs 0.112.)
+
 Run: ``python -m dml_tpu.tools.conv_roofline [model ...]``
-(CPU-safe: only traces jaxprs, compiles nothing).
+(CPU-safe: only traces jaxprs, compiles nothing), or
+``python -m dml_tpu.tools.conv_roofline --microbench [model]`` on the
+chip to reproduce the measured sum-of-parts vs fused comparison.
 """
 
 from __future__ import annotations
@@ -36,6 +54,17 @@ from typing import Any, Dict
 # the bench lm decode path, latest BENCH_r* artifact; spec 819)
 HBM_BW = 750e9
 PEAK = 197e12  # v5e dense bf16
+
+
+def eff_bw(feature_group_count: int, spatial: int) -> float:
+    """Per-class effective HBM bandwidth, measured on-chip with
+    isolated slope-timed convs at EfficientNet-B4's own shapes
+    (--microbench; 2026-07 v5e captures: dw 3x3 192ch@95^2 357 GB/s,
+    dw 5x5 960ch@24^2 179, dw@12^2 122, dense 1x1 32->192@95^2 254,
+    dense 1x1s@24^2 274-570). Coarse two-bucket model per class."""
+    if feature_group_count > 1:  # depthwise: VPU window streams
+        return 300e9 if spatial >= 48 else 150e9
+    return 300e9 if spatial >= 95 else 420e9
 
 
 def analyze(name: str, batch: int) -> Dict[str, Any]:
@@ -52,7 +81,7 @@ def analyze(name: str, batch: int) -> Dict[str, Any]:
     jaxpr = jax.make_jaxpr(lambda v, x: model.apply(v, x, train=False))(v, x)
 
     tot_flops = mxu_flops = w_util = 0.0
-    t_serial = t_mxu_sum = t_mem_sum = 0.0
+    t_serial = t_mxu_sum = t_mem_sum = t_serial_meas = 0.0
     for eqn in jaxpr.jaxpr.eqns:
         if eqn.primitive.name != "conv_general_dilated":
             continue
@@ -68,9 +97,11 @@ def analyze(name: str, batch: int) -> Dict[str, Any]:
             math.prod(lhs.shape) + math.prod(rhs.shape) + math.prod(out.shape)
         )
         t_mem = bytes_ / HBM_BW
+        t_mem_meas = bytes_ / eff_bw(fg, lhs.shape[1])
         t_mem_sum += t_mem
         if fg > 1:  # depthwise: VPU stream, no MXU work
             t_serial += t_mem
+            t_serial_meas += t_mem_meas
             continue
         k_dim, n_dim = kh * kw * cin_g, cout
         util = (
@@ -82,6 +113,7 @@ def analyze(name: str, batch: int) -> Dict[str, Any]:
         w_util += flops * util
         t_mxu_sum += t_mxu
         t_serial += max(t_mxu, t_mem)
+        t_serial_meas += max(t_mxu, t_mem_meas)
 
     t_pipelined = max(t_mxu_sum, t_mem_sum)
     return {
@@ -91,14 +123,95 @@ def analyze(name: str, batch: int) -> Dict[str, Any]:
         "mxu_flop_share": round(mxu_flops / tot_flops, 3),
         "tile_util_flop_weighted": round(w_util / max(mxu_flops, 1), 3),
         "mfu_bound_serial": round(tot_flops / PEAK / t_serial, 3),
+        "mfu_bound_serial_measured_bw": round(
+            tot_flops / PEAK / t_serial_meas, 3
+        ),
         "mfu_bound_pipelined": round(tot_flops / PEAK / t_pipelined, 3),
         "roofline_ms_serial": round(t_serial * 1e3, 2),
+        "roofline_ms_serial_measured_bw": round(t_serial_meas * 1e3, 2),
         "roofline_ms_pipelined": round(t_pipelined * 1e3, 2),
     }
 
 
+def microbench(name: str = "EfficientNetB4", batch: int = 128) -> Dict[str, Any]:
+    """On-chip evidence pass: slope-time every UNIQUE conv shape of the
+    model in isolation, sum (weighted by occurrence count), and compare
+    against the fused full forward. The fused/isolated ratio is the
+    fusion-overlap factor that no per-op roofline can see — on B4 b128
+    it measures ~3.5x, which is why the fused model BEATS every serial
+    bound built from measured per-op constants."""
+    import collections
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..benchmarks import device_seconds_per_iter, poke
+    from ..models.params_io import init_variables
+    from ..models.registry import get_model
+
+    spec = get_model(name)
+    v = init_variables(spec, dtype=jnp.bfloat16)
+    model = spec.build(dtype=jnp.bfloat16)
+    x0 = jnp.zeros((batch, *spec.input_size, 3), jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(lambda v, x: model.apply(v, x, train=False))(v, x0)
+    shapes: collections.Counter = collections.Counter()
+    tot_flops = 0.0
+    for eqn in jaxpr.jaxpr.eqns:
+        if eqn.primitive.name != "conv_general_dilated":
+            continue
+        lhs = tuple(eqn.invars[0].aval.shape)
+        rhs = tuple(eqn.invars[1].aval.shape)
+        fg = eqn.params.get("feature_group_count", 1)
+        st = tuple(eqn.params.get("window_strides"))
+        pad = tuple(map(tuple, eqn.params.get("padding")))
+        shapes[(lhs, rhs, fg, st, pad)] += 1
+        kh, kw, cin_g, cout = rhs
+        n, ho, wo, _ = eqn.outvars[0].aval.shape
+        tot_flops += 2.0 * n * ho * wo * kh * kw * cin_g * cout
+    t_parts = 0.0
+    for (ls, rs, fg, st, pad), cnt in shapes.items():
+        x = jnp.zeros(ls, jnp.bfloat16)
+        w = jnp.zeros(rs, jnp.bfloat16)
+        dn = lax.conv_dimension_numbers(ls, rs, ("NHWC", "HWIO", "NHWC"))
+
+        def step(i, acc, x, w, fg=fg, st=st, dn=dn, pad=list(pad)):
+            y = lax.conv_general_dilated(
+                poke(x, acc), w, st, pad,
+                feature_group_count=fg, dimension_numbers=dn,
+            )
+            return jnp.max(y.astype(jnp.float32))
+
+        # reps>=3: with 2 samples _paired_slopes' "median" is the max,
+        # which would bias every isolated timing slow (and inflate the
+        # published fusion_overlap_factor)
+        t_parts += device_seconds_per_iter(step, x, w, chains=(6, 24), reps=3) * cnt
+    vars_dev = jax.device_put(v)
+    fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
+
+    def fstep(i, acc, v, x):
+        return jnp.max(fwd(v, poke(x, acc)).astype(jnp.float32))
+
+    t_fused = device_seconds_per_iter(fstep, vars_dev, x0, chains=(3, 10), reps=3)
+    return {
+        "model": name,
+        "batch": batch,
+        "unique_conv_shapes": len(shapes),
+        "conv_gflops": round(tot_flops / 1e9, 1),
+        "isolated_sum_ms": round(t_parts * 1e3, 1),
+        "isolated_sum_mfu": round(tot_flops / PEAK / t_parts, 3),
+        "fused_forward_ms": round(t_fused * 1e3, 1),
+        "fused_conv_mfu": round(tot_flops / PEAK / t_fused, 3),
+        "fusion_overlap_factor": round(t_parts / t_fused, 2),
+    }
+
+
 def main() -> None:
-    targets = sys.argv[1:] or ["ResNet50", "InceptionV3", "EfficientNetB4"]
+    args = [a for a in sys.argv[1:] if a != "--microbench"]
+    if "--microbench" in sys.argv[1:]:
+        print(json.dumps(microbench(*(args or ["EfficientNetB4"]))))
+        return
+    targets = args or ["ResNet50", "InceptionV3", "EfficientNetB4"]
     out = [analyze(t, b) for t in targets for b in (32, 128)]
     print(json.dumps(out, indent=1))
 

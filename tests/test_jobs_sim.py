@@ -956,3 +956,174 @@ async def test_job_failure_relayed_to_standby(tmp_path):
         )
         st = sb.scheduler.job_state(job_id)
         assert st is not None and st.error
+
+
+# ------------------------------------------------------- worker pipelining
+
+
+async def test_pipeline_stage_prepares_while_primary_infers(tmp_path):
+    """Depth-2 pipelining: while a worker's PRIMARY batch is held in
+    the backend, its STAGED batch must be assigned and its prepare
+    (store fetch) must complete — the overlap that makes the serving
+    path wall ~ max(stage), not sum."""
+    async with cluster(4, tmp_path, 23100) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+
+        gates = {}
+        for u, be in sim.backends.items():
+            gates[u] = be.gate = asyncio.Event()
+
+        client = sim.jobs[client_u]
+        job_id = await client.submit_job("ResNet50", 96)  # 3 batches of 32
+
+        # a worker holds a primary batch (gated) AND a staged one
+        await sim.wait_for(
+            lambda: len(coord.scheduler.prefetch) >= 1,
+            what="a staged assignment",
+        )
+        worker_u = next(iter(coord.scheduler.prefetch))
+        wsvc = sim.jobs[worker_u]
+        # the stage's prepare (fetch) finishes while the primary is
+        # still gated in the backend
+        await sim.wait_for(
+            lambda: wsvc._staged is not None and wsvc._staged[3].done(),
+            what="staged prepare completed during primary inference",
+        )
+        assert not wsvc._staged[3].cancelled()
+
+        for ev in gates.values():
+            ev.set()
+        done = await client.wait_job(job_id, timeout=20.0)
+        assert done["total_queries"] == 96
+
+
+async def test_pipeline_stage_cancel_on_second_model(tmp_path):
+    """A second model's job arriving while stages are out must pull
+    the staged batches back (fair split sees them) and cancel the
+    workers' stages; both jobs then complete."""
+    async with cluster(4, tmp_path, 23200) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+
+        gates = {}
+        for u, be in sim.backends.items():
+            gates[u] = be.gate = asyncio.Event()
+
+        client = sim.jobs[client_u]
+        job_a = await client.submit_job("ResNet50", 128)  # 4 batches
+        await sim.wait_for(
+            lambda: len(coord.scheduler.prefetch) >= 1,
+            what="staged assignments",
+        )
+        staged_workers = list(coord.scheduler.prefetch)
+
+        job_b = await client.submit_job("InceptionV3", 64)
+        await sim.wait_for(
+            lambda: not coord.scheduler.prefetch,
+            what="stages revoked on dual-model activation",
+        )
+        # workers received the cancel (stage cleared or promoted; a
+        # promoted stage is allowed to finish — completion dedup)
+        await sim.wait_for(
+            lambda: all(
+                sim.jobs[u]._staged is None for u in staged_workers
+                if u in sim.jobs
+            ),
+            what="worker stages cancelled",
+        )
+
+        for ev in gates.values():
+            ev.set()
+        done_a = await client.wait_job(job_a, timeout=30.0)
+        done_b = await client.wait_job(job_b, timeout=30.0)
+        assert done_a["total_queries"] == 128
+        assert done_b["total_queries"] == 64
+
+
+async def test_pipeline_worker_death_with_stage_completes(tmp_path):
+    """Killing a worker that holds a primary AND a staged batch must
+    requeue both; the job still completes 100%."""
+    async with cluster(4, tmp_path, 23300) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H4")
+        await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+
+        gates = {}
+        for u, be in sim.backends.items():
+            gates[u] = be.gate = asyncio.Event()
+
+        client = sim.jobs[client_u]
+        job_id = await client.submit_job("ResNet50", 96)
+        await sim.wait_for(
+            lambda: len(coord.scheduler.prefetch) >= 1,
+            what="a staged assignment",
+        )
+        victim = next(iter(coord.scheduler.prefetch))
+        assert victim in coord.scheduler.in_progress
+        before = coord.scheduler.requeue_count
+        await sim.stop_node(victim)
+        for u, ev in gates.items():
+            if u != victim:
+                ev.set()
+        done = await client.wait_job(job_id, timeout=20.0)
+        assert done["total_queries"] == 96
+        assert coord.scheduler.requeue_count >= before + 2
+
+
+def test_decode_cache_unit(tmp_path):
+    """_decode_cached: hits on identical (path, mtime, size), misses
+    after overwrite, byte-budget eviction."""
+    import numpy as np
+    from PIL import Image
+
+    class Dummy:
+        pass
+
+    svc = Dummy()
+    svc.decode_cache_bytes = 10 * 224 * 224 * 3  # ~10 images
+    svc._decode_cache = __import__("collections").OrderedDict()
+    svc._decode_cache_lock = __import__("threading").Lock()
+    svc._decode_cache_used = 0
+    svc.decode_cache_hits = 0
+    svc.decode_cache_misses = 0
+    decode = JobService._decode_cached
+
+    rng = np.random.RandomState(0)
+    files = []
+    for i in range(4):
+        p = tmp_path / f"c_{i}.jpeg"
+        Image.fromarray(rng.randint(0, 255, (64, 64, 3), np.uint8)).save(p)
+        files.append(str(p))
+
+    a = decode(svc, files, (224, 224))
+    assert svc.decode_cache_misses == 4 and svc.decode_cache_hits == 0
+    b = decode(svc, files, (224, 224))
+    assert svc.decode_cache_hits == 4
+    np.testing.assert_array_equal(a, b)
+
+    # overwrite one file -> its entry must not serve stale pixels
+    import time as _t
+    _t.sleep(0.01)
+    Image.fromarray(rng.randint(0, 255, (64, 64, 3), np.uint8)).save(files[0])
+    c = decode(svc, files, (224, 224))
+    assert not np.array_equal(c[0], a[0])
+    np.testing.assert_array_equal(c[1], a[1])
+
+    # disabled cache bypasses entirely
+    svc.decode_cache_bytes = 0
+    h, m = svc.decode_cache_hits, svc.decode_cache_misses
+    decode(svc, files, (224, 224))
+    assert (svc.decode_cache_hits, svc.decode_cache_misses) == (h, m)
+
+    # eviction respects the byte budget
+    svc.decode_cache_bytes = 2 * 224 * 224 * 3
+    for i in range(4):
+        decode(svc, [files[i]], (224, 224))
+    assert svc._decode_cache_used <= svc.decode_cache_bytes
+    assert len(svc._decode_cache) <= 2

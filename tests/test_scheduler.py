@@ -249,3 +249,138 @@ def test_c5_assignment_dump():
     s.schedule(["w1"])
     c5 = s.c5_assignments()
     assert c5["w1"]["model"] == "a" and c5["w1"]["images"] == 10
+
+
+# ------------------------------------------------------- worker pipelining
+
+
+def make_pipelined():
+    s, clock = make()
+    s.pipeline_depth = 2
+    return s, clock
+
+
+def test_pipeline_stages_one_extra_batch_per_busy_worker():
+    s, _ = make_pipelined()
+    s.submit_job(1, "a", ["x"], 50, "c")  # 5 batches of 10
+    out = s.schedule(["w1", "w2"])
+    # 2 primaries + 2 staged
+    assert len(out) == 4
+    assert [a.staged for a in out] == [False, False, True, True]
+    assert set(s.in_progress) == {"w1", "w2"}
+    assert set(s.prefetch) == {"w1", "w2"}
+    # no double-staging on the next round
+    assert s.schedule(["w1", "w2"]) == []
+
+
+def test_pipeline_ack_promotes_staged_batch():
+    s, _ = make_pipelined()
+    s.submit_job(1, "a", ["x"], 30, "c")  # 3 batches
+    s.schedule(["w1"])
+    staged = s.prefetch["w1"]
+    s.on_batch_done("w1", 1, 0, 0.1, 10)
+    assert s.in_progress["w1"] is staged
+    assert "w1" not in s.prefetch
+    # next round stages the third batch
+    out = s.schedule(["w1"])
+    assert len(out) == 1 and out[0].staged
+
+
+def test_pipeline_out_of_order_ack_clears_stage_only():
+    s, _ = make_pipelined()
+    s.submit_job(1, "a", ["x"], 20, "c")
+    s.schedule(["w1"])
+    primary = s.in_progress["w1"]
+    staged_key = s.prefetch["w1"].key
+    s.on_batch_done("w1", *staged_key, 0.1, 10)
+    assert s.in_progress["w1"] is primary
+    assert "w1" not in s.prefetch
+
+
+def test_pipeline_worker_death_requeues_both_in_order():
+    s, _ = make_pipelined()
+    s.submit_job(1, "a", ["x"], 20, "c")
+    s.schedule(["w1"])
+    primary_key = s.in_progress["w1"].key
+    staged_key = s.prefetch["w1"].key
+    before = s.requeue_count
+    s.on_worker_failed("w1")
+    q = list(s.queues["a"])
+    assert [b.key for b in q[:2]] == [primary_key, staged_key]
+    assert s.requeue_count == before + 2
+    assert "w1" not in s.prefetch and "w1" not in s.in_progress
+
+
+def test_pipeline_staged_batch_failure_keeps_primary_running():
+    s, _ = make_pipelined()
+    s.submit_job(1, "a", ["x"], 20, "c")
+    s.schedule(["w1"])
+    primary = s.in_progress["w1"]
+    staged_key = s.prefetch["w1"].key
+    requeued = s.on_batch_failed("w1", *staged_key)
+    assert requeued is not None and requeued.key == staged_key
+    assert s.in_progress["w1"] is primary
+    assert "w1" not in s.prefetch
+    assert s.queues["a"][0].key == staged_key
+
+
+def test_pipeline_primary_failure_promotes_stage():
+    s, _ = make_pipelined()
+    s.submit_job(1, "a", ["x"], 20, "c")
+    s.schedule(["w1"])
+    primary_key = s.in_progress["w1"].key
+    staged = s.prefetch["w1"]
+    requeued = s.on_batch_failed("w1", *primary_key)
+    assert requeued is not None and requeued.key == primary_key
+    assert s.in_progress["w1"] is staged
+    assert "w1" not in s.prefetch
+
+
+def test_pipeline_preemption_requeues_stage_behind_primary():
+    s, clock = make_pipelined()
+    # model a starts alone and gets staged work; then model b arrives
+    # and the fair split preempts a's workers: both the displaced
+    # primary and its stage must requeue, primary in front
+    s.submit_job(1, "a", ["x"], 40, "c")
+    s.schedule(["w1", "w2"])
+    assert set(s.prefetch) == {"w1", "w2"}
+    s.submit_job(2, "b", ["y"], 40, "c")
+    out = s.schedule(["w1", "w2"])
+    preempting = [a for a in out if a.preempted is not None]
+    assert preempting, "b should preempt at least one of a's workers"
+    w = preempting[0].worker
+    assert w not in s.prefetch  # stage requeued with its primary
+    qa = list(s.queues["a"])
+    assert qa[0].key == preempting[0].preempted.key
+
+
+def test_pipeline_never_stages_in_dual_model_rounds():
+    s, _ = make_pipelined()
+    s.submit_job(1, "a", ["x"], 40, "c")
+    s.submit_job(2, "b", ["y"], 40, "c")
+    out = s.schedule(["w1", "w2", "w3"])
+    assert all(not a.staged for a in out)
+    assert not s.prefetch
+
+
+def test_pipeline_snapshot_folds_stage_behind_primary():
+    s, _ = make_pipelined()
+    s.submit_job(1, "a", ["x"], 30, "c")
+    s.schedule(["w1"])
+    primary_key = s.in_progress["w1"].key
+    staged_key = s.prefetch["w1"].key
+    snap = s.snapshot()
+    s2 = Scheduler({"a": FAST})
+    s2.restore(snap)
+    keys = [b.key for b in s2.queues["a"]]
+    assert keys[0] == primary_key and keys[1] == staged_key
+    assert not s2.prefetch and not s2.in_progress
+
+
+def test_pipeline_c5_shows_staged_assignments():
+    s, _ = make_pipelined()
+    s.submit_job(1, "a", ["x"], 20, "c")
+    s.schedule(["w1"])
+    c5 = s.c5_assignments()
+    assert c5["w1"]["model"] == "a"
+    assert c5["w1 (staged)"]["staged"] is True
