@@ -1141,6 +1141,8 @@ def main() -> None:
         "inception_mfu_b128": g("inceptionv3", default=[{}])[-1].get("mfu"),
         "b4_mfu_b128": g("efficientnet_b4", default=[{}])[-1].get("mfu"),
         "cluster_qps": g("cluster_serving", "qps_end_to_end"),
+        "cluster_qps_unpipelined": g("cluster_serving", "qps_unpipelined"),
+        "cluster_pipelining": g("cluster_serving", "pipelining_speedup"),
         "cluster_qps_b128": g("cluster_serving_b128", "qps_end_to_end"),
         "fail_completed": g("cluster_serving_failure", "completed"),
         "fail_detect_s": g("cluster_serving_failure", "detect_to_requeue_s"),
@@ -1151,6 +1153,11 @@ def main() -> None:
             if isinstance(v, dict)
         },
         "kv_int8_speedup": g("lm", "kv_cache_int8_4k_ctx_b8", "speedup"),
+        "kv_heads_tok_s": {
+            k: v.get("tok_per_s")
+            for k, v in g("lm", "decode_kv_heads_4k_ctx_b1", default={}).items()
+            if isinstance(v, dict)
+        },
         "cb_gain": g("lm", "continuous_batching", "batching_gain_8_vs_1"),
         "train_img_s": g("train", "resnet50_b32", "img_per_s"),
         "train_mfu": g("train", "resnet50_b32", "mfu_fwd_bwd"),
