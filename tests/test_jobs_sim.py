@@ -1127,3 +1127,111 @@ def test_decode_cache_unit(tmp_path):
         decode(svc, [files[i]], (224, 224))
     assert svc._decode_cache_used <= svc.decode_cache_bytes
     assert len(svc._decode_cache) <= 2
+
+
+async def test_pipeline_reordered_stage_before_primary(tmp_path):
+    """UDP reorder: the STAGE datagram outruns its same-round primary.
+    The worker must park the stage (not execute it — that would get it
+    cancelled as a 'preemption' when the primary lands) and the
+    stale-seq primary (a DIFFERENT batch of the same round) must still
+    run; the parked stage then promotes through the normal path. (The
+    same-key prepare-reuse branch is exercised separately below.)"""
+    from dml_tpu.cluster.wire import Message, MsgType
+
+    async with cluster(3, tmp_path, 23400) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        files = await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+        worker_u = next(
+            u for u in sim.jobs
+            if u != coord.node.me.unique_name
+        )
+        w = sim.jobs[worker_u]
+        leader_u = coord.node.me.unique_name
+        base = {"model": "ResNet50", "files": files,
+                "replicas": {}, "versions": {}, "inc": 7}
+
+        # stage arrives FIRST with the HIGHER seq
+        await w._h_task_request(Message(
+            sender=leader_u, type=MsgType.WORKER_TASK_REQUEST,
+            data={**base, "job": 99, "batch": 1, "staged": True, "seq": 6},
+        ), None)
+        assert w._staged is not None and w._staged[0] == (99, 1)
+        assert not w._running, "reordered stage must NOT execute eagerly"
+
+        # primary arrives second with the LOWER (stale) seq
+        await w._h_task_request(Message(
+            sender=leader_u, type=MsgType.WORKER_TASK_REQUEST,
+            data={**base, "job": 99, "batch": 0, "staged": False, "seq": 5},
+        ), None)
+        assert (99, 0) in w._running, "stale-seq primary must run when idle"
+        # the stage stays parked; promotion happens via the normal path
+        await sim.wait_for(
+            lambda: not w._running and w._staged is None,
+            timeout=15.0, what="both batches drained",
+        )
+
+
+async def test_pipeline_orphaned_stage_self_promotes(tmp_path):
+    """A stage whose primary was LOST entirely must self-promote after
+    a beat instead of stranding until the coordinator's resend."""
+    from dml_tpu.cluster.wire import Message, MsgType
+
+    async with cluster(3, tmp_path, 23500) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        files = await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+        worker_u = next(
+            u for u in sim.jobs if u != coord.node.me.unique_name
+        )
+        w = sim.jobs[worker_u]
+        await w._h_task_request(Message(
+            sender=coord.node.me.unique_name,
+            type=MsgType.WORKER_TASK_REQUEST,
+            data={"job": 98, "batch": 3, "model": "ResNet50",
+                  "files": files, "replicas": {}, "versions": {},
+                  "staged": True, "seq": 2, "inc": 3},
+        ), None)
+        assert w._staged is not None and not w._running
+        await sim.wait_for(
+            lambda: w._staged is None,
+            timeout=5.0, what="orphaned stage promoted",
+        )
+
+
+async def test_pipeline_promotion_resend_reuses_prepare(tmp_path):
+    """A primary assignment for the SAME key as the parked stage (the
+    coordinator's promotion resend) must reuse the stage's in-flight
+    prepare task rather than starting a second fetch+decode."""
+    from dml_tpu.cluster.wire import Message, MsgType
+
+    async with cluster(3, tmp_path, 23600) as sim:
+        await sim.wait_converged()
+        client_u = sim.by_name("H3")
+        files = await sim.seed_images(client_u, 2)
+        coord = sim.coordinator_jobs()
+        worker_u = next(
+            u for u in sim.jobs if u != coord.node.me.unique_name
+        )
+        w = sim.jobs[worker_u]
+        base = {"model": "ResNet50", "files": files,
+                "replicas": {}, "versions": {}, "inc": 9}
+        await w._h_task_request(Message(
+            sender=coord.node.me.unique_name,
+            type=MsgType.WORKER_TASK_REQUEST,
+            data={**base, "job": 97, "batch": 2, "staged": True, "seq": 3},
+        ), None)
+        assert w._staged is not None
+        prep_task = w._staged[3]
+        await w._h_task_request(Message(
+            sender=coord.node.me.unique_name,
+            type=MsgType.WORKER_TASK_REQUEST,
+            data={**base, "job": 97, "batch": 2, "staged": False, "seq": 4},
+        ), None)
+        assert w._staged is None and (97, 2) in w._running
+        # the execute must consume the ORIGINAL prepare, not re-fetch
+        await sim.wait_for(lambda: prep_task.done(), what="prepare consumed")
+        assert not prep_task.cancelled()
+        await sim.wait_for(lambda: not w._running, what="batch drained")
