@@ -82,3 +82,23 @@ def test_nowait_window_bound():
     np.testing.assert_allclose(
         probs, engine.infer_arrays("TinyNet", imgs), rtol=1e-6
     )
+
+
+def test_cluster_lm_serving_bench():
+    """The bench's distributed-LM-serving section machinery on CPU
+    with a tiny spec: prompts through the store -> scheduler -> LM
+    server -> merged outputs, end-to-end rates recorded."""
+    from bench import _bench_cluster_lm
+
+    out = {}
+    _bench_cluster_lm(
+        out, n_prompts=6, new_tokens=8, base_port=28951,
+        lm_overrides={"vocab_size": 128, "d_model": 32, "n_heads": 4,
+                      "n_kv_heads": 2, "n_layers": 2, "d_ff": 64,
+                      "dtype": "float32", "max_len": 64,
+                      "max_slots": 4},
+    )
+    cs = out["cluster_lm_serving"]
+    assert cs["prompts"] == 6
+    assert cs["prompts_per_s"] > 0
+    assert cs["gen_tok_per_s_end_to_end"] > 0
