@@ -218,6 +218,48 @@ def _bench_dual_c4(engine, out):
     }
 
 
+def _probe_tunnel():
+    """Host<->device link weather, recorded in every artifact: the
+    chip is remoted through a tunnel whose latency/bandwidth swing by
+    orders of magnitude between runs (observed 3-190 ms RTT, 0.03-1.4
+    GB/s upload on identical code), so absolute cluster-serving q/s
+    are only comparable across rounds TOGETHER with this probe.
+    On-device rates are immune (slope timing cancels the link);
+    anything that blocks per batch is not. Uses random (incompressible)
+    payloads — the link compresses, so zeros measure fiction."""
+    import statistics
+
+    import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    x = np.random.RandomState(0).randint(
+        0, 255, (32, 224, 224, 3), np.uint8
+    )
+    jax.device_put(x, dev).block_until_ready()  # warm the path
+    ups = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        jax.device_put(x, dev).block_until_ready()
+        ups.append(time.monotonic() - t0)
+    y = jax.device_put(
+        np.random.RandomState(1).standard_normal((32, 1000)).astype(np.float32),
+        dev,
+    )
+    y.block_until_ready()
+    rts = []
+    for _ in range(5):
+        t0 = time.monotonic()
+        np.asarray(y)
+        rts.append(time.monotonic() - t0)
+    up = statistics.median(ups)
+    return {
+        "upload_4p8mb_ms": round(up * 1e3, 1),
+        "upload_mb_per_s": round(4.8 / up, 1),
+        "readback_128kb_ms": round(statistics.median(rts) * 1e3, 1),
+    }
+
+
 def _cluster_stack(tmp, base_port, make_jobs):
     """Shared bring-up/teardown for the cluster bench sections: a
     fresh 4-node localhost cluster (introducer + UDP control plane +
@@ -248,6 +290,10 @@ def _cluster_stack(tmp, base_port, make_jobs):
         )
         dns = IntroducerService(spec)
         await dns.start()
+        # each service registers in `started` the moment its start()
+        # returns, so teardown reaps exactly what came up even when a
+        # later start() in the same node's tuple fails (stale port)
+        started = []
         stack = []
         try:
             for n in spec.nodes:
@@ -257,8 +303,11 @@ def _cluster_stack(tmp, base_port, make_jobs):
                 )
                 jobs = make_jobs(node, store)
                 await node.start()
+                started.append(node)
                 await store.start()
+                started.append(store)
                 await jobs.start()
+                started.append(jobs)
                 stack.append((node, store, jobs))
             for _ in range(100):
                 if all(n.joined and n.leader_unique for n, _, _ in stack):
@@ -271,10 +320,8 @@ def _cluster_stack(tmp, base_port, make_jobs):
                 )
             yield stack
         finally:
-            for node, store, jobs in reversed(stack):
-                await jobs.stop()
-                await store.stop()
-                await node.stop()
+            for svc in reversed(started):
+                await svc.stop()
             await dns.stop()
 
     return ctx()
@@ -1165,6 +1212,7 @@ def main() -> None:
     t_start = time.monotonic()
     engine = InferenceEngine()  # bfloat16, first visible device
 
+    out["tunnel"] = _probe_tunnel()
     _bench_models(engine, out)
     _bench_dual_c4(engine, out)
     _bench_cluster_serving(engine, out, failure_model="EfficientNetB4")
@@ -1241,6 +1289,7 @@ def main() -> None:
         "opt_batch": g("resnet50_throughput_optimal_batch"),
         "inception_mfu_b128": g("inceptionv3", default=[{}])[-1].get("mfu"),
         "b4_mfu_b128": g("efficientnet_b4", default=[{}])[-1].get("mfu"),
+        "tunnel_up_mbps": g("tunnel", "upload_mb_per_s"),
         "cluster_qps": g("cluster_serving", "qps_end_to_end"),
         "cluster_qps_unpipelined": g("cluster_serving", "qps_unpipelined"),
         "cluster_pipelining": g("cluster_serving", "pipelining_speedup"),

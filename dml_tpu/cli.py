@@ -70,6 +70,9 @@ job commands (ML inference):
   C2 <model>                        processing-time stats (mean/percentiles)
   C3 <model> <batch_size>           set batch size cluster-wide
   C5                                current worker->batch assignments
+                                    (incl. staged pipeline batches)
+  breakdown                         coordinator per-batch wall-time split +
+                                    worker pipeline/decode-cache stats
 observability:
   profile spans                     wall-clock span stats (store/job hot paths)
   profile trace start [dir]         capture a jax.profiler (XLA) trace
@@ -269,6 +272,12 @@ class NodeApp:
             print("ok")
         elif cmd == "C5":
             print(json.dumps(j.c5_assignments(), indent=2))
+        elif cmd == "breakdown":
+            print(json.dumps({
+                "per_batch_ms": j.breakdown_stats(),
+                "pipeline_depth": j.scheduler.pipeline_depth,
+                "decode_cache": j.decode_cache_stats(),
+            }, indent=2))
         else:
             print(f"unknown command {cmd!r} (try 'help')")
         return True

@@ -108,6 +108,9 @@ async def test_nodeapp_commands(tmp_path, capsys):
         assert "DONE: 4 queries" in out
         assert await app.handle("C1")
         assert await app.handle("C5")
+        assert await app.handle("breakdown")
+        out = capsys.readouterr().out
+        assert "decode_cache" in out and "pipeline_depth" in out
 
         # stats + errors
         assert await app.handle("bps")
