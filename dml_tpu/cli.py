@@ -275,7 +275,7 @@ class NodeApp:
         elif cmd == "breakdown":
             print(json.dumps({
                 "per_batch_ms": j.breakdown_stats(),
-                "pipeline_depth": j.scheduler.pipeline_depth,
+                "pipeline_depth": j.pipeline_depth,
                 "decode_cache": j.decode_cache_stats(),
             }, indent=2))
         else:

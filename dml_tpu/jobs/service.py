@@ -453,6 +453,12 @@ class JobService:
     def c5_assignments(self) -> Dict[str, Any]:
         return self.scheduler.c5_assignments()
 
+    @property
+    def pipeline_depth(self) -> int:
+        """Worker-pipelining depth (operator surface; the scheduler
+        owns the knob)."""
+        return self.scheduler.pipeline_depth
+
     def decode_cache_stats(self) -> Dict[str, int]:
         """Worker decoded-input cache counters (operator surface for
         the CLI `breakdown` verb)."""

@@ -100,10 +100,22 @@ def normalize_sharded(
     from jax.sharding import PartitionSpec as P
 
     spec = P("dp", *(None,) * (x.ndim - 1))
-    return shard_map(
-        partial(fused_normalize, mode=mode, dtype=dtype),
-        mesh=mesh, in_specs=(spec,), out_specs=spec,
-    )(x)
+    body = partial(fused_normalize, mode=mode, dtype=dtype)
+    # the pallas_call inside can't express varying-mesh-axes metadata
+    # on its out_shape, which jax>=0.8's shard_map rejects under its
+    # default check_vma=True; disable the check (the body is trivially
+    # per-shard). Older jax spells the flag check_rep.
+    try:
+        wrapped = shard_map(
+            body, mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older jax
+        wrapped = shard_map(
+            body, mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_rep=False,
+        )
+    return wrapped(x)
 
 
 def fused_normalize(

@@ -180,3 +180,30 @@ def test_lm_uses_flash_when_not_seq_sharded():
     l1 = lm.train_step(tokens)
     l2 = lm.train_step(tokens)
     assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1
+
+
+def test_normalize_sharded_mesh_path_compiles(monkeypatch):
+    """The shard_map(pallas) branch of normalize_sharded — the REAL
+    TPU mesh path the Trainer takes — exercised on the CPU mesh via
+    interpret mode (regression: jax>=0.8's shard_map rejects a
+    pallas_call out_shape under its default check_vma=True, which
+    crashed the on-chip train bench while every CPU test silently
+    took the jnp fallback)."""
+    import numpy as np
+
+    from dml_tpu.ops import preprocess as pre
+
+    monkeypatch.setattr(pre.jax, "default_backend", lambda: "tpu")
+    # force the pallas kernel to interpret on CPU
+    monkeypatch.setattr(pre, "_interpret_default", lambda: True)
+    from dml_tpu.parallel.mesh import local_mesh
+
+    mesh = local_mesh(dp=jax.device_count())
+    x = jnp.asarray(
+        np.random.RandomState(0).randint(
+            0, 255, (jax.device_count() * 2, 8, 8, 3), np.uint8
+        )
+    )
+    got = pre.normalize_sharded(x, "tf", jnp.float32, mesh)
+    want = normalize_on_device(x, "tf", jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
