@@ -161,12 +161,27 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
                 f"{fi.get('detect_to_requeue_s', 'n/a')} s, wall "
                 f"{fi.get('wall_s', 'n/a')} s"
             )
+        pipe_txt = ""
+        if "qps_unpipelined" in cs:
+            pipe_txt = (
+                f" — serial worker loop {cs['qps_unpipelined']} q/s → "
+                f"depth-2 pipelined "
+                f"{cs.get('qps_pipelined_cold_cache', 'n/a')} q/s "
+                f"({cs.get('pipelining_speedup', 'n/a')}×) → + decode "
+                f"cache {cs.get('qps_end_to_end', 'n/a')} q/s"
+            )
+        tun = m.get("tunnel") or {}
+        tun_txt = (
+            f"; link weather this run: {tun.get('upload_mb_per_s')} MB/s "
+            f"up, {tun.get('readback_128kb_ms')} ms readback"
+            if tun else ""
+        )
         row(
             f"Cluster serving end-to-end ({cs.get('nodes', '?')} nodes, "
             "SDFS-replicated JPEGs, batch 32)",
             "≈0.8 q/s/node (25-image task in ~31 s)",
             f"≈{cs.get('qps_end_to_end', 'n/a')} q/s through the full "
-            f"stack{extra}{fi_txt}",
+            f"stack{pipe_txt}{extra}{fi_txt}{tun_txt}",
         )
     pl = m.get("pallas_on_device") or {}
     if pl:
@@ -236,6 +251,45 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
                 f"1 slot {_num(s1)} → 8 slots {_num(s8)} tok/s aggregate "
                 f"({cb.get('batching_gain_8_vs_1', 'n/a')}×)",
             )
+    clm = m.get("cluster_lm_serving") or {}
+    if clm and "gen_tok_per_s_end_to_end" in clm:
+        row(
+            f"Distributed LM serving end-to-end ({clm.get('nodes', '?')} "
+            f"nodes, store-replicated prompts)",
+            "— (reference has no sequence serving)",
+            f"{clm.get('prompts', 'n/a')} prompts × "
+            f"{clm.get('new_tokens_per_prompt', 'n/a')} new tokens in "
+            f"{clm.get('wall_s', 'n/a')} s = "
+            f"{_num(clm['gen_tok_per_s_end_to_end'])} gen tok/s through "
+            "the full stack",
+        )
+    tr = m.get("train") or {}
+    cnn_tr = tr.get("resnet50_b32") or {}
+    if cnn_tr:
+        mfu = cnn_tr.get("mfu_fwd_bwd")
+        mfu_txt = (
+            f" ({mfu*100:.0f}% fwd+bwd MFU)"
+            if isinstance(mfu, (int, float)) else ""
+        )
+        row(
+            "ResNet50 train step (fwd+bwd+SGD, b32)",
+            "— (reference does no training)",
+            f"{_num(cnn_tr.get('img_per_s'))} img/s"
+            f"{mfu_txt}, {cnn_tr.get('step_ms', 'n/a')} ms/step",
+        )
+    lm_tr = tr.get("lm_198m_t2048") or {}
+    if lm_tr:
+        mfu = lm_tr.get("mfu_fwd_bwd")
+        mfu_txt = (
+            f" ({mfu*100:.0f}% fwd+bwd MFU)"
+            if isinstance(mfu, (int, float)) else ""
+        )
+        row(
+            "LM train step (198M, T=2048)",
+            "— (reference does no training)",
+            f"{_num(lm_tr.get('tok_per_s'))} tok/s"
+            f"{mfu_txt}, {lm_tr.get('step_ms', 'n/a')} ms/step",
+        )
     if isinstance(qps, (int, float)) and qps > 0:
         row("`vs_baseline` (bench.py headline)", "1×",
             f"≈{_num(qps / 4.0)}×")
