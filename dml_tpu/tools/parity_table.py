@@ -71,6 +71,14 @@ def _num(x, nd=0):
     return f"{x:,.{nd}f}"
 
 
+def _mfu_txt(mfu, label="MFU", prefix=" (", suffix=")"):
+    """'(54% MFU)'-style fragment, or empty when absent — the ONE
+    formatting site for MFU cells."""
+    if not isinstance(mfu, (int, float)):
+        return ""
+    return f"{prefix}{mfu * 100:.0f}% {label}{suffix}"
+
+
 def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
     """The markdown block, markers included. Missing sections render
     as 'n/a (pending next bench run)' so a schema change degrades the
@@ -84,10 +92,7 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
     hl = m.get("headline_resnet50_b32") or {}
     qps = hl.get("qps")
     if isinstance(qps, (int, float)) and qps > 0:
-        mfu = hl.get("mfu")
-        mfu_txt = (
-            f", {mfu*100:.0f}% MFU" if isinstance(mfu, (int, float)) else ""
-        )
+        mfu_txt = _mfu_txt(hl.get("mfu"), prefix=", ", suffix="")
         row(
             "ResNet50 steady inference",
             "250 ms/image (4 q/s/node)",
@@ -109,12 +114,10 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
         for p in points:
             if not isinstance(p.get("qps"), (int, float)):
                 continue
-            mfu = p.get("mfu")
-            mfu_txt = (
-                f" ({mfu*100:.0f}% MFU)"
-                if isinstance(mfu, (int, float)) else ""
+            out.append(
+                f"b{p.get('batch', '?')} ≈{p['qps']/1000:.1f}k q/s"
+                + _mfu_txt(p.get("mfu"))
             )
-            out.append(f"b{p.get('batch', '?')} ≈{p['qps']/1000:.1f}k q/s{mfu_txt}")
         return ", ".join(out)
 
     inc = m.get("inceptionv3") or []
@@ -172,8 +175,8 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
             )
         tun = m.get("tunnel") or {}
         tun_txt = (
-            f"; link weather this run: {tun.get('upload_mb_per_s')} MB/s "
-            f"up, {tun.get('readback_128kb_ms')} ms readback"
+            f"; link weather this run: {_num(tun.get('upload_mb_per_s'))} "
+            f"MB/s up, {_num(tun.get('readback_128kb_ms'), 1)} ms readback"
             if tun else ""
         )
         row(
@@ -266,29 +269,21 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
     tr = m.get("train") or {}
     cnn_tr = tr.get("resnet50_b32") or {}
     if cnn_tr:
-        mfu = cnn_tr.get("mfu_fwd_bwd")
-        mfu_txt = (
-            f" ({mfu*100:.0f}% fwd+bwd MFU)"
-            if isinstance(mfu, (int, float)) else ""
-        )
         row(
             "ResNet50 train step (fwd+bwd+SGD, b32)",
             "— (reference does no training)",
             f"{_num(cnn_tr.get('img_per_s'))} img/s"
-            f"{mfu_txt}, {cnn_tr.get('step_ms', 'n/a')} ms/step",
+            + _mfu_txt(cnn_tr.get("mfu_fwd_bwd"), label="fwd+bwd MFU")
+            + f", {cnn_tr.get('step_ms', 'n/a')} ms/step",
         )
     lm_tr = tr.get("lm_198m_t2048") or {}
     if lm_tr:
-        mfu = lm_tr.get("mfu_fwd_bwd")
-        mfu_txt = (
-            f" ({mfu*100:.0f}% fwd+bwd MFU)"
-            if isinstance(mfu, (int, float)) else ""
-        )
         row(
             "LM train step (198M, T=2048)",
             "— (reference does no training)",
             f"{_num(lm_tr.get('tok_per_s'))} tok/s"
-            f"{mfu_txt}, {lm_tr.get('step_ms', 'n/a')} ms/step",
+            + _mfu_txt(lm_tr.get("mfu_fwd_bwd"), label="fwd+bwd MFU")
+            + f", {lm_tr.get('step_ms', 'n/a')} ms/step",
         )
     if isinstance(qps, (int, float)) and qps > 0:
         row("`vs_baseline` (bench.py headline)", "1×",
@@ -377,6 +372,25 @@ def sanity_check(bench: Dict[str, Any]) -> List[str]:
     rng("lm.kv_int8.int8_tok_per_s",
         kq.get("int8_cache_tok_per_s"), 50, 1e5)
     rng("lm.kv_int8.speedup", kq.get("speedup"), 0.05, 20)
+    cs = m.get("cluster_serving") or {}
+    rng("cluster.qps", cs.get("qps_end_to_end"), 1, 1e4)
+    rng("cluster.qps_unpipelined", cs.get("qps_unpipelined"), 1, 1e4)
+    rng("cluster.pipelining_speedup", cs.get("pipelining_speedup"), 0.2, 20)
+    clm = m.get("cluster_lm_serving") or {}
+    rng("cluster_lm.gen_tok_per_s",
+        clm.get("gen_tok_per_s_end_to_end"), 0.5, 1e5)
+    tr = m.get("train") or {}
+    cnn_tr = tr.get("resnet50_b32") or {}
+    rng("train.cnn.img_per_s", cnn_tr.get("img_per_s"), 10, 1e5)
+    rng("train.cnn.step_ms", cnn_tr.get("step_ms"), 0.5, 1e4)
+    rng("train.cnn.mfu", cnn_tr.get("mfu_fwd_bwd"), 0.01, 1.0)
+    lm_tr = tr.get("lm_198m_t2048") or {}
+    rng("train.lm.tok_per_s", lm_tr.get("tok_per_s"), 100, 1e7)
+    rng("train.lm.step_ms", lm_tr.get("step_ms"), 0.5, 1e4)
+    rng("train.lm.mfu", lm_tr.get("mfu_fwd_bwd"), 0.01, 1.0)
+    tun = m.get("tunnel") or {}
+    rng("tunnel.upload_mb_per_s", tun.get("upload_mb_per_s"), 0.1, 1e5)
+    rng("tunnel.readback_ms", tun.get("readback_128kb_ms"), 0.01, 1e4)
     # a numerically broken kernel must not publish its speedup rows:
     # parity_pass=False is a hard refusal, not a table footnote
     if pl and pl.get("parity_pass", True) is False:
