@@ -125,10 +125,7 @@ class LMBackend:
             # preempted decode is queueing, not this batch's cost —
             # it must not inflate the scheduler's per_query model
             t0 = time.monotonic()
-            rids = [
-                self.server.submit(prompt, self.max_new_tokens)
-                for prompt in prompts
-            ]
+            rids = self.server.submit_many(prompts, self.max_new_tokens)
             done = self.server.run()
             infer_time = time.monotonic() - t0
         if paths:

@@ -58,7 +58,7 @@ the job scheduler's one-batch-per-worker fair-share loop
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -217,6 +217,9 @@ class LMServer:
     def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
         """Queue a request; returns its request id. Placement happens
         immediately if a slot is free, else at the next step()."""
+        return self.submit_many([prompt], max_new_tokens)[0]
+
+    def _validate(self, prompt: np.ndarray, max_new_tokens: int) -> np.ndarray:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -230,13 +233,36 @@ class LMServer:
                 f"prompt {prompt.size} + budget {max_new_tokens} "
                 f"exceeds max_len {self.max_len}"
             )
-        self._rid += 1
-        req = _Request(self._rid, prompt, max_new_tokens)
-        self._queue.append(req)
+        return prompt
+
+    def submit_many(
+        self, prompts: Sequence[np.ndarray], max_new_tokens: int
+    ) -> List[int]:
+        """Queue a burst of requests and place them in ONE batched
+        round: per-request placement drains one scalar per call, which
+        through a remoted chip costs a full link round-trip each — a
+        burst of max_slots prompts pays max_slots round-trips where
+        one suffices. Validates EVERY prompt before queueing ANY
+        (atomic), preserving sequential submit()'s rid order."""
+        validated = [self._validate(p, max_new_tokens) for p in prompts]
+        reqs = []
+        for prompt in validated:
+            self._rid += 1
+            reqs.append(_Request(self._rid, prompt, max_new_tokens))
+        self._queue.extend(reqs)
         self._place_waiting()
-        return req.rid
+        return [r.rid for r in reqs]
 
     def _place_waiting(self) -> None:
+        # Phase 1: DISPATCH every placement (prefill, cache insert,
+        # first-token sample) without touching the host — JAX queues
+        # them asynchronously. Phase 2 drains ONE concatenated scalar
+        # vector. The previous per-request np.asarray of the full
+        # [vocab] logits plus the sampled token cost two blocking link
+        # round-trips per prompt; through a remoted chip (~100 ms
+        # readback) that serialized placement into the dominant cost
+        # of distributed LM serving (bench `cluster_lm_serving`).
+        placed = []  # (slot, req, tp, device first-token [1])
         for slot in range(self.max_slots):
             if self._slot_req[slot] is not None or not self._queue:
                 continue
@@ -259,16 +285,24 @@ class LMServer:
             self.cache = self._insert(
                 self.cache, pcache, jnp.int32(slot), jnp.int32(tp)
             )
-            first_logits = np.asarray(logits[0])
             # the first generated token occupies position tp — same
-            # (rid, position) stream the chunk sampler continues
+            # (rid, position) stream the chunk sampler continues;
+            # sampled ON DEVICE from the same [1, vocab] logits the
+            # host hop used to round-trip (values identical)
             sub = jax.random.fold_in(
                 jax.random.fold_in(self._base_rng, req.rid), tp
             )
-            first = int(np.asarray(
-                _sample(jnp.asarray(first_logits[None]), sub,
-                        self.temperature, self.top_k)
-            )[0])
+            first_dev = _sample(
+                logits, sub, self.temperature, self.top_k
+            )
+            placed.append((slot, req, tp, first_dev))
+        if not placed:
+            return
+        firsts = np.asarray(
+            jnp.concatenate([f for (_, _, _, f) in placed])
+        )
+        for (slot, req, tp, _), first in zip(placed, firsts.tolist()):
+            first = int(first)
             req.out.append(first)
             req.slot = slot
             self._slot_req[slot] = req
@@ -298,8 +332,14 @@ class LMServer:
             self.params, self.cache, jnp.asarray(self.cur),
             jnp.asarray(self.pos), jnp.asarray(self.rid_vec),
         )
-        toks = np.asarray(toks)  # [chunk, slots]
-        cur, pos = np.asarray(cur), np.asarray(pos)
+        # ONE packed readback: toks/cur/pos are three separate device
+        # buffers, and each blocking np.asarray costs a full link
+        # round-trip on a remoted chip
+        packed = np.asarray(jnp.concatenate([jnp.ravel(toks), cur]))
+        n = self.chunk * self.max_slots
+        toks = packed[:n].reshape(self.chunk, self.max_slots)
+        cur = packed[n:]
+        del pos  # host self.pos is advanced per-slot below
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue

@@ -349,6 +349,15 @@ def sanity_check(bench: Dict[str, Any]) -> List[str]:
     hl = m.get("headline_resnet50_b32") or {}
     rng("headline.qps", hl.get("qps"), 1e3, 1e5)
     rng("headline.mfu", hl.get("mfu"), 0.05, 1.0)
+    for pt in m.get("resnet50_sweep") or []:
+        rng(f"sweep.b{pt.get('batch')}.qps", pt.get("qps"), 1e3, 1e5)
+        rng(f"sweep.b{pt.get('batch')}.mfu", pt.get("mfu"), 0.01, 1.0)
+    for section, lo, hi in (
+        ("inceptionv3", 100, 5e4), ("efficientnet_b4", 50, 2e4)
+    ):
+        for pt in m.get(section) or []:
+            rng(f"{section}.b{pt.get('batch')}.qps", pt.get("qps"), lo, hi)
+            rng(f"{section}.b{pt.get('batch')}.mfu", pt.get("mfu"), 0.01, 1.0)
     pl = m.get("pallas_on_device") or {}
     rng("pallas.flash_fwd_ms", pl.get("flash_fwd_ms"), 0.2, 50)
     rng("pallas.flash_vs_naive_speedup",

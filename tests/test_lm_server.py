@@ -146,3 +146,32 @@ def test_sampled_request_independent_of_batch(params):
     np.testing.assert_array_equal(out_alone[ra], out_packed[ra2])
     # and the second request actually produced tokens under sampling
     assert len(out_packed[rb]) == 6
+
+
+def test_submit_many_matches_sequential_submit(params):
+    """submit_many (one batched placement round) must produce the
+    same rids and the same outputs as sequential submit() calls."""
+    prompts = [
+        np.array([1, 2, 3], np.int32),
+        np.array([4, 5], np.int32),
+        np.array([6], np.int32),
+    ]
+    a = LMServer(params, CFG, max_slots=2, max_len=32, chunk=4)
+    rids_a = [a.submit(p, 6) for p in prompts]
+    out_a = a.run()
+    b = LMServer(params, CFG, max_slots=2, max_len=32, chunk=4)
+    rids_b = b.submit_many(prompts, 6)
+    out_b = b.run()
+    assert rids_a == rids_b
+    for ra, rb in zip(rids_a, rids_b):
+        np.testing.assert_array_equal(out_a[ra], out_b[rb])
+
+
+def test_submit_many_validates_before_queueing(params):
+    srv = LMServer(params, CFG, max_slots=2, max_len=8, chunk=2)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        srv.submit_many(
+            [np.array([1, 2], np.int32), np.arange(7, dtype=np.int32)], 4
+        )
+    # the valid first prompt must not have been queued by the failed call
+    assert not srv._queue
