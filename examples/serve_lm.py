@@ -13,7 +13,11 @@ Shows the three serving layers working together:
    weight-only int8 tree for memory-constrained chips.
 """
 
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
