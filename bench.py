@@ -1,7 +1,15 @@
-"""Bench matrix for the TPU serving stack. Prints ONE JSON line.
+"""Bench matrix for the TPU serving stack.
+
+Output protocol (VERDICT r4 item 1): one compact JSON line per section
+AS IT COMPLETES (so a mid-run kill leaves every finished measurement in
+the stdout tail), then the combined artifact as the FINAL line with the
+summary as its last key. A global wall budget (default 1,200 s of
+section starts, `DML_TPU_BENCH_BUDGET_S`) skips remaining secondary
+sections rather than running into the driver's timeout; SIGTERM/SIGINT
+jump straight to the final combined print.
 
 Headline: ResNet50 batch=32 inference throughput per chip (the
-BASELINE.json north-star). The line also carries the full matrix:
+BASELINE.json north-star). The final line also carries the full matrix:
 
 - ResNet50 batch sweep 16..256 with q/s + MFU per point (the headline
   batch is justified by the sweep, not assumed);
@@ -33,6 +41,78 @@ from __future__ import annotations
 import json
 import os
 import time
+
+
+class _Interrupted(BaseException):
+    """Raised from the SIGTERM/SIGINT handler: unwinds the section loop
+    (past the fail-soft `except Exception` nets) into main()'s final
+    print, so a driver kill still emits the combined artifact for
+    everything measured. BaseException on purpose."""
+
+
+def run_sections(sections, out, *, t_start, budget_s, fatal=(),
+                 stream=None):
+    """Run bench sections with streaming output + a global wall budget
+    (VERDICT r4 item 1).
+
+    `sections` is [(name, thunk)]. After each section completes, the
+    top-level keys it added to `out` are printed as ONE compact JSON
+    line (``{"section": ..., "wall_s": ..., "data": {...}}``) so any
+    mid-run kill leaves every finished measurement in the stdout tail.
+    Before each section, the global wall budget is checked: once
+    ``budget_s`` is exceeded, remaining non-fatal sections are recorded
+    under ``out["_skipped"]`` and not run — the run jumps to the final
+    summary print instead of being timeout-killed into an empty
+    artifact (the round-4 failure mode: rc=124, no numbers).
+
+    Sections in `fatal` propagate exceptions (a run without the
+    headline is not an artifact); others fail soft under
+    ``out["_errors"]``, keeping any partial results they wrote.
+    Per-section wall times land in ``out["_section_wall_s"]`` so the
+    next round can see where the budget went.
+    """
+    if stream is None:
+        def stream(line):
+            print(line, flush=True)
+
+    for name, thunk in sections:
+        elapsed = time.monotonic() - t_start
+        if elapsed > budget_s and name not in fatal:
+            reason = (
+                f"wall budget {budget_s:.0f}s exceeded at {elapsed:.0f}s"
+            )
+            out.setdefault("_skipped", {})[name] = reason
+            stream(json.dumps(
+                {"section": name, "skipped": "wall_budget",
+                 "elapsed_s": round(elapsed, 1)},
+                separators=(",", ":")))
+            continue
+        before = set(out)
+        t0 = time.monotonic()
+        try:
+            thunk()
+        except Exception as e:
+            if name in fatal:
+                raise
+            import traceback
+
+            traceback.print_exc()
+            # errors live under their own key: a section that wrote
+            # partial results before tripping keeps what it measured
+            out.setdefault("_errors", {})[name] = repr(e)
+        wall = time.monotonic() - t0
+        out.setdefault("_section_wall_s", {})[name] = round(wall, 1)
+        new = {
+            k: out[k] for k in out
+            if k not in before and not k.startswith("_")
+        }
+        stream(json.dumps(
+            {"section": name, "wall_s": round(wall, 1),
+             "elapsed_s": round(time.monotonic() - t_start, 1),
+             "error": out.get("_errors", {}).get(name),
+             "data": new},
+            separators=(",", ":"), default=str))
+    return out
 
 
 def _bench_models(engine, out):
@@ -588,40 +668,80 @@ def _bench_cluster_lm(out, *, n_prompts=64, new_tokens=32, base_port=28821,
             jobs.register_lm("BenchLM", backend=be.backend, cost=be.cost())
             return jobs
 
-        async with _cluster_stack(tmp, base_port, make_jobs) as stack:
-            client_store, client_jobs = stack[-1][1], stack[-1][2]
-            rng = np.random.RandomState(0)
-            for i in range(n_prompts):
-                prompt = rng.randint(
-                    0, lm_spec["vocab_size"], int(rng.randint(8, 48))
-                )
-                p = os.path.join(tmp, f"prompt_{i}.tokens.txt")
-                write_prompt_file(p, prompt)
-                await client_store.put(p, f"prompt_{i}.tokens.txt")
-            t0 = time.monotonic()
-            job_id = await client_jobs.submit_job("BenchLM", n_prompts)
-            done = await client_jobs.wait_job(job_id, timeout=600.0)
-            wall = time.monotonic() - t0
-            assert done["total_queries"] == n_prompts
-            merged = await client_jobs.get_output(
-                job_id, os.path.join(tmp, "lm_out.json")
-            )
-            gen_tokens = sum(
-                len(v.get("tokens", [])) for v in merged.values()
-            )
-            out["cluster_lm_serving"] = {
-                "nodes": 4,
-                "prompts": n_prompts,
-                "new_tokens_per_prompt": new_tokens,
-                "wall_s": round(wall, 2),
-                "prompts_per_s": round(n_prompts / wall, 2),
-                "gen_tok_per_s_end_to_end": round(gen_tokens / wall, 1),
-                "note": "full stack: store-replicated prompt files -> "
-                        "fair-share scheduler -> continuous-batching LM "
-                        "server -> merged outputs; outputs are exactly "
-                        "isolated generate() per prompt (LMServer "
-                        "batching-exactness contract)",
-            }
+        try:
+            async with _cluster_stack(tmp, base_port, make_jobs) as stack:
+                client_store, client_jobs = stack[-1][1], stack[-1][2]
+                rng = np.random.RandomState(0)
+                for i in range(n_prompts):
+                    prompt = rng.randint(
+                        0, lm_spec["vocab_size"], int(rng.randint(8, 48))
+                    )
+                    p = os.path.join(tmp, f"prompt_{i}.tokens.txt")
+                    write_prompt_file(p, prompt)
+                    await client_store.put(p, f"prompt_{i}.tokens.txt")
+
+                async def timed_job():
+                    t0 = time.monotonic()
+                    job_id = await client_jobs.submit_job(
+                        "BenchLM", n_prompts
+                    )
+                    done = await client_jobs.wait_job(job_id, timeout=600.0)
+                    wall = time.monotonic() - t0
+                    assert done["total_queries"] == n_prompts
+                    merged = await client_jobs.get_output(
+                        job_id, os.path.join(tmp, "lm_out.json")
+                    )
+                    gen = sum(
+                        len(v.get("tokens", [])) for v in merged.values()
+                    )
+                    return wall, gen
+
+                # warm every compile the timed jobs will hit (prefill
+                # buckets 16/32/64 for the 8..48-token prompts, the
+                # chunk fn, insert) so the serial-vs-overlap ratio
+                # compares pipelining, not who paid the XLA compiles
+                warm = [
+                    os.path.join(tmp, f"warm_{n}.tokens.txt")
+                    for n in (8, 20, 40)
+                ]
+                for p, n in zip(warm, (8, 20, 40)):
+                    write_prompt_file(
+                        p, rng.randint(0, lm_spec["vocab_size"], n)
+                    )
+                await asyncio.to_thread(be.serve_files, warm)
+
+                # in-run serial baseline: the r3/r4 shape — workers
+                # lock-serialize on the shared server, next batch's
+                # decode starts only after the current one drains
+                be.overlap = False
+                wall_serial, gen_serial = await timed_job()
+                # overlapped: all workers feed one continuous-batching
+                # LMDriver (cross-batch slot sharing + promote-at-
+                # dispatch), VERDICT r4 item 2
+                be.overlap = True
+                steps0 = be.driver.steps  # warmup ran through the driver
+                wall, gen_tokens = await timed_job()
+                out["cluster_lm_serving"] = {
+                    "nodes": 4,
+                    "prompts": n_prompts,
+                    "new_tokens_per_prompt": new_tokens,
+                    "wall_s": round(wall, 2),
+                    "prompts_per_s": round(n_prompts / wall, 2),
+                    "gen_tok_per_s_end_to_end": round(gen_tokens / wall, 1),
+                    "gen_tok_per_s_serial": round(gen_serial / wall_serial, 1),
+                    "overlap_speedup": round(wall_serial / wall, 2),
+                    "driver_steps": be.driver.steps - steps0,
+                    "note": "full stack: store-replicated prompt files -> "
+                            "fair-share scheduler -> continuous-batching "
+                            "LMDriver (one slot grid shared across "
+                            "batches + promote-at-dispatch) -> merged "
+                            "outputs; serial row = the lock-serialized "
+                            "r4 path, same run, same cluster; outputs "
+                            "are exactly isolated generate() per prompt "
+                            "(LMServer batching-exactness contract)",
+                }
+        finally:
+            be.close()
 
     asyncio.run(run())
 
@@ -1204,57 +1324,15 @@ def _bench_lm(
     lm["continuous_batching"] = slots
 
 
-def main() -> None:
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR", "/tmp/dml_tpu_jax_cache_tpu"
-    )
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+def _bench_ring_vs_ulysses(out):
+    """Ring vs Ulysses collective footprint (VERDICT r3 item 10): runs
+    on a virtual 8-device CPU mesh in a subprocess (the sp axis needs
+    multiple devices; the bench chip is one) — the collective structure
+    in the lowered HLO is what transfers to a pod."""
+    import subprocess
+    import sys as _sys
 
-    import jax
-
-    from dml_tpu.inference.engine import InferenceEngine
-
-    out = {}
-    t_start = time.monotonic()
-    engine = InferenceEngine()  # bfloat16, first visible device
-
-    out["tunnel"] = _probe_tunnel()
-    # the headline section stays FATAL — a run without it is not an
-    # artifact. Secondary sections fail soft: one section tripping on
-    # a chip-only path must not destroy the whole round's perf record
-    # (r4: a shard_map/pallas interaction in the train section rc=1'd
-    # an otherwise complete 30-minute run).
-    _bench_models(engine, out)
-
-    def section(name, fn, *a, **kw):
-        try:
-            fn(*a, **kw)
-        except Exception as e:  # pragma: no cover
-            import traceback
-
-            traceback.print_exc()
-            # errors live under their own key: a section that wrote
-            # partial results before tripping (e.g. cluster_serving's
-            # b32 matrix before the failure-injection phase) keeps
-            # what it measured
-            out.setdefault("_errors", {})[name] = repr(e)
-
-    section("dual_model_c4", _bench_dual_c4, engine, out)
-    section("cluster_serving", _bench_cluster_serving, engine, out,
-            failure_model="EfficientNetB4")
-    section("pallas_on_device", _bench_pallas, out)
-    section("train", _bench_train, engine, out)
-    section("lm", _bench_lm, out, engine=engine)
-    section("cluster_lm_serving", _bench_cluster_lm, out)
-
-    # ring vs ulysses collective footprint (VERDICT r3 item 10): runs
-    # on a virtual 8-device CPU mesh in a subprocess (the sp axis
-    # needs multiple devices; the bench chip is one) — the collective
-    # structure in the lowered HLO is what transfers to a pod
     try:
-        import subprocess
-        import sys as _sys
-
         env = {k: v for k, v in os.environ.items()
                if k != "PALLAS_AXON_POOL_IPS"}
         env["JAX_PLATFORMS"] = "cpu"
@@ -1275,9 +1353,11 @@ def main() -> None:
     except Exception as e:  # pragma: no cover
         out["ring_vs_ulysses"] = {"skipped": True, "reason": repr(e)}
 
-    # imagenet parity vs reference goldens (skips with reason in
-    # hermetic environments; full label-match report when weights are
-    # obtainable at bench time)
+
+def _bench_imagenet_parity(out):
+    """Imagenet parity vs reference goldens (skips with reason in
+    hermetic environments; full label-match report when weights are
+    obtainable at bench time)."""
     try:
         import contextlib
         import sys
@@ -1285,13 +1365,82 @@ def main() -> None:
         from dml_tpu.tools.imagenet_parity import run_parity
 
         # keras prints download progress to stdout; keep stdout pure
-        # for the single JSON line
+        # for the JSON artifact lines
         with contextlib.redirect_stdout(sys.stderr):
             out["imagenet_parity"] = run_parity()
     except Exception as e:  # pragma: no cover
         out["imagenet_parity"] = {"skipped": True, "reason": repr(e)}
 
-    hl = out["headline_resnet50_b32"]
+
+def main() -> None:
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/dml_tpu_jax_cache_tpu"
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+    import signal
+
+    import jax
+
+    from dml_tpu.inference.engine import InferenceEngine
+
+    out = {}
+    t_start = time.monotonic()
+    # Global wall budget (VERDICT r4 item 1): the r3 driver envelope
+    # accepted a 1,750 s run and killed the r4 2,214 s one; 1,200 s of
+    # section starts keeps the total (last section may overrun its
+    # start check) comfortably ≤ ~1,400 s.
+    budget_s = float(os.environ.get("DML_TPU_BENCH_BUDGET_S", "1200"))
+
+    def _on_signal(signum, frame):  # pragma: no cover - signal path
+        raise _Interrupted(f"signal {signum}")
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    interrupted = None
+
+    # The interrupt window covers EVERYTHING before the final print —
+    # engine init and the tunnel probe included — so a driver kill at
+    # any point still falls through to the combined artifact below.
+    try:
+        engine = InferenceEngine()  # bfloat16, first visible device
+
+        out["tunnel"] = _probe_tunnel()
+        print(json.dumps({"section": "tunnel", "data": out["tunnel"]},
+                         separators=(",", ":")), flush=True)
+
+        # The headline section is FATAL — a run without it is not an
+        # artifact. Secondary sections fail soft inside run_sections:
+        # one section tripping on a chip-only path must not destroy
+        # the whole round's perf record. Ordering: engine-model (CNN)
+        # sections stay adjacent (no weight reloads), then the LM
+        # sections (which unload the CNNs for HBM headroom), then
+        # train/pallas; the CPU-subprocess and parity sections run
+        # last — they are the right ones to lose to the wall budget.
+        sections = [
+            ("models", lambda: _bench_models(engine, out)),
+            ("dual_model_c4", lambda: _bench_dual_c4(engine, out)),
+            ("cluster_serving", lambda: _bench_cluster_serving(
+                engine, out, failure_model="EfficientNetB4")),
+            ("lm", lambda: _bench_lm(out, engine=engine)),
+            ("cluster_lm_serving", lambda: _bench_cluster_lm(out)),
+            ("train", lambda: _bench_train(engine, out)),
+            ("pallas_on_device", lambda: _bench_pallas(out)),
+            ("ring_vs_ulysses", lambda: _bench_ring_vs_ulysses(out)),
+            ("imagenet_parity", lambda: _bench_imagenet_parity(out)),
+        ]
+        run_sections(sections, out, t_start=t_start, budget_s=budget_s,
+                     fatal={"models"})
+    except _Interrupted as e:  # driver kill: still print the artifact
+        interrupted = str(e)
+    # from here on signals are IGNORED either way: a follow-up SIGTERM
+    # (drivers often send a second one before SIGKILL) must not
+    # truncate the final combined print
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+
+    hl = out.get("headline_resnet50_b32", {})
     baseline_qps = 4.0  # reference: 250 ms/image CPU steady state
 
     # Compact roll-up of every headline number, emitted as the LAST
@@ -1309,9 +1458,9 @@ def main() -> None:
 
     lm_forms = g("lm", "decode_weight_forms_b1", default={})
     summary = {
-        "headline_qps": hl["qps"],
+        "headline_qps": hl.get("qps"),
         "headline_qps_range": hl.get("qps_range"),
-        "headline_mfu": hl["mfu"],
+        "headline_mfu": hl.get("mfu"),
         "opt_batch": g("resnet50_throughput_optimal_batch"),
         "inception_mfu_b128": g("inceptionv3", default=[{}])[-1].get("mfu"),
         "b4_mfu_b128": g("efficientnet_b4", default=[{}])[-1].get("mfu"),
@@ -1341,30 +1490,39 @@ def main() -> None:
         "train_lm_tok_s": g("train", "lm_198m_t2048", "tok_per_s"),
         "pallas_parity": g("pallas_on_device", "parity_pass"),
         "imagenet_parity": (
-            "skipped" if g("imagenet_parity", "skipped") else "ran"
+            "not_run" if "imagenet_parity" not in out
+            else "skipped" if g("imagenet_parity", "skipped") else "ran"
         ),
         # fail-soft sections that tripped (empty = clean run); their
         # tracebacks are on stderr and partial results stay in place
         "section_errors": sorted(out.get("_errors", {})),
+        # sections the wall budget skipped (empty = everything ran)
+        "sections_skipped": sorted(out.get("_skipped", {})),
+        "section_wall_s": out.get("_section_wall_s", {}),
     }
+    if interrupted:
+        summary["interrupted"] = interrupted
 
     print(json.dumps({
         "metric": "ResNet50 b32 inference throughput per chip",
-        "value": hl["qps"],
+        "value": hl.get("qps"),
         "unit": "queries/sec",
-        "vs_baseline": round(hl["qps"] / baseline_qps, 2),
-        "mfu": hl["mfu"],
-        "batch_latency_p50_ms": hl["batch_latency_p50_ms"],
-        "batch_latency_p99_ms": hl["batch_latency_p99_ms"],
-        "query_latency_p50_ms": hl["query_latency_p50_ms"],
-        "query_latency_p99_ms": hl["query_latency_p99_ms"],
+        "vs_baseline": (
+            round(hl["qps"] / baseline_qps, 2) if hl.get("qps") else None
+        ),
+        "mfu": hl.get("mfu"),
+        "batch_latency_p50_ms": hl.get("batch_latency_p50_ms"),
+        "batch_latency_p99_ms": hl.get("batch_latency_p99_ms"),
+        "query_latency_p50_ms": hl.get("query_latency_p50_ms"),
+        "query_latency_p99_ms": hl.get("query_latency_p99_ms"),
         "device": str(jax.devices()[0]),
         "dtype": "bfloat16",
         "batch_size": 32,
         "bench_wall_s": round(time.monotonic() - t_start, 1),
+        "wall_budget_s": budget_s,
         "matrix": out,
         "summary": summary,  # keep LAST: must survive the driver tail
-    }))
+    }, default=str), flush=True)
 
 
 if __name__ == "__main__":

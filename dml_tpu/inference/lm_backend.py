@@ -30,7 +30,7 @@ import numpy as np
 
 from ..jobs.cost_model import ModelCost
 from .generate import LMConfig
-from .lm_server import LMServer
+from .lm_server import LMDriver, LMServer
 
 
 def parse_prompt_file(path: str, vocab_size: int) -> np.ndarray:
@@ -88,22 +88,38 @@ class LMBackend:
         # measured serving constants for the scheduler's cost model
         # (folded from real ACKs after the first batch either way)
         self._per_query = 0.05
-        # the LMServer is MUTABLE state. When the scheduler preempts a
-        # worker (jobs/service.py _h_task_request), the host-side task
-        # is cancelled at its await but the to_thread decode keeps
-        # running to completion in the background — without this lock
-        # the replacement batch would drive the same server
-        # concurrently and corrupt the slot grid (observed as KeyErrors
-        # under fair-share preemption). The orphaned run finishes,
-        # drains its slots, and its result is simply discarded.
+        # Concurrency: the LMServer is single-threaded MUTABLE state,
+        # but serving callers are many (co-located workers, preemption
+        # orphans). Two modes (VERDICT r4 item 2):
+        #
+        # - overlap=True (default): all callers feed ONE LMDriver —
+        #   their prompts merge into the same slot grid, so batch N+1
+        #   prefills into freed slots while batch N is still decoding
+        #   and per-chunk link round-trips amortize over everything in
+        #   flight (see LMDriver's docstring for why this beats
+        #   per-worker servers on one chip).
+        # - overlap=False: the round-3/4 lock-serialized path, kept as
+        #   the bench's in-run serial baseline. When the scheduler
+        #   preempts a worker the host-side task is cancelled at its
+        #   await but the to_thread decode keeps running — the lock
+        #   stops the replacement batch from corrupting the slot grid;
+        #   under the driver the same orphan simply finishes its
+        #   ticket and nobody reads it.
+        self.overlap = True
         self._serve_lock = threading.Lock()
+        # the driver takes the SAME lock the serial mode holds across
+        # a whole run(): a mode flip racing an orphaned serial decode
+        # can never interleave two drivers of one slot grid
+        self.driver = LMDriver(self.server, server_lock=self._serve_lock)
 
     def serve_files(
-        self, paths: Sequence[str]
+        self, paths: Sequence[str], on_dispatch=None
     ) -> Tuple[Dict[str, Any], float, Dict[str, float]]:
         """Decode every prompt file; returns (results keyed by path,
         decode seconds, cost constants) — the sync core of
-        `backend()`."""
+        `backend()`. `on_dispatch` (overlap mode) fires once the
+        prompts are submitted to the shared driver, so the caller's
+        pipeline can promote its next staged batch immediately."""
         prompts = [
             parse_prompt_file(p, self.cfg.vocab_size) for p in paths
         ]
@@ -120,30 +136,59 @@ class LMBackend:
                     f"{self.max_new_tokens} exceeds the server's "
                     f"max_len {self.server.max_len}"
                 )
-        with self._serve_lock:
-            # clock starts INSIDE the lock: waiting out an orphaned
-            # preempted decode is queueing, not this batch's cost —
-            # it must not inflate the scheduler's per_query model
+        if self.overlap:
             t0 = time.monotonic()
-            rids = self.server.submit_many(prompts, self.max_new_tokens)
-            done = self.server.run()
+            toks = self.driver.serve(
+                prompts, self.max_new_tokens, on_dispatch=on_dispatch
+            )
             infer_time = time.monotonic() - t0
+            results = {
+                p: {"tokens": [int(t) for t in ts]}
+                for p, ts in zip(paths, toks)
+            }
+        else:
+            with self._serve_lock:
+                # clock starts INSIDE the lock: waiting out an orphaned
+                # preempted decode is queueing, not this batch's cost —
+                # it must not inflate the scheduler's per_query model
+                t0 = time.monotonic()
+                rids = self.server.submit_many(
+                    prompts, self.max_new_tokens
+                )
+                # run(rids): drain only OUR requests — a bare run()
+                # would also consume (and discard) results of any
+                # in-flight driver tickets sharing the grid
+                done = self.server.run(rids)
+                infer_time = time.monotonic() - t0
+            results = {
+                p: {"tokens": [int(t) for t in done[rid]]}
+                for p, rid in zip(paths, rids)
+            }
         if paths:
+            # overlap mode: a ticket's wall includes sharing the grid
+            # with other in-flight batches — that IS its marginal
+            # serving cost, which is what the fair-share model wants
             self._per_query = infer_time / len(paths)
-        results = {
-            p: {"tokens": [int(t) for t in done[rid]]}
-            for p, rid in zip(paths, rids)
-        }
         return results, infer_time, self.cost_constants()
 
     async def backend(
-        self, model: str, paths: Sequence[str]
+        self, model: str, paths: Sequence[str], on_dispatch=None
     ) -> Tuple[Dict[str, Any], float, Dict[str, float]]:
         """JobService-compatible coroutine; the blocking decode runs in
         a thread so the node's event loop stays live (same pattern as
-        the engine's infer_files_async)."""
+        the engine's infer_files_async). Declaring `on_dispatch` opts
+        in to the job pipeline's promote-at-dispatch (jobs/service.py
+        detects the parameter): the staged next batch starts the
+        moment this batch's prompts are in the driver's grid."""
         del model
-        return await asyncio.to_thread(self.serve_files, paths)
+        return await asyncio.to_thread(
+            self.serve_files, paths, on_dispatch
+        )
+
+    def close(self) -> None:
+        """Stop the driver thread (idempotent); in-flight work
+        finishes first."""
+        self.driver.stop()
 
     def cost_constants(self) -> Dict[str, float]:
         return {

@@ -58,7 +58,8 @@ the job scheduler's one-batch-per-worker fair-share loop
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -351,14 +352,242 @@ class LMServer:
                 self._retire(slot)
         self._place_waiting()
 
-    def run(self) -> Dict[int, np.ndarray]:
-        """Drive until every submitted request finishes; returns
-        {rid: generated tokens}."""
-        while self._queue or any(r is not None for r in self._slot_req):
-            self.step()
+    def has_work(self) -> bool:
+        """True while any request is queued or occupying a slot."""
+        return bool(self._queue) or any(
+            r is not None for r in self._slot_req
+        )
+
+    def take_done(self) -> Dict[int, np.ndarray]:
+        """Drain finished requests: {rid: generated tokens}. The
+        incremental form of run()'s result — LMDriver calls this after
+        every step to deliver each batch's results the moment its last
+        request retires, without waiting for the whole grid to drain."""
         out = {
             rid: np.asarray(r.out, np.int32)
             for rid, r in self._done.items()
         }
         self._done.clear()
         return out
+
+    def run(
+        self, rids: Optional[Sequence[int]] = None
+    ) -> Dict[int, np.ndarray]:
+        """Drive until every submitted request finishes; returns
+        {rid: generated tokens}.
+
+        With `rids`, drives until THOSE requests finish and returns
+        (and removes) only them, leaving everything else in the done
+        set. A caller sharing the server with an LMDriver (LMBackend's
+        serial mode between driver tickets) must use this form: the
+        bare drain would consume — and discard — results belonging to
+        in-flight driver tickets, hanging their serve() callers."""
+        if rids is None:
+            while self.has_work():
+                self.step()
+            return self.take_done()
+        want = set(rids)
+        while (want - set(self._done)) and self.has_work():
+            self.step()
+        out = {}
+        for rid in want:
+            r = self._done.pop(rid, None)
+            if r is not None:
+                out[rid] = np.asarray(r.out, np.int32)
+        return out
+
+
+@dataclasses.dataclass
+class _Ticket:
+    """One caller's batch of prompts inside the driver. `event` fires
+    when every request in the ticket has finished (or on error)."""
+
+    prompts: List[np.ndarray]
+    max_new_tokens: int
+    event: threading.Event
+    on_dispatch: Optional[Callable[[], None]] = None
+    rids: Optional[List[int]] = None
+    remaining: int = 0
+    results: Optional[Dict[int, np.ndarray]] = None
+    error: Optional[BaseException] = None
+
+
+class LMDriver:
+    """Thread-safe continuous-batching front door for ONE `LMServer`.
+
+    The server itself is single-threaded mutable state; the round-3/4
+    cluster LM path serialized co-located workers on a lock, so batch
+    N+1's prompts could not enter the grid until batch N fully drained
+    — through a remoted chip that exposed every per-chunk link
+    round-trip serially and put distributed LM serving ~115x below the
+    device's own continuous-batching rate (VERDICT r4 item 2).
+
+    The driver fixes the structure, not the constants: ONE background
+    thread owns the server; any number of serving tasks call
+    `serve()` concurrently (each from its own `asyncio.to_thread`),
+    and their prompts merge into the SAME slot grid. A new batch's
+    prefills enter freed slots while earlier batches are still
+    decoding (prefill-of-next overlapped with current drain), the
+    per-chunk readbacks amortize over every request in flight, and
+    each caller gets its results the moment its OWN requests retire —
+    no drain barrier between batches.
+
+    Exactness is unchanged: slots decode independently
+    (`batched_decode_step` masks per-slot), so outputs remain
+    identical to isolated `generate()` calls no matter how tickets
+    interleave (the LMServer batching-exactness contract).
+
+    This supersedes per-worker servers for co-located workers on one
+    chip — separate grids would split the weight stream across
+    programs instead of sharing it. On multi-host deployments each
+    host runs its own backend+driver over its own chip(s), which is
+    the "per-worker server" layout with the worker = the host.
+    """
+
+    def __init__(
+        self,
+        server: LMServer,
+        server_lock: Optional[threading.Lock] = None,
+    ):
+        self.server = server
+        # `server_lock` guards the RAW server against a caller that
+        # also drives it directly (LMBackend's serial mode holds this
+        # lock for a whole run(); a preempted serial decode keeps
+        # running orphaned — the driver must not interleave with it
+        # when a mode flip races an orphan)
+        self._server_lock = server_lock or threading.Lock()
+        self._cv = threading.Condition()
+        self._incoming: List[_Ticket] = []
+        self._owner: Dict[int, _Ticket] = {}  # rid -> ticket
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # serving stats (read by bench/observability; driver thread
+        # writes under _cv)
+        self.steps = 0
+        self.tickets_served = 0
+
+    # -- caller side ---------------------------------------------------
+
+    def serve(
+        self,
+        prompts: Sequence[np.ndarray],
+        max_new_tokens: int,
+        on_dispatch: Optional[Callable[[], None]] = None,
+    ) -> List[np.ndarray]:
+        """Blocking: decode `prompts`, return their completions in
+        order. Safe from any thread. `on_dispatch` fires (on the
+        DRIVER thread) the moment the ticket's prompts are submitted
+        to the server — the caller's pipeline can start preparing its
+        next batch from that point, not from completion."""
+        t = _Ticket(
+            prompts=[np.asarray(p, np.int32).reshape(-1) for p in prompts],
+            max_new_tokens=max_new_tokens,
+            event=threading.Event(),
+            on_dispatch=on_dispatch,
+        )
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("LMDriver is stopped")
+            self._ensure_thread()
+            self._incoming.append(t)
+            self._cv.notify_all()
+        t.event.wait()
+        if t.error is not None:
+            raise t.error
+        assert t.results is not None and t.rids is not None
+        return [t.results[rid] for rid in t.rids]
+
+    def stop(self) -> None:
+        """Stop the driver thread (idempotent). In-flight tickets
+        finish first; new serve() calls are rejected."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+            self._thread = None
+
+    # -- driver thread -------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="lm-driver", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as e:
+            # a device/tunnel error mid-step would otherwise kill this
+            # thread silently and leave every serve() caller blocked
+            # forever on its event — fail ALL in-flight and queued
+            # tickets loudly, then stop accepting work
+            with self._cv:
+                self._stop = True
+                pending = list(self._incoming)
+                self._incoming = []
+            owned = {id(t): t for t in self._owner.values()}
+            self._owner.clear()
+            for t in list(owned.values()) + pending:
+                if t.error is None:
+                    t.error = RuntimeError(f"LMDriver thread died: {e!r}")
+                t.event.set()
+            raise
+
+    def _loop_inner(self) -> None:
+        srv = self.server
+        while True:
+            with self._cv:
+                while (
+                    not self._incoming
+                    and not srv.has_work()
+                    and not self._stop
+                ):
+                    self._cv.wait()
+                if self._stop and not self._incoming and not srv.has_work():
+                    return
+                new = self._incoming
+                self._incoming = []
+            # server access happens only under _server_lock: a
+            # lock-mode (serial) decode running orphaned after a
+            # preemption must fully drain before the driver touches
+            # the grid
+            with self._server_lock:
+                for t in new:
+                    try:
+                        # validation failures reject the WHOLE ticket
+                        # before any of its prompts queue (submit_many
+                        # is atomic), so a bad prompt file can't leave
+                        # siblings decoding into a discarded result
+                        t.rids = srv.submit_many(t.prompts, t.max_new_tokens)
+                        t.remaining = len(t.rids)
+                        t.results = {}
+                        for rid in t.rids:
+                            self._owner[rid] = t
+                        if t.remaining == 0:
+                            t.event.set()
+                    except Exception as e:
+                        t.error = e
+                        t.event.set()
+                        continue
+                    if t.on_dispatch is not None:
+                        try:
+                            t.on_dispatch()
+                        except Exception:
+                            pass  # a pipeline hint, never a decode error
+                if srv.has_work():
+                    srv.step()
+                    with self._cv:
+                        self.steps += 1
+                done = srv.take_done()
+            for rid, toks in done.items():
+                t = self._owner.pop(rid, None)
+                if t is None:
+                    continue  # pre-driver submission via raw server API
+                t.results[rid] = toks
+                t.remaining -= 1
+                if t.remaining == 0:
+                    self.tickets_served += 1
+                    t.event.set()

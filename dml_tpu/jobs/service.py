@@ -84,6 +84,8 @@ class JobService:
         # per-model worker backend + per-model input-file patterns
         # (image jobs sample *.jpeg; LM jobs sample prompt-token files)
         self._extra_backends: Dict[str, InferBackend] = {}
+        # models whose backend declares `on_dispatch` (see register_lm)
+        self._backend_dispatch_aware: Dict[str, bool] = {}
         self.model_patterns: Dict[str, Tuple[str, ...]] = {}
         self._engine = engine  # lazy InferenceEngine (imports jax on first use)
         # Decoded-input cache for the worker prepare stage, keyed by
@@ -319,6 +321,21 @@ class JobService:
         """
         if backend is not None:
             self._extra_backends[name] = backend
+            # Backends that declare an `on_dispatch` parameter (the
+            # LMBackend contract) opt in to promote-at-dispatch: the
+            # staged next batch starts the moment this batch's prompts
+            # are submitted to the backend's continuous-batching
+            # driver, instead of after its decode drains — the
+            # generic-path analog of the engine path's
+            # promote-at-dispatch (VERDICT r4 item 2).
+            try:
+                import inspect
+
+                self._backend_dispatch_aware[name] = (
+                    "on_dispatch" in inspect.signature(backend).parameters
+                )
+            except (TypeError, ValueError):
+                self._backend_dispatch_aware[name] = False
         self.model_patterns[name] = tuple(patterns)
         if cost is not None:
             self.scheduler.set_cost(name, cost)
@@ -471,29 +488,36 @@ class JobService:
 
     def breakdown_stats(self) -> Dict[str, float]:
         """Mean per-batch wall-time split from ACK-carried timings
-        (coordinator-side; VERDICT r2 item 9): `fetch_ms` replica
-        fetch, `decode_ms` host JPEG decode (backend − infer),
-        `infer_ms` the engine's infer call — device forward PLUS
-        dispatch, which on a remoted chip is dominated by the tunnel
-        round-trips (device compute for a b32 ResNet batch is ~2.2 ms;
-        see the bench sweep) — and `other_ms` the rest (exec − fetch −
-        backend): output PUT + ACK path, plus, for STAGED batches, the
-        time the prepared batch sat parked waiting for the previous
-        batch's inference (exec spans first touch to ACK). Per-batch
-        exec therefore sums across stages while the job's WALL tracks
-        max(stage) — overlap means the sum exceeds wall. Empty dict
-        when no samples."""
+        (coordinator-side; VERDICT r2 item 9, stages named fully per
+        r4 item 4): `fetch_ms` replica fetch, `decode_ms` host JPEG
+        decode (backend − infer), `infer_ms` the engine's infer call —
+        device forward PLUS dispatch, which on a remoted chip is
+        dominated by the tunnel round-trips (device compute for a b32
+        ResNet batch is ~2.2 ms; see the bench sweep) —
+        `stage_wait_ms` the time a STAGED batch sat parked, prepare
+        done, waiting out the previous batch's inference (pipelining
+        means this stage runs CONCURRENTLY with another batch's
+        infer — it is exec-accounting, not lost wall time), `put_ms`
+        the output write + replicated store PUT, and `other_ms` the
+        unattributed residue (result re-keying, ACK send, loop
+        scheduling; should be near zero). Per-batch exec
+        sums across stages while the job's WALL tracks max(stage) —
+        overlap means the sum exceeds wall. Empty dict when no
+        samples."""
         if not self.batch_timing:
             return {}
         n = len(self.batch_timing)
-        mean = lambda k: sum(s[k] for s in self.batch_timing) / n  # noqa: E731
+        mean = lambda k: sum(s.get(k, 0.0) for s in self.batch_timing) / n  # noqa: E731
         f, b, i, e = mean("fetch"), mean("backend"), mean("infer"), mean("exec")
+        sw, p = mean("stage_wait"), mean("put")
         return {
             "batches": n,
             "fetch_ms": round(f * 1e3, 1),
             "decode_ms": round((b - i) * 1e3, 1),
             "infer_ms": round(i * 1e3, 1),
-            "other_ms": round((e - f - b) * 1e3, 1),
+            "stage_wait_ms": round(sw * 1e3, 1),
+            "put_ms": round(p * 1e3, 1),
+            "other_ms": round((e - f - b - sw - p) * 1e3, 1),
             "exec_ms": round(e * 1e3, 1),
         }
 
@@ -733,6 +757,8 @@ class JobService:
                 "fetch": float(d.get("fetch_time", 0.0)),
                 "backend": float(d.get("backend_time", 0.0)),
                 "infer": float(d.get("infer_time", 0.0)),
+                "stage_wait": float(d.get("stage_wait_time", 0.0)),
+                "put": float(d.get("put_time", 0.0)),
                 "n": int(d.get("n_images", 0)),
             })
         sb = self.store.standby_node()
@@ -1281,13 +1307,16 @@ class JobService:
 
     async def _prepare(
         self, batch: Batch
-    ) -> Tuple[List[str], Optional[Any], float, float, float]:
+    ) -> Tuple[List[str], Optional[Any], float, float, float, float]:
         """Stage 1 of the worker pipeline: materialize the batch's
         inputs locally and (for engine-served CNN models) decode them
         to the uint8 batch array. Runs eagerly for staged batches so
         it overlaps the previous batch's device time. Returns its own
-        start time so exec accounting spans the true first touch (for
-        a staged batch, _execute begins long after prepare did)."""
+        start AND end times so exec accounting spans the true first
+        touch (for a staged batch, _execute begins long after prepare
+        did) and the parked time between prepare finishing and the
+        batch's promotion is attributable (`stage_wait` in the
+        breakdown, VERDICT r4 item 4)."""
         t0 = time.monotonic()
         paths = await self._fetch_inputs(batch)
         t_fetch = time.monotonic() - t0
@@ -1304,7 +1333,7 @@ class JobService:
                     self._decode_cached, paths, spec.input_size
                 )
                 t_decode = time.monotonic() - t1
-        return paths, imgs, t_fetch, t_decode, t0
+        return paths, imgs, t_fetch, t_decode, t0, time.monotonic()
 
     def _decode_cached(self, paths: List[str], size) -> Any:
         """load_images through the per-file decoded cache (thread
@@ -1368,16 +1397,39 @@ class JobService:
         try:
             with span("worker.fetch_inputs"):
                 if prep is None:
-                    paths, imgs, t_fetch, t_decode, t0 = await self._prepare(batch)
+                    (paths, imgs, t_fetch, t_decode, t0,
+                     t_prep_end) = await self._prepare(batch)
                 else:
-                    paths, imgs, t_fetch, t_decode, t0 = await prep
+                    paths, imgs, t_fetch, t_decode, t0, t_prep_end = await prep
             t1 = time.monotonic()
+            # staged batches park between prepare finishing and
+            # promotion (waiting out the previous batch's inference) —
+            # a real, named stage of exec, not "other"
+            stage_wait = max(0.0, t1 - t_prep_end)
             with span("worker.inference"):
                 be = self._extra_backends.get(batch.model, self._backend)
                 if imgs is not None and self._backend_is_engine:
                     results, infer_time, cost = await self._engine_infer_prepared(
                         batch.model, paths, imgs
                     )
+                elif self._backend_dispatch_aware.get(batch.model):
+                    # dispatch-aware backend (LMBackend): the staged
+                    # next batch promotes the moment this batch's
+                    # prompts enter the continuous-batching driver, so
+                    # its decode JOINS the grid while this one drains
+                    # (VERDICT r4 item 2). The callback fires on the
+                    # driver thread — hop back to the loop.
+                    loop = asyncio.get_running_loop()
+                    results, infer_time, cost = await be(
+                        batch.model, paths,
+                        on_dispatch=lambda: loop.call_soon_threadsafe(
+                            self._promote_staged
+                        ),
+                    )
+                    # also promote now: covers backends whose serial
+                    # mode never fires the callback, and a NEW stage
+                    # that landed mid-drain (engine path does the same)
+                    self._promote_staged()
                 else:
                     results, infer_time, cost = await be(batch.model, paths)
                     # generic path: promote once inference finished
@@ -1398,6 +1450,7 @@ class JobService:
             out_name = f"output_{batch.job_id}_{batch.batch_id}_{self.node.me.port}.json"
             tmp = os.path.join(self.store.cfg.download_path(), out_name)
             os.makedirs(os.path.dirname(tmp), exist_ok=True)
+            t_put0 = time.monotonic()
             with open(tmp, "w") as f:
                 json.dump(results, f)
             try:
@@ -1407,6 +1460,7 @@ class JobService:
                 # carries the result timing; get-output will miss this
                 # shard, which the reference tolerates identically
                 log.warning("%s: PUT of %s failed: %s", self._me, out_name, e)
+            t_put = time.monotonic() - t_put0
             self.node.send_unique(
                 coordinator if self.node.leader_unique is None else self.node.leader_unique,
                 MsgType.WORKER_TASK_REQUEST_ACK,
@@ -1419,10 +1473,13 @@ class JobService:
                     "infer_time": infer_time,
                     # where the batch's wall time went (VERDICT r2
                     # item 9): replica fetch vs backend (backend −
-                    # infer ≈ host JPEG decode); the coordinator
-                    # aggregates these into breakdown_stats()
+                    # infer ≈ host JPEG decode) vs staged-parking vs
+                    # output PUT; the coordinator aggregates these
+                    # into breakdown_stats()
                     "fetch_time": t_fetch,
                     "backend_time": t_backend,
+                    "stage_wait_time": stage_wait,
+                    "put_time": t_put,
                     "cost": cost,
                 },
             )
