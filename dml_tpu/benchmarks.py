@@ -103,19 +103,21 @@ def device_seconds_per_iter_stats(
     FULL computation under test (use `jnp.max(out)`), and should feed
     `poke(input, acc)` into the op so iterations can't fold. Each
     slope uses two chain lengths to cancel fixed dispatch/readback
-    overhead."""
-    c1, c2 = chains
+    overhead.
 
-    def make(chain: int):
-        def chained(*a):
-            def body(i, acc):
-                return step(i, acc, *a) * jnp.float32(1e-12) + acc
+    The chain length is a TRACED argument (fori_loop lowers to a
+    while loop), so ONE compiled program serves both lengths: through
+    the tunnel each XLA compile costs tens of seconds and is not
+    persistently cached, and separate per-length programs both doubled
+    that bill and let the two lengths schedule differently."""
 
-            return jax.lax.fori_loop(0, chain, body, jnp.float32(0))
+    def chained(n, *a):
+        def body(i, acc):
+            return step(i, acc, *a) * jnp.float32(1e-12) + acc
 
-        return jax.jit(chained)
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0))
 
-    return _paired_slopes(make(c1), make(c2), args, c1, c2, reps)
+    return dynamic_slope_stats(chained, args, chains, reps)
 
 
 def device_seconds_per_iter(
@@ -132,35 +134,26 @@ def device_seconds_per_iter(
     )["median"]
 
 
-def scan_slope_stats(
-    make: Callable[[int], Callable],
+def dynamic_slope_stats(
+    fn: Callable,
     args: Tuple,
     lengths: Tuple[int, int] = (16, 64),
     reps: int = 5,
 ) -> dict:
-    """Per-iteration seconds of a SEQUENTIAL scanned body, with
-    dispersion (median/min/max over `reps` paired slopes).
-
-    `make(n)` returns a jitted callable over `args` that runs the body
-    n times under `lax.scan` with a genuinely loop-carried dependency
-    (e.g. autoregressive decode: each step's token is the argmax of
-    the previous step's logits, so nothing hoists) and returns a value
-    depending on the full chain. The per-iteration time is the slope
-    between the two lengths — same dispatch/readback cancellation as
-    `device_seconds_per_iter`, for bodies whose carry (KV caches) is
-    too structured for the fori_loop `poke` protocol."""
+    """Slope stats for a body whose chain length is a TRACED
+    argument: `fn(n, *args)` runs the sequential body n times (e.g. a
+    `lax.fori_loop` carrying the KV cache / train state) and returns a
+    value depending on the full chain. ONE compiled program serves
+    both lengths — through the tunnel every per-length compile costs
+    tens of uncached seconds, and a single program also guarantees the
+    two lengths get the identical XLA schedule (the slope's
+    subtraction is then exact, not two programs' difference)."""
     n1, n2 = lengths
-    return _paired_slopes(make(n1), make(n2), args, n1, n2, reps)
-
-
-def scan_slope(
-    make: Callable[[int], Callable],
-    args: Tuple,
-    lengths: Tuple[int, int] = (16, 64),
-    reps: int = 5,
-) -> float:
-    """Median form of `scan_slope_stats`."""
-    return scan_slope_stats(make, args, lengths, reps)["median"]
+    jfn = jax.jit(fn)
+    a1, a2 = jnp.int32(n1), jnp.int32(n2)
+    return _paired_slopes(
+        lambda *a: jfn(a1, *a), lambda *a: jfn(a2, *a), args, n1, n2, reps
+    )
 
 
 def forward_rate_stats(

@@ -31,7 +31,7 @@ import asyncio
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -94,6 +94,9 @@ class InferenceEngine:
         # load must not silently fall back to random init
         self._evicted_explicit: set = set()
         self._reshape_lock = threading.Lock()
+        # measured dispatch-mode choice per round composition:
+        # key -> (mode, measured_at) — see choose_dispatch_mode
+        self._dispatch_mode: Dict[tuple, Tuple[str, float]] = {}
 
     # ---- loading ----
 
@@ -359,6 +362,76 @@ class InferenceEngine:
             return cached[0]
 
         return result
+
+    def choose_dispatch_mode(
+        self,
+        round_spec: Sequence[Tuple[str, np.ndarray]],
+        rounds: int = 3,
+        ttl_s: float = 600.0,
+    ) -> str:
+        """Measure sync vs pipelined dispatch for a SCHEDULING ROUND
+        and return the faster mode ('sync' | 'pipelined'), cached per
+        round composition.
+
+        `round_spec` is the round as the dispatcher will actually
+        drive it: [(model, sample_batch), ...] — e.g. the fair-share
+        split's [R50, R50, R50, IncV3]. Probing the real composition
+        matters: a single-model 2-batch probe measured pipelined
+        FASTER on the tunnel while the true dual-model round ran it
+        0.8x (the models' uploads/readbacks contend differently when
+        interleaved), so the probe must dispatch what the round
+        dispatches.
+
+        Why a measurement and not a heuristic: whether enqueue-then-
+        drain beats one-round-trip-per-batch depends on the host<->
+        device link, not the model. On a local TPU host transfers and
+        compute overlap, so pipelining wins; through a SERIALIZED
+        remoting tunnel later batches' uploads contend with earlier
+        batches' readbacks on one stream and pipelining measurably
+        loses. `rounds` interleaved sync/pipelined reps (interleaved
+        so drifting link weather biases neither mode). Dispatchers
+        (the dual-model C4 path) ask this before choosing how to
+        drive their rounds (VERDICT r4 item 3).
+        """
+        import statistics
+
+        # key on the actual probe shapes, not just the configured batch
+        # size: the same model composition with ragged tail batches
+        # moves different bytes and may prefer a different mode. The
+        # cache entry EXPIRES (ttl_s): the winner is decided by link
+        # weather, which drifts — a long-lived server must re-measure,
+        # not run a once-right mode forever
+        key = tuple(
+            (self._require(n).spec.name, tuple(np.shape(s)))
+            for n, s in round_spec
+        )
+        hit = self._dispatch_mode.get(key)
+        if hit is not None and time.monotonic() - hit[1] < ttl_s:
+            return hit[0]
+        # warm both paths at the exact shapes so neither pays a compile
+        for n, s in round_spec:
+            self.infer_arrays(n, s)
+            self.infer_arrays_nowait(n, s)()
+        t_sync: List[float] = []
+        t_pipe: List[float] = []
+        for _ in range(rounds):
+            t0 = time.monotonic()
+            for n, s in round_spec:
+                self.infer_arrays(n, s)
+            t_sync.append(time.monotonic() - t0)
+            t0 = time.monotonic()
+            for h in [
+                self.infer_arrays_nowait(n, s) for n, s in round_spec
+            ]:
+                h()
+            t_pipe.append(time.monotonic() - t0)
+        mode = (
+            "pipelined"
+            if statistics.median(t_pipe) <= statistics.median(t_sync)
+            else "sync"
+        )
+        self._dispatch_mode[key] = (mode, time.monotonic())
+        return mode
 
     def infer_files(self, name: str, files: Sequence[str], top: int = 5) -> InferenceResult:
         """The reference's perform_inference(model, files) equivalent

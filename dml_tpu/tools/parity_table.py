@@ -130,7 +130,19 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
             _model_pts(b4) + " per chip")
     c4 = m.get("dual_model_c4") or {}
     if c4:
-        if "combined_qps_pipelined" in c4:
+        if "combined_qps_auto" in c4:  # r5 schema: auto-chosen mode
+            ours = (
+                f"{c4['combined_qps_auto']} q/s serving with the "
+                f"probe-chosen '{c4.get('dispatch_mode_auto', 'n/a')}' "
+                f"dispatch ({c4.get('pipelining_speedup', 'n/a')}× vs "
+                f"the reference-shaped sync loop); forced modes: sync "
+                f"{c4.get('combined_qps_sync', 'n/a')} / pipelined "
+                f"{c4.get('combined_qps_pipelined', 'n/a')} q/s "
+                f"({c4.get('pipelined_vs_sync_forced', 'n/a')}×) "
+                "through the real fair-share scheduler (tunnel "
+                "dispatch included)"
+            )
+        elif "combined_qps_pipelined" in c4:  # r3/r4 schema
             ours = (
                 f"{c4['combined_qps_sync']} q/s sync → "
                 f"{c4['combined_qps_pipelined']} q/s with pipelined "

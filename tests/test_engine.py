@@ -127,3 +127,58 @@ def test_infer_arrays_nowait_matches_sync(engine):
         np.testing.assert_allclose(
             h(), engine.infer_arrays("TinyNet", b), rtol=1e-6
         )
+
+
+def test_choose_dispatch_mode_picks_faster_both_ways(engine):
+    """The adaptive dispatch selection (VERDICT r4 item 3) must pick
+    whichever mode the measurement says is faster — exercised BOTH
+    ways by steering the two paths' speed, plus the per-(model, bs)
+    cache."""
+    import time as _time
+
+    sample = np.zeros((8, 32, 32, 3), np.uint8)
+    orig_sync = engine.infer_arrays
+    orig_nowait = engine.infer_arrays_nowait
+    calls = {"sync": 0, "nowait": 0}
+
+    def slow_sync(name, imgs):
+        calls["sync"] += 1
+        _time.sleep(0.01)
+        return orig_sync(name, imgs)
+
+    def slow_nowait(name, imgs):
+        calls["nowait"] += 1
+        h = orig_nowait(name, imgs)
+
+        def wrapped():
+            _time.sleep(0.01)
+            return h()
+
+        return wrapped
+
+    round_spec = [("TinyNet", sample), ("TinyNet", sample)]
+    try:
+        # pipelined path slower -> engine must choose sync
+        engine.infer_arrays_nowait = slow_nowait
+        assert engine.choose_dispatch_mode(round_spec) == "sync"
+        engine._dispatch_mode.clear()
+        engine.infer_arrays_nowait = orig_nowait
+
+        # sync path slower -> engine must choose pipelined
+        engine.infer_arrays = slow_sync
+        assert engine.choose_dispatch_mode(round_spec) == "pipelined"
+        # cached: a second ask re-measures nothing
+        n_sync = calls["sync"]
+        assert engine.choose_dispatch_mode(round_spec) == "pipelined"
+        assert calls["sync"] == n_sync
+        # ... but the entry EXPIRES: link weather drifts, so a
+        # long-lived server must re-measure (ttl_s=0 forces it)
+        engine.infer_arrays = orig_sync
+        engine.infer_arrays_nowait = slow_nowait
+        assert (
+            engine.choose_dispatch_mode(round_spec, ttl_s=0.0) == "sync"
+        )
+    finally:
+        engine.infer_arrays = orig_sync
+        engine.infer_arrays_nowait = orig_nowait
+        engine._dispatch_mode.clear()
