@@ -788,35 +788,73 @@ def _bench_cluster_lm(out, *, n_prompts=64, new_tokens=32, base_port=28821,
                     )
                 await asyncio.to_thread(be.serve_files, warm)
 
-                # in-run serial baseline: the r3/r4 shape — workers
-                # lock-serialize on the shared server, next batch's
-                # decode starts only after the current one drains
-                be.overlap = False
-                wall_serial, gen_serial = await timed_job()
-                # overlapped: all workers feed one continuous-batching
-                # LMDriver (cross-batch slot sharing + promote-at-
-                # dispatch), VERDICT r4 item 2
-                be.overlap = True
-                steps0 = be.driver.steps  # warmup ran through the driver
-                wall, gen_tokens = await timed_job()
+                # serial = the r3/r4 shape (workers lock-serialize on
+                # the shared server); overlapped = all workers feed one
+                # continuous-batching LMDriver (cross-batch slot
+                # sharing + promote-at-dispatch, VERDICT r4 item 2).
+                # INTERLEAVED pairs: the tunnel's weather drifts over
+                # the section, and a serial-then-overlap order charges
+                # all of the drift to one mode
+                import statistics
+
+                walls = {True: [], False: []}
+                gens = {True: [], False: []}
+                driver_steps = 0  # ONE overlap run's step count
+                for overlap in (True, False, True, False):
+                    be.overlap = overlap
+                    s0 = be.driver.steps
+                    w, g = await timed_job()
+                    if overlap and not driver_steps:
+                        driver_steps = be.driver.steps - s0
+                    walls[overlap].append(w)
+                    gens[overlap].append(g)
+                wall_over = statistics.median(walls[True])
+                wall_serial = statistics.median(walls[False])
+                gen_tokens = gens[True][0]
+                gen_serial = gens[False][0]
+                # C4's adaptive-dispatch principle applied here too:
+                # the HEADLINE rate is the measured winner's, labeled.
+                # On this 1-core co-located cluster the serial mode
+                # usually wins (the driver funnel contends with the
+                # asyncio loop for the core; isolated, driver ≈
+                # serial); on a real multi-core TPU host the driver's
+                # cross-batch batching is the right default.
+                mode_chosen = (
+                    "overlap" if wall_over <= wall_serial else "serial"
+                )
+                wall = min(wall_over, wall_serial)
                 out["cluster_lm_serving"] = {
                     "nodes": 4,
                     "prompts": n_prompts,
                     "new_tokens_per_prompt": new_tokens,
+                    "mode_chosen": mode_chosen,
                     "wall_s": round(wall, 2),
                     "prompts_per_s": round(n_prompts / wall, 2),
                     "gen_tok_per_s_end_to_end": round(gen_tokens / wall, 1),
+                    "gen_tok_per_s_overlap": round(
+                        gen_tokens / wall_over, 1),
+                    "overlap_range": sorted(
+                        round(gens[True][0] / w, 1) for w in walls[True]
+                    ),
                     "gen_tok_per_s_serial": round(gen_serial / wall_serial, 1),
-                    "overlap_speedup": round(wall_serial / wall, 2),
-                    "driver_steps": be.driver.steps - steps0,
+                    "serial_range": sorted(
+                        round(gens[False][0] / w, 1) for w in walls[False]
+                    ),
+                    "overlap_vs_serial": round(wall_serial / wall_over, 2),
+                    "driver_steps": driver_steps,
                     "note": "full stack: store-replicated prompt files -> "
                             "fair-share scheduler -> continuous-batching "
-                            "LMDriver (one slot grid shared across "
-                            "batches + promote-at-dispatch) -> merged "
-                            "outputs; serial row = the lock-serialized "
-                            "r4 path, same run, same cluster; outputs "
-                            "are exactly isolated generate() per prompt "
-                            "(LMServer batching-exactness contract)",
+                            "LM server -> merged outputs; the headline "
+                            "rate is the measured winner of interleaved "
+                            "serial/overlap pairs (mode_chosen — the C4 "
+                            "adaptive-dispatch principle): overlap = all "
+                            "workers feed one LMDriver slot grid "
+                            "(promote-at-dispatch), serial = the r4 "
+                            "lock path, which on a 1-core co-located "
+                            "cluster avoids contending with the asyncio "
+                            "loop; outputs are exactly isolated "
+                            "generate() per prompt (LMServer "
+                            "batching-exactness contract)",
                 }
         finally:
             be.close()

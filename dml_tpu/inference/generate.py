@@ -446,8 +446,15 @@ def prefill(
 
     if logits_index is None:
         x_last = x[:, -1:]
-    else:
+    elif jnp.ndim(logits_index) == 0:
         x_last = jax.lax.dynamic_slice_in_dim(x, logits_index, 1, axis=1)
+    else:
+        # per-row indices: a batched-placement prefill packs prompts
+        # of different true lengths into one bucket, so each row reads
+        # its own last-prompt position (LMServer group placement)
+        x_last = jax.vmap(
+            lambda row, i: jax.lax.dynamic_slice_in_dim(row, i, 1, axis=0)
+        )(x, logits_index.astype(jnp.int32))
     return _head(params, cfg, x_last), cache
 
 

@@ -264,12 +264,19 @@ class LMBackend:
             params = variables_from_bytes(
                 data, {"params": params}
             )["params"]
-        return cls(
+        max_new = int(spec.get("max_new_tokens", 32))
+        # default chunk ≈ the per-request budget (capped): every step's
+        # packed readback costs a link round-trip, so a 32-token budget
+        # at chunk 16 pays twice the round-trips for the same tokens.
+        # Operators with mixed budgets set chunk explicitly (smaller =
+        # finer continuous-batching join granularity).
+        chunk_default = max(1, min(max_new, 32))
+        be = cls(
             params, cfg,
-            max_new_tokens=int(spec.get("max_new_tokens", 32)),
+            max_new_tokens=max_new,
             max_slots=int(spec.get("max_slots", 4)),
             max_len=int(spec.get("max_len", 1024)),
-            chunk=int(spec.get("chunk", 16)),
+            chunk=int(spec.get("chunk", chunk_default)),
             temperature=float(spec.get("temperature", 0.0)),
             top_k=(
                 int(spec["top_k"]) if spec.get("top_k") is not None
@@ -277,6 +284,14 @@ class LMBackend:
             ),
             seed=int(spec.get("seed", 0)),
         )
+        # operators pick the serving concurrency mode per deployment
+        # ({"overlap": false}): the driver's cross-batch batching wins
+        # on multi-core TPU hosts; a 1-core co-located cluster can
+        # prefer the lock-serialized path (bench `cluster_lm_serving`
+        # measures both every round)
+        if spec.get("overlap") is not None:
+            be.overlap = bool(spec["overlap"])
+        return be
 
 
 def write_prompt_file(path: str, tokens: Sequence[int]) -> None:

@@ -13,11 +13,15 @@ sequences decoding together in ONE compiled program:
   compilation serves every mix of requests;
 - `submit()` prefills the new request's prompt in one flash-attention
   forward (prompt lengths bucketed to powers of two to bound distinct
-  compilations) and writes its cache rows into a free slot;
+  compilations) and writes its cache rows into a free slot — placement
+  is FULLY async: the per-slot next-token/position state is
+  device-resident, the first sampled token's value rides the next
+  step's packed readback, and nothing blocks on the link;
 - `run()`/`step()` advance EVERY active slot one token per
   `batched_decode_step` (per-slot positions), `chunk` tokens per
-  dispatch through a `lax.scan` — host round-trips (expensive through
-  a remoted TPU) amortize over the chunk;
+  dispatch through a `lax.scan` — ONE blocking readback per step is
+  the serve loop's only host round-trip (expensive through a remoted
+  TPU), amortized over chunk × slots tokens;
 - finished slots free immediately and the next queued request takes
   the slot — no drain barrier, which is the whole point of continuous
   batching.
@@ -86,12 +90,18 @@ class _Request:
     rid: int
     prompt: np.ndarray  # [Tp] int32
     max_new_tokens: int
+    # `emitted` counts tokens GENERATED on device; `out` holds the
+    # values actually read back. They differ transiently: the first
+    # token is sampled at placement but its VALUE rides the next
+    # packed readback (deferred-first protocol, see _place_waiting) —
+    # retirement/budget logic keys on emitted, results on out.
     out: List[int] = dataclasses.field(default_factory=list)
+    emitted: int = 0
     slot: Optional[int] = None
 
     @property
     def done(self) -> bool:
-        return len(self.out) >= self.max_new_tokens
+        return self.emitted >= self.max_new_tokens
 
 
 class LMServer:
@@ -126,10 +136,26 @@ class LMServer:
         self.temperature = temperature
         self.top_k = top_k
         self.cache = init_cache(cfg, max_slots, max_len)
-        self.pos = np.zeros(max_slots, np.int32)  # next write position
-        self.cur = np.zeros(max_slots, np.int32)  # next input token
+        # Decode state lives ON DEVICE (authoritative): `_cur_dev` the
+        # next input token per slot, `_pos_dev` the next write
+        # position. Placement writes them with device scatters and the
+        # chunk fn returns their advanced forms — the host NEVER reads
+        # them back (a slot's position, when needed, is
+        # req.prompt.size + req.emitted). Through a remoted chip every
+        # blocking readback costs a full link round-trip, and the old
+        # host-resident cur/pos forced one per placement round on top
+        # of one per chunk (together ~half the distributed-LM serving
+        # wall).
+        self._cur_dev = jnp.zeros(max_slots, jnp.int32)
+        self._pos_dev = jnp.zeros(max_slots, jnp.int32)
         self.rid_vec = np.zeros(max_slots, np.int32)  # slot -> request id
         self._slot_req: List[Optional[_Request]] = [None] * max_slots
+        # placement groups whose first tokens haven't been read back
+        # yet: (requests in row order, device [group_rows] tokens —
+        # rows past the requests are group padding). Flushed into the
+        # next step's packed readback, or by _flush_firsts when a
+        # contained request retires with no step following.
+        self._pending_first: List[Tuple[List[_Request], jax.Array]] = []
         self._queue: List[_Request] = []
         self._done: Dict[int, _Request] = {}
         self._rid = 0
@@ -148,13 +174,29 @@ class LMServer:
             )
         )
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=(1,))
+        self._chunk_fn = jax.jit(
+            self._chunk_impl, donate_argnums=(1, 2, 3)
+        )
+        # fixed-shape masked merge for placement-time cur/pos writes:
+        # slot_map[s] = the prefill row whose value slot s takes, or
+        # -1 to keep the current value. One compile serves every group
+        # size and slot assignment (the vectors are always [max_slots])
+        self._merge_vec = jax.jit(
+            lambda vec, vals, slot_map: jnp.where(
+                slot_map >= 0, vals[jnp.clip(slot_map, 0, None)], vec
+            ),
+            donate_argnums=(0,),
+        )
+        # per-row first-token sampling for a placement group (same
+        # (rid, position) streams the chunk sampler continues)
+        # prefill's logits are already [rows, vocab] (_head squeezes)
+        self._sample_first = jax.jit(self._sample_slots)
 
-    def _insert_impl(self, cache, pcache, slot, n_valid):
-        """Copy a prefilled request's cache rows into `slot`. Only the
-        first `n_valid` positions carry real data, but copying the
-        whole row is one contiguous DMA and stale tail positions are
-        invisible behind the per-slot validity mask.
+    def _insert_impl(self, cache, pcache, slot, row):
+        """Copy row `row` of a (possibly group-batched) prefilled
+        cache into `slot`. Stale tail positions past the prompt are
+        invisible behind the per-slot validity mask, and copying the
+        whole row is one contiguous DMA.
 
         INVARIANT (with `_chunk_impl`): an empty slot's pos is clamped
         to max_len - 1 on the device, so between retire and reuse its
@@ -162,12 +204,15 @@ class LMServer:
         full-row overwrite then erases that too. Any future partial-row
         insert or unclamped scatter would break the pairing; keep both
         sides together."""
-        del n_valid
         # generic over the cache layout (bf16 {k, v} or kv_quant
         # {k_q, k_s, v_q, v_s}) — every leaf copies the same way
         return {
             name: {
-                key: kv[key].at[slot].set(pcache[name][key][0])
+                key: kv[key].at[slot].set(
+                    jax.lax.dynamic_index_in_dim(
+                        pcache[name][key], row, axis=0, keepdims=False
+                    )
+                )
                 for key in kv
             }
             for name, kv in cache.items()
@@ -255,63 +300,89 @@ class LMServer:
         return [r.rid for r in reqs]
 
     def _place_waiting(self) -> None:
-        # Phase 1: DISPATCH every placement (prefill, cache insert,
-        # first-token sample) without touching the host — JAX queues
-        # them asynchronously. Phase 2 drains ONE concatenated scalar
-        # vector. The previous per-request np.asarray of the full
-        # [vocab] logits plus the sampled token cost two blocking link
-        # round-trips per prompt; through a remoted chip (~100 ms
-        # readback) that serialized placement into the dominant cost
-        # of distributed LM serving (bench `cluster_lm_serving`).
-        placed = []  # (slot, req, tp, device first-token [1])
+        # Placement is FULLY ASYNC and GROUP-BATCHED: free slots take
+        # queued requests bucket-by-bucket, each bucket group running
+        # ONE batched prefill (rows padded to a power-of-two group
+        # size to bound compilations), one row-indexed cache insert
+        # per request, one batched first-token sample, and fixed-shape
+        # masked merges into the device-resident cur/pos — nothing
+        # here blocks on the link, and the first tokens' VALUES ride
+        # the next step's packed readback (or _flush_firsts). History:
+        # r3 paid two blocking round-trips per prompt, r4 one per
+        # placement round plus a [1, bucket] prefill dispatch chain
+        # PER PROMPT — through a ~100 ms tunnel that was ~a third of
+        # the distributed-LM serving wall (bench `cluster_lm_serving`).
+        pairs = []
         for slot in range(self.max_slots):
-            if self._slot_req[slot] is not None or not self._queue:
-                continue
-            req = self._queue.pop(0)
-            tp = req.prompt.size
-            bucket = min(_bucket(tp), self.max_len)
-            padded = np.zeros(bucket, np.int32)
-            padded[:tp] = req.prompt
-            # pad with the last token: garbage positions >= tp are
-            # behind the validity mask, but rope/cache still write them
-            padded[tp:] = req.prompt[-1]
-            # logits_index = tp-1: causal masking makes the logits at
-            # the true last prompt position identical to an UNPADDED
-            # prefill's, so the first token matches generate() exactly
-            # (bit-for-bit, any dtype) despite the bucket padding
-            logits, pcache = self._prefill(
-                self.params, jnp.asarray(padded[None, :]),
-                jnp.int32(tp - 1),
-            )
-            self.cache = self._insert(
-                self.cache, pcache, jnp.int32(slot), jnp.int32(tp)
-            )
-            # the first generated token occupies position tp — same
-            # (rid, position) stream the chunk sampler continues;
-            # sampled ON DEVICE from the same [1, vocab] logits the
-            # host hop used to round-trip (values identical)
-            sub = jax.random.fold_in(
-                jax.random.fold_in(self._base_rng, req.rid), tp
-            )
-            first_dev = _sample(
-                logits, sub, self.temperature, self.top_k
-            )
-            placed.append((slot, req, tp, first_dev))
-        if not placed:
+            if self._slot_req[slot] is None and self._queue:
+                pairs.append((slot, self._queue.pop(0)))
+        if not pairs:
             return
-        firsts = np.asarray(
-            jnp.concatenate([f for (_, _, _, f) in placed])
-        )
-        for (slot, req, tp, _), first in zip(placed, firsts.tolist()):
-            first = int(first)
-            req.out.append(first)
-            req.slot = slot
-            self._slot_req[slot] = req
-            self.pos[slot] = tp
-            self.cur[slot] = first
-            self.rid_vec[slot] = req.rid
-            if req.done:  # max_new_tokens == 1
-                self._retire(slot)
+        groups: Dict[int, List[Tuple[int, _Request]]] = {}
+        for slot, req in pairs:
+            b = min(_bucket(req.prompt.size), self.max_len)
+            groups.setdefault(b, []).append((slot, req))
+        for bucket, grp in groups.items():
+            k = len(grp)
+            # group-row padding policy: short buckets pad straight to
+            # max_slots — ONE prefill compilation per bucket, which a
+            # 1-prompt warmup already covers (distinct (bucket, rows)
+            # shapes each cost seconds of tunnel compile; a k-sized
+            # group would mint up to 4 variants per bucket). Long
+            # buckets keep power-of-two padding: an 8-row 4k-token
+            # prefill's transient cache is real HBM.
+            kp = (
+                self.max_slots if bucket <= 256
+                else min(_bucket(k, lo=1), self.max_slots)
+            )
+            padded = np.zeros((kp, bucket), np.int32)
+            tps = np.ones(kp, np.int32)
+            rids = np.zeros(kp, np.int32)
+            slot_map = np.full(self.max_slots, -1, np.int32)
+            for row, (slot, req) in enumerate(grp):
+                tp = req.prompt.size
+                padded[row, :tp] = req.prompt
+                # pad with the last token: garbage positions >= tp are
+                # behind the validity mask, but rope/cache write them
+                padded[row, tp:] = req.prompt[-1]
+                tps[row] = tp
+                rids[row] = req.rid
+                slot_map[slot] = row
+            for row in range(k, kp):  # dummy rows: repeat row 0
+                padded[row] = padded[0]
+                tps[row] = tps[0]
+            # per-row logits_index = tp-1: causal masking makes each
+            # row's logits at its true last prompt position identical
+            # to an UNPADDED prefill's, so first tokens match
+            # generate() exactly despite bucket AND group padding
+            logits, pcache = self._prefill(
+                self.params, jnp.asarray(padded),
+                jnp.asarray(tps - 1),
+            )
+            for row, (slot, req) in enumerate(grp):
+                self.cache = self._insert(
+                    self.cache, pcache, jnp.int32(slot), jnp.int32(row)
+                )
+            # first generated tokens occupy position tp — the same
+            # (rid, position) streams the chunk sampler continues
+            firsts = self._sample_first(
+                logits, jnp.asarray(rids), jnp.asarray(tps)
+            )
+            sm = jnp.asarray(slot_map)
+            self._cur_dev = self._merge_vec(self._cur_dev, firsts, sm)
+            self._pos_dev = self._merge_vec(
+                self._pos_dev, jnp.asarray(tps), sm
+            )
+            self._pending_first.append(
+                ([req for _, req in grp], firsts)
+            )
+            for slot, req in grp:
+                req.emitted = 1
+                req.slot = slot
+                self._slot_req[slot] = req
+                self.rid_vec[slot] = req.rid
+                if req.done:  # max_new_tokens == 1
+                    self._retire(slot)
 
     def _retire(self, slot: int) -> None:
         req = self._slot_req[slot]
@@ -321,6 +392,32 @@ class LMServer:
         self._slot_req[slot] = None
         self.rid_vec[slot] = 0
 
+    @staticmethod
+    def _distribute_firsts(entries, vals, off) -> int:
+        """Append each pending group's first tokens to its requests'
+        outputs from the packed buffer `vals` starting at `off`; rows
+        past a group's real requests are padding. Shared by step()'s
+        packed readback and _flush_firsts — the offset walk must stay
+        identical or tokens land on the wrong requests."""
+        for reqs, v in entries:
+            for i, req in enumerate(reqs):
+                req.out.append(int(vals[off + i]))
+            off += int(v.shape[0])
+        return off
+
+    def _flush_firsts(self) -> None:
+        """Read back any placement-time first tokens that haven't
+        ridden a step's packed readback (e.g. a budget-1 request that
+        retired at placement with no step following). A blocking link
+        round-trip — callers gate it (take_done flushes only when a
+        pending request is actually done)."""
+        if not self._pending_first:
+            return
+        entries = self._pending_first
+        self._pending_first = []
+        vals = np.asarray(jnp.concatenate([v for _, v in entries]))
+        self._distribute_firsts(entries, vals, 0)
+
     def step(self) -> None:
         """One chunked dispatch: every active slot advances up to
         `chunk` tokens; finished slots free and waiting requests take
@@ -329,25 +426,34 @@ class LMServer:
             self._place_waiting()
             if not any(r is not None for r in self._slot_req):
                 return
-        self.cache, cur, pos, toks = self._chunk_fn(
-            self.params, self.cache, jnp.asarray(self.cur),
-            jnp.asarray(self.pos), jnp.asarray(self.rid_vec),
+        firsts = self._pending_first
+        self._pending_first = []
+        self.cache, self._cur_dev, self._pos_dev, toks = self._chunk_fn(
+            self.params, self.cache, self._cur_dev, self._pos_dev,
+            jnp.asarray(self.rid_vec),
         )
-        # ONE packed readback: toks/cur/pos are three separate device
-        # buffers, and each blocking np.asarray costs a full link
-        # round-trip on a remoted chip
-        packed = np.asarray(jnp.concatenate([jnp.ravel(toks), cur]))
+        # ONE packed readback per step — chunk tokens plus any
+        # placement first tokens deferred since the last one. cur/pos
+        # never come back to the host (device-authoritative); each
+        # blocking np.asarray costs a full link round-trip on a
+        # remoted chip, and this is now the ONLY one in the serve loop
+        packed = np.asarray(jnp.concatenate(
+            [jnp.ravel(toks)] + [v for _, v in firsts]
+        ))
         n = self.chunk * self.max_slots
         toks = packed[:n].reshape(self.chunk, self.max_slots)
-        cur = packed[n:]
-        del pos  # host self.pos is advanced per-slot below
+        self._distribute_firsts(firsts, packed, n)
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
-            take = min(self.chunk, req.max_new_tokens - len(req.out))
+            take = min(self.chunk, req.max_new_tokens - req.emitted)
             req.out.extend(int(t) for t in toks[:take, slot])
-            self.pos[slot] = self.pos[slot] + take
-            self.cur[slot] = int(toks[take - 1, slot]) if take else cur[slot]
+            req.emitted += take
+            # take < chunk ⇒ the request retires here; the slot's
+            # device cur/pos ran past its budget, which the next
+            # insert's full overwrite erases (the _insert_impl
+            # invariant) — an ACTIVE continuation always has
+            # take == chunk, so device and host never disagree
             if req.done:
                 self._retire(slot)
         self._place_waiting()
@@ -362,7 +468,18 @@ class LMServer:
         """Drain finished requests: {rid: generated tokens}. The
         incremental form of run()'s result — LMDriver calls this after
         every step to deliver each batch's results the moment its last
-        request retires, without waiting for the whole grid to drain."""
+        request retires, without waiting for the whole grid to drain.
+        Deferred first tokens are flushed ONLY when a pending request
+        has actually retired (a budget-1 request can retire at
+        placement with its one token still on device): an
+        unconditional flush would re-add the blocking placement-round
+        readback the deferred-first protocol exists to remove — the
+        driver calls take_done every loop iteration, right after
+        step() defers the newly placed round's firsts."""
+        if any(
+            r.done for reqs, _ in self._pending_first for r in reqs
+        ):
+            self._flush_firsts()
         out = {
             rid: np.asarray(r.out, np.int32)
             for rid, r in self._done.items()
@@ -389,6 +506,7 @@ class LMServer:
         want = set(rids)
         while (want - set(self._done)) and self.has_work():
             self.step()
+        self._flush_firsts()  # a wanted budget-1 rid may have no step
         out = {}
         for rid in want:
             r = self._done.pop(rid, None)
