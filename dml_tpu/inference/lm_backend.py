@@ -33,12 +33,40 @@ from .generate import LMConfig
 from .lm_server import LMDriver, LMServer
 
 
-def parse_prompt_file(path: str, vocab_size: int) -> np.ndarray:
-    """Token ids from a prompt file; raises with the offending path on
-    malformed content (the job pipeline surfaces it as a batch FAIL)."""
+def parse_prompt_file(
+    path: str, vocab_size: int
+) -> Tuple[np.ndarray, Optional[int]]:
+    """(token ids, per-request budget or None) from a prompt file;
+    raises with the offending path on malformed content (the job
+    pipeline surfaces it as a batch FAIL).
+
+    A line starting with ``#`` is a directive; ``# max_new_tokens: N``
+    sets this request's generation budget (else the backend's
+    default). Mixed budgets are where continuous batching earns its
+    keep: a batch-synchronous server holds every slot until the
+    SLOWEST request finishes, while the slot grid refills the moment
+    each one retires (bench `lm.mixed_budget_batching`)."""
+    budget: Optional[int] = None
+    body: List[str] = []
     with open(path) as f:
-        text = f.read()
-    toks = [t for t in text.replace(",", " ").split() if t]
+        for line in f:
+            s = line.strip()
+            if s.startswith("#"):
+                m = s[1:].split(":", 1)
+                if len(m) == 2 and m[0].strip() == "max_new_tokens":
+                    try:
+                        budget = int(m[1])
+                    except ValueError:
+                        raise ValueError(
+                            f"{path}: bad max_new_tokens directive {s!r}"
+                        ) from None
+                    if budget < 1:
+                        raise ValueError(
+                            f"{path}: max_new_tokens must be >= 1"
+                        )
+                continue
+            body.append(line)
+    toks = [t for t in " ".join(body).replace(",", " ").split() if t]
     if not toks:
         raise ValueError(f"{path}: empty prompt file")
     try:
@@ -49,7 +77,7 @@ def parse_prompt_file(path: str, vocab_size: int) -> np.ndarray:
         raise ValueError(
             f"{path}: token id out of range [0, {vocab_size})"
         )
-    return ids
+    return ids, budget
 
 
 class LMBackend:
@@ -120,26 +148,33 @@ class LMBackend:
         `backend()`. `on_dispatch` (overlap mode) fires once the
         prompts are submitted to the shared driver, so the caller's
         pipeline can promote its next staged batch immediately."""
-        prompts = [
+        parsed = [
             parse_prompt_file(p, self.cfg.vocab_size) for p in paths
+        ]
+        prompts = [ids for ids, _ in parsed]
+        # per-file `# max_new_tokens: N` directives override the
+        # backend default — mixed budgets let the slot grid refill
+        # per-request instead of per-batch
+        budgets = [
+            b if b is not None else self.max_new_tokens
+            for _, b in parsed
         ]
         # validate EVERY prompt against server capacity before
         # submitting ANY: a mid-batch submit() failure would leave the
         # earlier requests queued in the shared server (decoded and
         # discarded on the next batch — and again per requeue retry),
         # and the server's own error has no file path in it
-        limit = self.server.max_len - self.max_new_tokens
-        for p, prompt in zip(paths, prompts):
-            if prompt.size > limit:
+        for p, prompt, budget in zip(paths, prompts, budgets):
+            if prompt.size + budget > self.server.max_len:
                 raise ValueError(
                     f"{p}: prompt of {prompt.size} tokens + budget "
-                    f"{self.max_new_tokens} exceeds the server's "
+                    f"{budget} exceeds the server's "
                     f"max_len {self.server.max_len}"
                 )
         if self.overlap:
             t0 = time.monotonic()
             toks = self.driver.serve(
-                prompts, self.max_new_tokens, on_dispatch=on_dispatch
+                prompts, budgets, on_dispatch=on_dispatch
             )
             infer_time = time.monotonic() - t0
             results = {
@@ -152,9 +187,7 @@ class LMBackend:
                 # preempted decode is queueing, not this batch's cost —
                 # it must not inflate the scheduler's per_query model
                 t0 = time.monotonic()
-                rids = self.server.submit_many(
-                    prompts, self.max_new_tokens
-                )
+                rids = self.server.submit_many(prompts, budgets)
                 # run(rids): drain only OUR requests — a bare run()
                 # would also consume (and discard) results of any
                 # in-flight driver tickets sharing the grid
@@ -294,8 +327,15 @@ class LMBackend:
         return be
 
 
-def write_prompt_file(path: str, tokens: Sequence[int]) -> None:
+def write_prompt_file(
+    path: str,
+    tokens: Sequence[int],
+    max_new_tokens: Optional[int] = None,
+) -> None:
     """Inverse of parse_prompt_file — the client-side helper for
-    seeding prompt files into the store."""
+    seeding prompt files into the store. `max_new_tokens` emits the
+    per-request budget directive."""
     with open(path, "w") as f:
+        if max_new_tokens is not None:
+            f.write(f"# max_new_tokens: {int(max_new_tokens)}\n")
         f.write(" ".join(str(int(t)) for t in tokens))

@@ -282,19 +282,32 @@ class LMServer:
         return prompt
 
     def submit_many(
-        self, prompts: Sequence[np.ndarray], max_new_tokens: int
+        self,
+        prompts: Sequence[np.ndarray],
+        max_new_tokens,
     ) -> List[int]:
         """Queue a burst of requests and place them in ONE batched
-        round: per-request placement drains one scalar per call, which
-        through a remoted chip costs a full link round-trip each — a
-        burst of max_slots prompts pays max_slots round-trips where
-        one suffices. Validates EVERY prompt before queueing ANY
-        (atomic), preserving sequential submit()'s rid order."""
-        validated = [self._validate(p, max_new_tokens) for p in prompts]
+        round. `max_new_tokens` is an int shared by the burst or a
+        per-prompt sequence — mixed budgets are continuous batching's
+        home turf: each slot refills the moment ITS request retires
+        instead of waiting out the burst's slowest. Validates EVERY
+        prompt before queueing ANY (atomic), preserving sequential
+        submit()'s rid order."""
+        if isinstance(max_new_tokens, (int, np.integer)):
+            budgets = [int(max_new_tokens)] * len(prompts)
+        else:
+            budgets = [int(b) for b in max_new_tokens]
+            if len(budgets) != len(prompts):
+                raise ValueError(
+                    f"{len(budgets)} budgets for {len(prompts)} prompts"
+                )
+        validated = [
+            self._validate(p, b) for p, b in zip(prompts, budgets)
+        ]
         reqs = []
-        for prompt in validated:
+        for prompt, b in zip(validated, budgets):
             self._rid += 1
-            reqs.append(_Request(self._rid, prompt, max_new_tokens))
+            reqs.append(_Request(self._rid, prompt, b))
         self._queue.extend(reqs)
         self._place_waiting()
         return [r.rid for r in reqs]
@@ -521,7 +534,7 @@ class _Ticket:
     when every request in the ticket has finished (or on error)."""
 
     prompts: List[np.ndarray]
-    max_new_tokens: int
+    max_new_tokens: Any  # int, or per-prompt sequence of ints
     event: threading.Event
     on_dispatch: Optional[Callable[[], None]] = None
     rids: Optional[List[int]] = None
@@ -589,14 +602,16 @@ class LMDriver:
     def serve(
         self,
         prompts: Sequence[np.ndarray],
-        max_new_tokens: int,
+        max_new_tokens,
         on_dispatch: Optional[Callable[[], None]] = None,
     ) -> List[np.ndarray]:
         """Blocking: decode `prompts`, return their completions in
-        order. Safe from any thread. `on_dispatch` fires (on the
-        DRIVER thread) the moment the ticket's prompts are submitted
-        to the server — the caller's pipeline can start preparing its
-        next batch from that point, not from completion."""
+        order. `max_new_tokens` is an int or a per-prompt sequence
+        (passed through to submit_many). Safe from any thread.
+        `on_dispatch` fires (on the DRIVER thread) the moment the
+        ticket's prompts are submitted to the server — the caller's
+        pipeline can start preparing its next batch from that point,
+        not from completion."""
         t = _Ticket(
             prompts=[np.asarray(p, np.int32).reshape(-1) for p in prompts],
             max_new_tokens=max_new_tokens,

@@ -44,13 +44,12 @@ def params():
 def test_parse_prompt_file(tmp_path):
     p = tmp_path / "a.tokens.txt"
     write_prompt_file(str(p), [3, 1, 4, 1, 5])
-    np.testing.assert_array_equal(
-        parse_prompt_file(str(p), 61), [3, 1, 4, 1, 5]
-    )
+    ids, budget = parse_prompt_file(str(p), 61)
+    np.testing.assert_array_equal(ids, [3, 1, 4, 1, 5])
+    assert budget is None
     (tmp_path / "b.tokens.txt").write_text("1, 2,3")
-    np.testing.assert_array_equal(
-        parse_prompt_file(str(tmp_path / "b.tokens.txt"), 61), [1, 2, 3]
-    )
+    ids, _ = parse_prompt_file(str(tmp_path / "b.tokens.txt"), 61)
+    np.testing.assert_array_equal(ids, [1, 2, 3])
     (tmp_path / "bad.txt").write_text("7 99")
     with pytest.raises(ValueError, match="out of range"):
         parse_prompt_file(str(tmp_path / "bad.txt"), 61)
@@ -60,6 +59,27 @@ def test_parse_prompt_file(tmp_path):
     (tmp_path / "nonint.txt").write_text("1 x")
     with pytest.raises(ValueError, match="non-integer"):
         parse_prompt_file(str(tmp_path / "nonint.txt"), 61)
+
+
+def test_parse_prompt_file_budget_directive(tmp_path):
+    """Per-request budgets ride the prompt file as a `#` directive
+    (mixed budgets = the continuous-batching case; bench
+    `lm.mixed_budget_batching`)."""
+    p = tmp_path / "a.tokens.txt"
+    write_prompt_file(str(p), [3, 1, 4], max_new_tokens=7)
+    ids, budget = parse_prompt_file(str(p), 61)
+    np.testing.assert_array_equal(ids, [3, 1, 4])
+    assert budget == 7
+    # unknown comment lines are ignored; bad budgets are loud
+    (tmp_path / "c.tokens.txt").write_text("# note: hi\n5 6")
+    ids, budget = parse_prompt_file(str(tmp_path / "c.tokens.txt"), 61)
+    assert budget is None and list(ids) == [5, 6]
+    (tmp_path / "d.tokens.txt").write_text("# max_new_tokens: zero\n5")
+    with pytest.raises(ValueError, match="bad max_new_tokens"):
+        parse_prompt_file(str(tmp_path / "d.tokens.txt"), 61)
+    (tmp_path / "e.tokens.txt").write_text("# max_new_tokens: 0\n5")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_prompt_file(str(tmp_path / "e.tokens.txt"), 61)
 
 
 def test_lm_backend_serve_files(params, tmp_path):

@@ -350,3 +350,53 @@ def test_run_with_rids_leaves_other_results(params):
     left = srv.run([ra])
     assert set(left) == {ra}
     np.testing.assert_array_equal(left[ra], _isolated(params, pa, 4))
+
+
+def test_mixed_budgets_exact_and_slots_refill(params):
+    """Per-request budgets in one burst: every output matches its own
+    isolated generate(), and short requests retire early so queued
+    work enters freed slots (the continuous-batching property the
+    mixed-budget bench row measures)."""
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, CFG.vocab_size, 4 + 2 * i) for i in range(5)]
+    budgets = [2, 9, 4, 7, 3]
+    srv = LMServer(params, CFG, max_slots=2, max_len=64, chunk=3)
+    rids = srv.submit_many(prompts, budgets)
+    out = srv.run()
+    for rid, p, b in zip(rids, prompts, budgets):
+        np.testing.assert_array_equal(
+            out[rid], _isolated(params, p, b), err_msg=f"req {rid}"
+        )
+    with pytest.raises(ValueError, match="budgets for"):
+        srv.submit_many(prompts, [1, 2])
+
+
+def test_backend_mixed_budget_files(params, tmp_path):
+    """serve_files honors per-file `# max_new_tokens` directives in
+    both serving modes; outputs equal isolated generate() at each
+    file's own budget."""
+    from dml_tpu.inference.lm_backend import LMBackend, write_prompt_file
+
+    rng = np.random.RandomState(10)
+    paths, prompts, budgets = [], [], [3, 8, None, 5]
+    for i, b in enumerate(budgets):
+        p = str(tmp_path / f"p{i}.tokens.txt")
+        prompt = rng.randint(0, CFG.vocab_size, 4 + 3 * i)
+        write_prompt_file(p, prompt, max_new_tokens=b)
+        paths.append(p)
+        prompts.append(prompt)
+
+    for overlap in (True, False):
+        be = LMBackend(params, CFG, max_new_tokens=6, max_slots=2,
+                       max_len=64, chunk=3)
+        be.overlap = overlap
+        try:
+            res, _, _ = be.serve_files(paths)
+        finally:
+            be.close()
+        for p, prompt, b in zip(paths, prompts, budgets):
+            np.testing.assert_array_equal(
+                res[p]["tokens"],
+                _isolated(params, prompt, b if b is not None else 6),
+                err_msg=f"{p} overlap={overlap}",
+            )
