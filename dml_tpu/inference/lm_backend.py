@@ -64,6 +64,15 @@ def parse_prompt_file(
                         raise ValueError(
                             f"{path}: max_new_tokens must be >= 1"
                         )
+                elif "max_new_tokens" in s:
+                    # a near-miss ('# max_new_tokens 64', wrong case)
+                    # must be LOUD, not silently served at the default
+                    # budget
+                    raise ValueError(
+                        f"{path}: unparseable max_new_tokens "
+                        f"directive {s!r} (expected "
+                        f"'# max_new_tokens: N')"
+                    )
                 continue
             body.append(line)
     toks = [t for t in " ".join(body).replace(",", " ").split() if t]
@@ -335,6 +344,11 @@ def write_prompt_file(
     """Inverse of parse_prompt_file — the client-side helper for
     seeding prompt files into the store. `max_new_tokens` emits the
     per-request budget directive."""
+    if max_new_tokens is not None and int(max_new_tokens) < 1:
+        # reject at the WRITER: a bad budget seeded into the store
+        # would otherwise fail at every worker's parse as repeated
+        # batch FAILs instead of one loud client-side error
+        raise ValueError("max_new_tokens must be >= 1")
     with open(path, "w") as f:
         if max_new_tokens is not None:
             f.write(f"# max_new_tokens: {int(max_new_tokens)}\n")

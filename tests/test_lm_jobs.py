@@ -155,12 +155,17 @@ async def _cluster_lm_run(params, tmp):
         # workers between the LM job and the image job
         rng = np.random.RandomState(1)
         prompts = {}
+        budgets = {}
+        # p2 carries a per-request budget directive: it must flow
+        # store -> scheduler -> worker backend -> merged output intact
         for i, tp in enumerate((4, 9, 13, 16)):
             prompt = rng.randint(0, CFG.vocab_size, tp)
             p = os.path.join(tmp, f"p{i}.tokens.txt")
-            write_prompt_file(p, prompt)
+            b = 3 if i == 2 else None
+            write_prompt_file(p, prompt, max_new_tokens=b)
             await client_store.put(p, f"p{i}.tokens.txt")
             prompts[f"p{i}.tokens.txt"] = prompt
+            budgets[f"p{i}.tokens.txt"] = b or NEW_TOKENS
         from PIL import Image
 
         for i in range(3):
@@ -187,11 +192,17 @@ async def _cluster_lm_run(params, tmp):
             expect = np.asarray(generate(
                 params, CFG,
                 jnp.asarray(np.asarray(prompts[fname], np.int32)[None]),
-                NEW_TOKENS,
+                budgets[fname],
             ))[0]
             np.testing.assert_array_equal(
                 out["tokens"], expect, err_msg=fname
             )
+        # the budget-directive file really produced ITS budget's
+        # length — p2 MUST be present (6 wrap-around queries over 4
+        # files cover every file), else this regression check is
+        # vacuous
+        assert "p2.tokens.txt" in merged
+        assert len(merged["p2.tokens.txt"]["tokens"]) == 3
         # C1 saw both models through one scheduler
         leader_jobs = next(j for n, _, j in stack if n.is_leader)
         c1 = leader_jobs.scheduler.c1_stats()
@@ -295,3 +306,15 @@ def test_canon_lm_names_case_insensitive(params, tmp_path):
             jobs._canon("other")
 
     aio.run(run())
+
+
+def test_budget_directive_near_miss_is_loud(tmp_path):
+    """A malformed budget directive must raise, not silently serve the
+    default budget; and write_prompt_file rejects bad budgets at the
+    writer (review findings)."""
+    p = tmp_path / "a.tokens.txt"
+    p.write_text("# max_new_tokens 64\n5")  # missing colon
+    with pytest.raises(ValueError, match="unparseable max_new_tokens"):
+        parse_prompt_file(str(p), 61)
+    with pytest.raises(ValueError, match=">= 1"):
+        write_prompt_file(str(tmp_path / "b.tokens.txt"), [1], max_new_tokens=0)
