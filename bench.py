@@ -1736,6 +1736,20 @@ def main() -> None:
     signal.signal(signal.SIGTERM, signal.SIG_IGN)
     signal.signal(signal.SIGINT, signal.SIG_IGN)
 
+    # Per-stage metrics-registry snapshot (observability.py): every
+    # counter/gauge/histogram the sections' serving paths updated —
+    # lm_server decode counters, worker stage timings, scheduler
+    # C1/C2, transport totals — summarized into the artifact so
+    # BENCH_r*.json carries the breakdown behind its headline numbers.
+    # tools/claim_check.py validates this block's presence from round
+    # 6 on; the try guards the INFALLIBLE final print.
+    try:
+        from dml_tpu.observability import bench_metrics_block
+
+        metrics_block = bench_metrics_block()
+    except Exception as e:  # pragma: no cover - defensive
+        metrics_block = {"error": repr(e)}
+
     hl = out.get("headline_resnet50_b32", {})
     baseline_qps = 4.0  # reference: 250 ms/image CPU steady state
 
@@ -1818,6 +1832,7 @@ def main() -> None:
         "bench_wall_s": round(time.monotonic() - t_start, 1),
         "wall_budget_s": budget_s,
         "matrix": out,
+        "metrics": metrics_block,
         "summary": summary,  # keep LAST: must survive the driver tail
     }, default=str), flush=True)
 

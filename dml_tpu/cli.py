@@ -74,6 +74,12 @@ job commands (ML inference):
   breakdown                         coordinator per-batch wall-time split +
                                     worker pipeline/decode-cache stats
 observability:
+  profile metrics [prom|json]       this node's metrics registry — summary
+                                    roll-up (default), Prometheus exposition
+                                    text, or the raw JSON snapshot
+  profile metrics cluster           leader-aggregated cluster view via
+                                    METRICS_PULL: per-model C1-C5 rates,
+                                    counts, latency mean + p50/p95/p99
   profile spans                     wall-clock span stats (store/job hot paths)
   profile trace start [dir]         capture a jax.profiler (XLA) trace
   profile trace stop                stop + write the trace
@@ -243,9 +249,30 @@ class NodeApp:
             r = await j.restore_jobs(ver, force="force" in a)
             print(f"ok jobs={r['jobs']} queued_batches={r['queued_batches']}")
         elif cmd == "profile" and a:
-            from .observability import SPANS
+            from .observability import METRICS, SPANS, summarize_snapshot
 
-            if a[0] == "spans":
+            if a[0] == "metrics":
+                sub = a[1] if len(a) > 1 else "summary"
+                if sub == "prom":
+                    # Prometheus exposition text (scrape-ready; pipe to
+                    # a file and point a file_sd/textfile collector at it)
+                    print(METRICS.to_prometheus_text(), end="")
+                elif sub == "json":
+                    print(json.dumps(
+                        METRICS.snapshot(node=n.me.unique_name), indent=2
+                    ))
+                elif sub == "cluster":
+                    view = await n.pull_cluster_metrics()
+                    print(json.dumps({
+                        "nodes_reporting": sorted(view["nodes"]),
+                        "merged_from": view["cluster"]["merged_from"],
+                        "summary": view["summary"],
+                    }, indent=2))
+                else:
+                    print(json.dumps(
+                        summarize_snapshot(METRICS.snapshot()), indent=2
+                    ))
+            elif a[0] == "spans":
                 print(json.dumps(SPANS.summary(), indent=2))
             elif a[0] == "trace" and len(a) >= 2 and a[1] == "start":
                 import jax
@@ -259,7 +286,8 @@ class NodeApp:
                 jax.profiler.stop_trace()
                 print("trace written (view with TensorBoard profile/Perfetto)")
             else:
-                print("usage: profile spans | profile trace start [dir] | "
+                print("usage: profile metrics [prom|json|cluster] | "
+                      "profile spans | profile trace start [dir] | "
                       "profile trace stop")
         elif cmd == "C1":
             for m, stats in j.c1_stats().items():

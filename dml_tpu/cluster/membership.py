@@ -25,9 +25,23 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..config import ClusterSpec, NodeId
+from ..observability import METRICS
 
 ALIVE = 1
 SUSPECT = 0
+
+# SWIM failure-detector events as registry metrics (the fp-rate CLI
+# counters, made scrapeable and cluster-aggregatable)
+_M_SUSPECT = METRICS.counter(
+    "cluster_suspicions_total",
+    "nodes marked SUSPECT (direct + gossip-indirect)")
+_M_FALSE_POS = METRICS.counter(
+    "cluster_false_positives_total",
+    "suspects that proved alive before cleanup")
+_M_FAILED = METRICS.counter(
+    "cluster_node_failures_total", "suspects cleaned up as dead")
+_M_ALIVE = METRICS.gauge(
+    "cluster_alive_nodes", "members this node currently sees ALIVE")
 
 
 @dataclass
@@ -117,14 +131,17 @@ class MembershipList:
                 if status == SUSPECT:
                     self._suspect_since[uname] = self.clock()
                     self.indirect_failures += 1
+                    _M_SUSPECT.inc()
                 continue
             if ts > cur[0]:
                 if cur[1] == SUSPECT and status == ALIVE:
                     self.false_positives += 1
+                    _M_FALSE_POS.inc()
                     self._suspect_since.pop(uname, None)
                 if cur[1] == ALIVE and status == SUSPECT:
                     self._suspect_since[uname] = self.clock()
                     self.indirect_failures += 1
+                    _M_SUSPECT.inc()
                 if cur[1] != status:
                     changed = True
                 self._members[uname] = (ts, status)
@@ -143,6 +160,7 @@ class MembershipList:
             return
         self._members[unique_name] = (self.clock(), SUSPECT)
         self._suspect_since[unique_name] = self.clock()
+        _M_SUSPECT.inc()
         self.recompute_ping_targets()
         if self.hooks.on_topology_change:
             self.hooks.on_topology_change()
@@ -155,6 +173,7 @@ class MembershipList:
         changed = cur is None or cur[1] == SUSPECT
         if cur is not None and cur[1] == SUSPECT:
             self.false_positives += 1
+            _M_FALSE_POS.inc()
         self._tombstones.pop(unique_name, None)  # direct evidence beats a tombstone
         self._suspect_since.pop(unique_name, None)
         self._members[unique_name] = (self.clock(), ALIVE)
@@ -187,6 +206,7 @@ class MembershipList:
             if now - since >= self.spec.timing.cleanup_time
         ]
         for uname in expired:
+            _M_FAILED.inc()
             ent = self._members.pop(uname, None)
             if ent is not None:
                 self._tombstones[uname] = ent[0]
@@ -227,6 +247,9 @@ class MembershipList:
         suspects and not-yet-joined nodes — the reference does this
         with a recursive replacement search (_find_replacement_node);
         computing from the canonical ring is equivalent and simpler."""
+        _M_ALIVE.set(
+            sum(1 for _, st in self._members.values() if st == ALIVE)
+        )
         ring = self.spec.ring()
         if self.me not in ring or len(ring) <= 1:
             self._ping_targets = []

@@ -440,6 +440,104 @@ class Node:
         self.register(MsgType.ELECTION, self._h_election)
         self.register(MsgType.COORDINATE, self._h_coordinate)
         self.register(MsgType.COORDINATE_ACK, self._h_coordinate_ack)
+        self.register(MsgType.METRICS_PULL, self._h_metrics_pull)
+
+    async def _h_metrics_pull(self, msg: Message, addr) -> None:
+        """Reply with this process's metrics-registry snapshot (the
+        node-side half of the leader-aggregated cluster view),
+        degrading to fit the UDP frame cap: full snapshot -> bucket-
+        stripped (mean/count survive, percentiles drop for this node
+        only) -> counters+gauges only -> an explicit error reply. A
+        reply ALWAYS goes out — a node must degrade visibly, never
+        vanish from the cluster view because its registry grew."""
+        from .. import observability as obs
+
+        rid = msg.data.get("rid")
+        snap = obs.METRICS.snapshot(node=self.me.unique_name)
+        tiers = (
+            lambda: snap,
+            lambda: obs.strip_buckets(snap),
+            lambda: {
+                **{k: snap.get(k) for k in ("v", "proc", "ts", "node")},
+                "counters": snap.get("counters", {}),
+                "gauges": snap.get("gauges", {}),
+                "histograms": {},
+                "stripped": True,
+                "truncated": "histograms",
+            },
+        )
+        for i, tier in enumerate(tiers):
+            try:
+                self.send_unique(
+                    msg.sender,
+                    MsgType.METRICS_PULL_ACK,
+                    {"rid": rid, "ok": True, "metrics": tier()},
+                )
+                if i:
+                    log.warning(
+                        "%s: metrics snapshot over the frame cap, "
+                        "degraded to tier %d for %s",
+                        self.me.unique_name, i, msg.sender.unique_name,
+                    )
+                return
+            except ValueError:
+                continue
+        log.error(
+            "%s: metrics snapshot unsendable even without histograms",
+            self.me.unique_name,
+        )
+        self.send_unique(
+            msg.sender,
+            MsgType.METRICS_PULL_ACK,
+            {"rid": rid, "ok": False,
+             "error": "metrics snapshot exceeds datagram cap"},
+        )
+
+    async def pull_cluster_metrics(
+        self, timeout: float = 3.0
+    ) -> Dict[str, Any]:
+        """Aggregate every alive node's metrics snapshot into one
+        cluster view — the TPU-native analog of the reference
+        coordinator's C1-C5 console, but pull-based and typed. Run
+        from the leader for the operator console (any node CAN call
+        it; the view is the same).
+
+        Returns ``{"nodes": {unique_name: snapshot}, "cluster":
+        merged, "summary": C2-style roll-up of the merged view}``.
+        Unreachable peers are skipped (their absence is visible as a
+        missing key under ``nodes``). Totals dedupe by producing
+        process, so an in-process simulation's shared registry is
+        counted once (see observability.merge_snapshots)."""
+        from .. import observability as obs
+
+        snaps: Dict[str, Dict[str, Any]] = {
+            self.me.unique_name: obs.METRICS.snapshot(
+                node=self.me.unique_name
+            )
+        }
+
+        async def pull_one(peer: NodeId) -> None:
+            try:
+                reply = await self.request(
+                    peer, MsgType.METRICS_PULL, {}, timeout=timeout
+                )
+            except (asyncio.TimeoutError, TimeoutError):
+                return
+            snap = reply.get("metrics")
+            if isinstance(snap, dict):
+                snaps[peer.unique_name] = snap
+
+        await asyncio.gather(*(
+            pull_one(n)
+            for n in self.membership.alive_nodes()
+            if n.unique_name != self.me.unique_name
+        ))
+        merged = obs.merge_snapshots(list(snaps.values()))
+        return {
+            "nodes": snaps,
+            "cluster": merged,
+            "summary": obs.summarize_snapshot(merged),
+        }
 
     async def _h_ping(self, msg: Message, addr) -> None:
         """Merge piggybacked gossip, ACK with our own (reference PING

@@ -45,6 +45,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..config import ClusterSpec, NodeId, StoreConfig
+from ..observability import METRICS
 from .node import Node
 from .store.data_plane import DataPlane
 from .store.local_store import LocalStore
@@ -53,6 +54,28 @@ from .util import BoundedDict, leader_retry
 from .wire import Message, MsgType
 
 log = logging.getLogger(__name__)
+
+# Replicated-store client verbs + replica-side repair, as registry
+# metrics (the store_* rows of the METRICS_PULL cluster view). Client
+# histograms are END-TO-END walls: metadata RPC + data-plane transfer
+# + replication fan-out, as the caller experiences them.
+_M_PUTS = METRICS.counter(
+    "store_puts_total", "client PUT verbs completed on this node")
+_M_GETS = METRICS.counter(
+    "store_gets_total", "client GET verbs completed on this node")
+_M_DELETES = METRICS.counter(
+    "store_deletes_total", "client DELETE verbs completed on this node")
+_M_PUT_T = METRICS.histogram(
+    "store_put_seconds", "client PUT wall (replicated upload end-to-end)")
+_M_GET_T = METRICS.histogram(
+    "store_get_seconds", "client GET wall (metadata RPC + replica fetch)")
+_M_REPL = METRICS.counter(
+    "store_replications_total", "repair pulls completed on this replica")
+_M_REPL_FAIL = METRICS.counter(
+    "store_replication_failures_total", "repair pulls that failed here")
+_M_REPL_T = METRICS.histogram(
+    "store_replication_seconds",
+    "one repair pull (every version of one file from a survivor)")
 
 # the TCP data plane listens at udp_port + this offset on each node
 DATA_PORT_OFFSET = 10_000
@@ -194,6 +217,7 @@ class StoreService:
         if not os.path.isfile(local_path):
             raise FileNotFoundError(local_path)
         token = self.data_plane.expose(local_path)
+        t0 = time.monotonic()
         try:
             with span("store.put"):
                 reply = await self._leader_retry(
@@ -209,6 +233,8 @@ class StoreService:
             self.data_plane.unexpose(token)
         if not reply.get("ok"):
             raise RuntimeError(f"put {sdfs_name} failed: {reply.get('error')}")
+        _M_PUTS.inc()
+        _M_PUT_T.observe(time.monotonic() - t0)
         return reply
 
     async def get(
@@ -223,8 +249,12 @@ class StoreService:
         worker.py:1323-1354). Returns the version fetched."""
         from ..observability import span
 
+        t0 = time.monotonic()
         with span("store.get"):
-            return await self._get_impl(sdfs_name, local_path, version, timeout)
+            got = await self._get_impl(sdfs_name, local_path, version, timeout)
+        _M_GETS.inc()
+        _M_GET_T.observe(time.monotonic() - t0)
+        return got
 
     async def _get_impl(
         self,
@@ -340,6 +370,7 @@ class StoreService:
         )
         if not reply.get("ok"):
             raise RuntimeError(f"delete {sdfs_name} failed: {reply.get('error')}")
+        _M_DELETES.inc()
         return reply
 
     async def ls(self, sdfs_name: str) -> List[str]:
@@ -756,10 +787,13 @@ class StoreService:
         (reference replicate_file, file_service.py:52-61)."""
         file = msg.data["file"]
         source = self.node.spec.node_by_unique_name(msg.data["source"])
+        t0 = time.monotonic()
         try:
             if source is None:
                 raise RuntimeError(f"unknown source {msg.data['source']}")
             versions = await self.data_plane.replicate_from(data_addr(source), file)
+            _M_REPL.inc()
+            _M_REPL_T.observe(time.monotonic() - t0)
             self.node.send_unique(
                 msg.sender,
                 MsgType.REPLICATE_FILE_SUCCESS,
@@ -767,6 +801,7 @@ class StoreService:
             )
         except Exception as e:
             log.warning("%s: replicate %s failed: %s", self._me, file, e)
+            _M_REPL_FAIL.inc()
             self.node.send_unique(
                 msg.sender, MsgType.REPLICATE_FILE_FAIL, {"file": file, "error": str(e)}
             )

@@ -21,7 +21,23 @@ import random
 import time
 from typing import Callable, Optional, Tuple
 
+from ..observability import METRICS
 from .wire import Message
+
+# control-plane traffic accounting, labeled by message type (the
+# registry form of the reference's CLI option 9 byte counter)
+_M_SENT = METRICS.counter(
+    "transport_packets_sent_total", "datagrams sent, by message type")
+_M_SENT_BYTES = METRICS.counter(
+    "transport_bytes_sent_total", "payload bytes sent, by message type")
+_M_DROPPED = METRICS.counter(
+    "transport_packets_dropped_total",
+    "outbound datagrams dropped by loss injection / partition filter")
+_M_RECV = METRICS.counter(
+    "transport_packets_received_total",
+    "well-formed datagrams received, by message type")
+_M_RECV_BYTES = METRICS.counter(
+    "transport_bytes_received_total", "bytes received, by message type")
 
 
 class LossInjector:
@@ -88,6 +104,8 @@ class UdpTransport(asyncio.DatagramProtocol):
     def datagram_received(self, data: bytes, addr: Tuple[str, int]) -> None:
         msg = Message.unpack(data)
         if msg is not None:
+            _M_RECV.inc(1, type=msg.type.name)
+            _M_RECV_BYTES.inc(len(data), type=msg.type.name)
             self._queue.put_nowait((msg, addr))
 
     def error_received(self, exc) -> None:  # pragma: no cover - asyncio
@@ -122,15 +140,19 @@ class UdpTransport(asyncio.DatagramProtocol):
             raise RuntimeError("transport not bound")
         if self.partition_filter is not None and self.partition_filter(addr):
             self.packets_dropped += 1
+            _M_DROPPED.inc()
             return
         if self._loss.should_drop():
             self.packets_dropped += 1
+            _M_DROPPED.inc()
             return
         frame = msg.pack()
         if self.first_send_time is None:
             self.first_send_time = time.monotonic()
         self.bytes_sent += len(frame)
         self.packets_sent += 1
+        _M_SENT.inc(1, type=msg.type.name)
+        _M_SENT_BYTES.inc(len(frame), type=msg.type.name)
         self._transport.sendto(frame, addr)
 
     async def recv(self) -> Tuple[Message, Tuple[str, int]]:

@@ -103,6 +103,14 @@ class MsgType(enum.IntEnum):
     # pulled back into the queue when a second model's work arrived —
     # the fair split must see it as schedulable, not pinned to a worker
     WORKER_STAGE_CANCEL = 77
+    # observability (L8): any node (in practice the leader's console)
+    # pulls a peer's metrics-registry snapshot; the ACK carries the
+    # JSON snapshot (sparse histogram buckets), degrading tier by tier
+    # to fit the datagram cap: full -> bucket-stripped -> counters+
+    # gauges only -> explicit error reply. Aggregation =
+    # observability.merge_snapshots.
+    METRICS_PULL = 80
+    METRICS_PULL_ACK = 81
 
 
 @dataclass(frozen=True)

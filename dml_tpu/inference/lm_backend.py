@@ -22,6 +22,7 @@ regardless of which worker served it or what else shared the batch.
 from __future__ import annotations
 
 import asyncio
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -64,10 +65,14 @@ def parse_prompt_file(
                         raise ValueError(
                             f"{path}: max_new_tokens must be >= 1"
                         )
-                elif "max_new_tokens" in s:
-                    # a near-miss ('# max_new_tokens 64', wrong case)
-                    # must be LOUD, not silently served at the default
-                    # budget
+                elif re.match(r"^#\s*max_new_tokens\b", s):
+                    # a near-miss DIRECTIVE ('# max_new_tokens 64',
+                    # missing colon) must be LOUD, not silently served
+                    # at the default budget. Only comments that START
+                    # with the directive name trip this: an innocuous
+                    # mention ('# see max_new_tokens docs') is prose,
+                    # not a failed directive, and must not hard-fail
+                    # the whole batch
                     raise ValueError(
                         f"{path}: unparseable max_new_tokens "
                         f"directive {s!r} (expected "

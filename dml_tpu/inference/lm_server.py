@@ -62,13 +62,16 @@ the job scheduler's one-batch-per-worker fair-share loop
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import METRICS
 from .generate import (
     LMConfig,
     _sample,
@@ -76,6 +79,44 @@ from .generate import (
     init_cache,
     prefill,
 )
+
+log = logging.getLogger(__name__)
+
+# Serve-loop instrumentation (see observability.py's C1-C5 map). All
+# updates are host-side O(1) dict writes OUTSIDE the jitted chunk /
+# prefill programs, at per-DISPATCH granularity (a step covers
+# chunk × slots tokens), so the decode path's device rate is
+# unaffected. Handles are bound once at import: no name lookups on
+# the hot path.
+_M_REQS = METRICS.counter(
+    "lm_server_requests_total", "requests submitted to the slot grid")
+_M_REQS_DONE = METRICS.counter(
+    "lm_server_requests_completed_total", "requests fully decoded")
+_M_TOKENS = METRICS.counter(
+    "lm_server_decode_tokens_total",
+    "generated tokens delivered to request outputs")
+_M_STEPS = METRICS.counter(
+    "lm_server_steps_total", "chunked decode dispatches")
+_M_COMPILES = METRICS.counter(
+    "lm_server_compile_events_total",
+    "first-seen dispatch shapes per server (upper bound on XLA "
+    "compilations; jit caches may dedupe across servers)")
+_M_QUEUE_WAIT = METRICS.histogram(
+    "lm_server_queue_wait_seconds", "submit -> slot placement wait")
+_M_PREFILL = METRICS.histogram(
+    "lm_server_prefill_dispatch_seconds",
+    "host wall of one placement group's prefill + insert + merge "
+    "dispatch chain (async dispatch; device time shows up in step)")
+_M_STEP = METRICS.histogram(
+    "lm_server_step_seconds",
+    "one chunked decode step incl. its packed readback")
+_M_READBACK = METRICS.histogram(
+    "lm_server_readback_seconds",
+    "blocking device->host readbacks (the serve loop's only stalls)")
+_M_SLOTS = METRICS.gauge(
+    "lm_server_slots_active", "occupied decode slots")
+_M_SLOTS_TOTAL = METRICS.gauge(
+    "lm_server_slots_total", "slot grid capacity")
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -98,6 +139,7 @@ class _Request:
     out: List[int] = dataclasses.field(default_factory=list)
     emitted: int = 0
     slot: Optional[int] = None
+    t_submit: float = 0.0  # monotonic submit time (queue-wait metric)
 
     @property
     def done(self) -> bool:
@@ -179,8 +221,11 @@ class LMServer:
         )
         # fixed-shape masked merge for placement-time cur/pos writes:
         # slot_map[s] = the prefill row whose value slot s takes, or
-        # -1 to keep the current value. One compile serves every group
-        # size and slot assignment (the vectors are always [max_slots])
+        # -1 to keep the current value. `vec` and `slot_map` are
+        # always [max_slots]; `vals` carries the prefill group's kp
+        # rows, so this compiles once per distinct group-row count —
+        # the same (few, power-of-two) kp variants the group prefill
+        # itself mints, not one per slot assignment
         self._merge_vec = jax.jit(
             lambda vec, vals, slot_map: jnp.where(
                 slot_map >= 0, vals[jnp.clip(slot_map, 0, None)], vec
@@ -191,6 +236,11 @@ class LMServer:
         # (rid, position) streams the chunk sampler continues)
         # prefill's logits are already [rows, vocab] (_head squeezes)
         self._sample_first = jax.jit(self._sample_slots)
+        # compile-event accounting: first-seen dispatch shapes on THIS
+        # server (each distinct shape costs one XLA compilation unless
+        # a jit/persistent cache already holds it)
+        self._seen_shapes: set = set()
+        _M_SLOTS_TOTAL.set(max_slots)
 
     def _insert_impl(self, cache, pcache, slot, row):
         """Copy row `row` of a (possibly group-batched) prefilled
@@ -241,7 +291,12 @@ class LMServer:
         """`chunk` batched decode steps in one dispatch. Per-slot pos
         is clamped to the last cache row on the device, making the
         empty-slot write target explicit — see _insert_impl's
-        invariant note."""
+        invariant note. The CLAMPED position is what the scan carries
+        forward: an active slot's pos never exceeds the last row (its
+        prompt + budget fits max_len, enforced at submit), so this is
+        an identity for live requests, while a freed slot's pos pins
+        at max_len instead of growing by `chunk` every step for the
+        life of the server."""
         last = self.max_len - 1
 
         def body(carry, _):
@@ -251,7 +306,7 @@ class LMServer:
                 params, self.cfg, cache, cur, pos_c
             )
             nxt = self._sample_slots(logits, rid, pos_c + 1)
-            return (cache, nxt, pos + 1), nxt
+            return (cache, nxt, pos_c + 1), nxt
 
         (cache, cur, pos), toks = jax.lax.scan(
             body, (cache, cur, pos), None, length=self.chunk
@@ -305,9 +360,11 @@ class LMServer:
             self._validate(p, b) for p, b in zip(prompts, budgets)
         ]
         reqs = []
+        now = time.monotonic()
         for prompt, b in zip(validated, budgets):
             self._rid += 1
-            reqs.append(_Request(self._rid, prompt, b))
+            reqs.append(_Request(self._rid, prompt, b, t_submit=now))
+        _M_REQS.inc(len(reqs))
         self._queue.extend(reqs)
         self._place_waiting()
         return [r.rid for r in reqs]
@@ -336,6 +393,7 @@ class LMServer:
             b = min(_bucket(req.prompt.size), self.max_len)
             groups.setdefault(b, []).append((slot, req))
         for bucket, grp in groups.items():
+            t_grp0 = time.monotonic()
             k = len(grp)
             # group-row padding policy: short buckets pad straight to
             # max_slots — ONE prefill compilation per bucket, which a
@@ -389,13 +447,21 @@ class LMServer:
             self._pending_first.append(
                 ([req for _, req in grp], firsts)
             )
+            now = time.monotonic()
+            shape = ("prefill", bucket, kp)
+            if shape not in self._seen_shapes:
+                self._seen_shapes.add(shape)
+                _M_COMPILES.inc()
+            _M_PREFILL.observe(now - t_grp0)
             for slot, req in grp:
+                _M_QUEUE_WAIT.observe(now - req.t_submit)
                 req.emitted = 1
                 req.slot = slot
                 self._slot_req[slot] = req
                 self.rid_vec[slot] = req.rid
                 if req.done:  # max_new_tokens == 1
                     self._retire(slot)
+        _M_SLOTS.set(sum(1 for r in self._slot_req if r is not None))
 
     def _retire(self, slot: int) -> None:
         req = self._slot_req[slot]
@@ -404,6 +470,7 @@ class LMServer:
         req.slot = None
         self._slot_req[slot] = None
         self.rid_vec[slot] = 0
+        _M_REQS_DONE.inc()
 
     @staticmethod
     def _distribute_firsts(entries, vals, off) -> int:
@@ -428,8 +495,11 @@ class LMServer:
             return
         entries = self._pending_first
         self._pending_first = []
+        t0 = time.monotonic()
         vals = np.asarray(jnp.concatenate([v for _, v in entries]))
+        _M_READBACK.observe(time.monotonic() - t0)
         self._distribute_firsts(entries, vals, 0)
+        _M_TOKENS.inc(sum(len(reqs) for reqs, _ in entries))
 
     def step(self) -> None:
         """One chunked dispatch: every active slot advances up to
@@ -439,8 +509,12 @@ class LMServer:
             self._place_waiting()
             if not any(r is not None for r in self._slot_req):
                 return
+        t_step0 = time.monotonic()
         firsts = self._pending_first
         self._pending_first = []
+        if "chunk" not in self._seen_shapes:
+            self._seen_shapes.add("chunk")
+            _M_COMPILES.inc()
         self.cache, self._cur_dev, self._pos_dev, toks = self._chunk_fn(
             self.params, self.cache, self._cur_dev, self._pos_dev,
             jnp.asarray(self.rid_vec),
@@ -450,18 +524,25 @@ class LMServer:
         # never come back to the host (device-authoritative); each
         # blocking np.asarray costs a full link round-trip on a
         # remoted chip, and this is now the ONLY one in the serve loop
+        t_rb0 = time.monotonic()
         packed = np.asarray(jnp.concatenate(
             [jnp.ravel(toks)] + [v for _, v in firsts]
         ))
+        _M_READBACK.observe(time.monotonic() - t_rb0)
         n = self.chunk * self.max_slots
         toks = packed[:n].reshape(self.chunk, self.max_slots)
         self._distribute_firsts(firsts, packed, n)
+        # deferred first tokens ride this readback: they are delivered
+        # tokens of this step (the chunk takes below cover budget - 1
+        # of each request, the placement-time first covers the rest)
+        delivered = sum(len(reqs) for reqs, _ in firsts)
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
             take = min(self.chunk, req.max_new_tokens - req.emitted)
             req.out.extend(int(t) for t in toks[:take, slot])
             req.emitted += take
+            delivered += take
             # take < chunk ⇒ the request retires here; the slot's
             # device cur/pos ran past its budget, which the next
             # insert's full overwrite erases (the _insert_impl
@@ -470,6 +551,10 @@ class LMServer:
             if req.done:
                 self._retire(slot)
         self._place_waiting()
+        _M_TOKENS.inc(delivered)
+        _M_STEPS.inc()
+        _M_SLOTS.set(sum(1 for r in self._slot_req if r is not None))
+        _M_STEP.observe(time.monotonic() - t_step0)
 
     def has_work(self) -> bool:
         """True while any request is queued or occupying a slot."""
@@ -632,12 +717,28 @@ class LMDriver:
 
     def stop(self) -> None:
         """Stop the driver thread (idempotent). In-flight tickets
-        finish first; new serve() calls are rejected."""
+        finish first; new serve() calls are rejected.
+
+        If the thread has not drained when the join times out (e.g. a
+        wedged device tunnel mid-chunk), the handle is KEPT and the
+        timeout logged loudly: that thread still owns the server's
+        slot grid, and dropping the reference would silently leak a
+        live driver (and let a future restart interleave two drivers
+        over one grid). A later stop() retries the join."""
         with self._cv:
             self._stop = True
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=60.0)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=60.0)
+            if t.is_alive():
+                log.error(
+                    "LMDriver thread %s did not stop within 60s; "
+                    "keeping the handle (it still owns the LMServer "
+                    "slot grid — likely a wedged device dispatch)",
+                    t.name,
+                )
+                return
             self._thread = None
 
     # -- driver thread -------------------------------------------------

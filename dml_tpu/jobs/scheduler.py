@@ -40,7 +40,37 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
+from ..observability import METRICS
 from .cost_model import ModelCost, fair_split, query_rate
+
+# Coordinator metrics: the registry form of the reference's C1/C2
+# console (see observability.py's C1-C5 map). The exact-sample
+# c1_stats/c2_stats read-outs below stay for reference parity; these
+# are the mergeable cluster-wide equivalents METRICS_PULL aggregates.
+_M_QUERIES = METRICS.counter(
+    "jobs_queries_total", "queries completed, per model (C1 count)")
+_M_RATE = METRICS.gauge(
+    "jobs_query_rate_per_s",
+    "trailing 10s per-model query rate, refreshed per batch ACK (C1)")
+_M_QUERY_LAT = METRICS.histogram(
+    "jobs_query_latency_seconds",
+    "per-query processing time, per model (C2: mean + percentiles)")
+_M_BATCH_EXEC = METRICS.histogram(
+    "jobs_batch_exec_seconds", "per-batch worker exec wall, per model")
+_M_QUEUE_DEPTH = METRICS.gauge(
+    "jobs_queue_depth", "queued batches, per model")
+_M_WORKERS_BUSY = METRICS.gauge(
+    "jobs_workers_busy", "workers with a batch in flight (C5 size)")
+_M_PREEMPTIONS = METRICS.counter(
+    "jobs_preemptions_total",
+    "batches displaced by the dual-model fair split")
+_M_REQUEUES = METRICS.counter(
+    "jobs_requeues_total",
+    "batches returned to a queue front (worker death + live failure)")
+_M_JOBS_DONE = METRICS.counter(
+    "jobs_completed_total", "jobs fully completed, per model")
+_M_JOBS_FAILED = METRICS.counter(
+    "jobs_failed_total", "jobs retired with an error, per model")
 
 
 @dataclass
@@ -146,10 +176,38 @@ class Scheduler:
         self.latency_samples: Dict[str, Deque[Tuple[float, float, int]]] = {}
         # per model: (timestamp, predicted_rate) per scheduling round
         self.rate_samples: Dict[str, Deque[Tuple[float, float]]] = {}
+        # read-time C1 rate refresh: without this the gauge freezes at
+        # its last batch-ACK value, so an idle coordinator would show
+        # phantom traffic in every scrape/METRICS_PULL forever. Held
+        # weakly by the registry — dies with this scheduler.
+        METRICS.add_collector(self._refresh_rate_gauges)
 
     # ------------------------------------------------------------------
     # model config
     # ------------------------------------------------------------------
+
+    def _refresh_gauges(self) -> None:
+        """Queue-depth and busy-worker gauges (C5-size view); called
+        wherever queues or in_progress change. O(active models)."""
+        for m, q in self.queues.items():
+            _M_QUEUE_DEPTH.set(len(q), model=m)
+        _M_WORKERS_BUSY.set(len(self.in_progress))
+
+    def _refresh_rate_gauges(self) -> None:
+        """Trailing-10s C1 rate gauge, recomputed from the sample
+        window NOW — runs on every batch ACK and (as a registry
+        collector) before every exposition, so the gauge decays to
+        zero on an idle coordinator exactly like the read-time
+        c1_stats it mirrors. Bounded walk: newest-first, stops at the
+        window edge."""
+        t = self.now()
+        for model, samples in self.latency_samples.items():
+            recent = 0
+            for ts, _, n in reversed(samples):
+                if ts < t - 10.0:
+                    break
+                recent += n
+            _M_RATE.set(recent / 10.0, model=model)
 
     def set_cost(self, model: str, cost: ModelCost) -> None:
         self.costs[model] = cost
@@ -235,6 +293,7 @@ class Scheduler:
         )
         self.jobs[job_id] = st
         self.observe_job_id(job_id)
+        self._refresh_gauges()
         return st
 
     # ------------------------------------------------------------------
@@ -270,6 +329,7 @@ class Scheduler:
         else:
             out = self._schedule_two(active[0], active[1], workers)
         self._record_rates(workers)
+        self._refresh_gauges()
         return out
 
     def _unstage_all(self) -> None:
@@ -366,6 +426,7 @@ class Scheduler:
                 # prefetch batch before a dual-model round can run)
                 displaced = self.in_progress[w]
                 self._queue(displaced.model).appendleft(displaced)
+                _M_PREEMPTIONS.inc()
                 batch = q.popleft()
                 self.in_progress[w] = batch
                 out.append(Assignment(worker=w, batch=batch, preempted=displaced))
@@ -422,12 +483,25 @@ class Scheduler:
                 break
         model = st.model
         self.query_counts[model] = self.query_counts.get(model, 0) + n_images
-        self.latency_samples.setdefault(
+        t = self.now()
+        samples = self.latency_samples.setdefault(
             model, deque(maxlen=self.max_samples)
-        ).append((self.now(), exec_time, n_images))
+        )
+        samples.append((t, exec_time, n_images))
+        # registry mirror of the C1/C2 console: counters + histograms
+        # METRICS_PULL can merge cluster-wide. Only the LIVE
+        # coordinator counts (shadow_prune deliberately does not, or a
+        # standby's shadow would double every query in the aggregate)
+        _M_QUERIES.inc(n_images, model=model)
+        _M_BATCH_EXEC.observe(exec_time, model=model)
+        if n_images > 0:
+            _M_QUERY_LAT.observe(exec_time / n_images, model=model)
+        self._refresh_rate_gauges()
+        self._refresh_gauges()
         st.pending_batches -= 1
         if st.pending_batches <= 0 and not st.done:
             st.done = True
+            _M_JOBS_DONE.inc(model=model)
             self._retire_job(job_id)
             return st
         return None
@@ -482,6 +556,8 @@ class Scheduler:
             return None
         self._queue(cur.model).appendleft(cur)
         self.requeue_count += 1
+        _M_REQUEUES.inc()
+        self._refresh_gauges()
         return cur
 
     def fail_job(self, job_id: int, error: str) -> Optional[JobState]:
@@ -494,11 +570,13 @@ class Scheduler:
             return None
         st.error = error
         st.done = True
+        _M_JOBS_FAILED.inc(model=st.model)
         q = self._queue(st.model)
         for b in [b for b in q if b.job_id == job_id]:
             q.remove(b)
         self._retire_job(job_id)
         self._newly_failed.append(st)
+        self._refresh_gauges()
         return st
 
     def pop_failed_jobs(self) -> List[JobState]:
@@ -514,12 +592,15 @@ class Scheduler:
         if staged is not None:
             self._queue(staged.model).appendleft(staged)
             self.requeue_count += 1
+            _M_REQUEUES.inc()
         batch = self.in_progress.pop(worker, None)
         if batch is not None:
             # primary requeued after the staged batch so it lands at
             # the very front (it was assigned first)
             self._queue(batch.model).appendleft(batch)
             self.requeue_count += 1
+            _M_REQUEUES.inc()
+        self._refresh_gauges()
         return batch
 
     def drop_worker(self, worker: str) -> None:

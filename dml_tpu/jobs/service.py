@@ -43,10 +43,35 @@ from ..cluster.store_service import StoreService, data_addr
 from ..cluster.util import BoundedDict, leader_retry
 from ..cluster.wire import Message, MsgType
 from ..models.registry import MODEL_REGISTRY, get_model
+from ..observability import METRICS
 from .cost_model import ModelCost
 from .scheduler import Assignment, Batch, Scheduler
 
 log = logging.getLogger(__name__)
+
+# Worker-side stage timings + counters (the registry form of the
+# ACK-carried breakdown the coordinator folds into breakdown_stats);
+# labeled by model so METRICS_PULL shows where each model's batch wall
+# goes on every node
+_M_BATCHES = METRICS.counter(
+    "worker_batches_total", "batches executed on this node, per model")
+_M_BATCH_FAILS = METRICS.counter(
+    "worker_batch_failures_total",
+    "batches this node reported as failed, per model")
+_M_FETCH = METRICS.histogram(
+    "worker_fetch_seconds", "store replica fetch per batch")
+_M_INFER = METRICS.histogram(
+    "worker_infer_seconds",
+    "backend infer call per batch (device forward + dispatch)")
+_M_PUT = METRICS.histogram(
+    "worker_put_seconds", "output JSON write + replicated store PUT")
+_M_ACKS = METRICS.counter(
+    "coordinator_batch_acks_total",
+    "worker batch ACKs processed by the coordinator, per model")
+_M_CACHE_HITS = METRICS.counter(
+    "worker_decode_cache_hits_total", "decoded-input cache hits")
+_M_CACHE_MISSES = METRICS.counter(
+    "worker_decode_cache_misses_total", "decoded-input cache misses")
 
 # (files_dict, exec_time_s, cost_constants_or_None)
 InferBackend = Callable[[str, List[str]], Awaitable[Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]]]
@@ -729,6 +754,7 @@ class JobService:
             return
         d = msg.data
         job_id, batch_id = int(d["job"]), int(d["batch"])
+        _M_ACKS.inc(model=d.get("model", ""))
         cost = d.get("cost")
         if cost:
             self._fold_cost(d.get("model", ""), cost)
@@ -1365,6 +1391,10 @@ class JobService:
                     self.decode_cache_misses += 1
                     miss_idx.append(i)
         if miss_idx:
+            _M_CACHE_MISSES.inc(len(miss_idx))
+        if len(paths) - len(miss_idx):
+            _M_CACHE_HITS.inc(len(paths) - len(miss_idx))
+        if miss_idx:
             decoded = load_images([paths[i] for i in miss_idx], size)
             with self._decode_cache_lock:
                 for j, i in enumerate(miss_idx):
@@ -1401,6 +1431,7 @@ class JobService:
                      t_prep_end) = await self._prepare(batch)
                 else:
                     paths, imgs, t_fetch, t_decode, t0, t_prep_end = await prep
+            _M_FETCH.observe(t_fetch)
             t1 = time.monotonic()
             # staged batches park between prepare finishing and
             # promotion (waiting out the previous batch's inference) —
@@ -1436,6 +1467,7 @@ class JobService:
                     # (the engine path promoted at dispatch)
                     self._promote_staged()
             t_backend = (time.monotonic() - t1) + t_decode
+            _M_INFER.observe(infer_time)
             # backends key results by the LOCAL path (the engine uses
             # the full path, others may use the basename), which
             # differs by how the input materialized (store-replica hit
@@ -1461,6 +1493,8 @@ class JobService:
                 # shard, which the reference tolerates identically
                 log.warning("%s: PUT of %s failed: %s", self._me, out_name, e)
             t_put = time.monotonic() - t_put0
+            _M_PUT.observe(t_put)
+            _M_BATCHES.inc(model=batch.model)
             self.node.send_unique(
                 coordinator if self.node.leader_unique is None else self.node.leader_unique,
                 MsgType.WORKER_TASK_REQUEST_ACK,
@@ -1492,6 +1526,7 @@ class JobService:
             raise
         except Exception as e:
             log.exception("%s: batch %s failed", self._me, batch.key)
+            _M_BATCH_FAILS.inc(model=batch.model)
             # tell the coordinator so it requeues the batch and frees
             # this worker — silence would wedge the job forever
             self.node.send_unique(

@@ -1,25 +1,667 @@
-"""Tracing, profiling, and structured logging.
+"""Typed cluster metrics, tracing, profiling, and structured logging.
 
-The reference's observability is print-based wall-clock spans and a
-debug.log (SURVEY §5: no tracing, no profiling). The TPU-native
-equivalents:
+The reference system's defining operator surface is the coordinator's
+live console: C1 prints the 10-second query rate and total query count
+per model, C2 the per-query latency mean/percentiles/std per model, C3
+confirms batch-size changes, C5 the current worker->batch assignments
+(reference worker.py:1394-1428, 1744-1808). This module is the
+TPU-native generalization of that console: a typed, process-wide
+metrics registry every subsystem writes into, plus the exposition
+surfaces (Prometheus text, JSON dumps, leader-aggregated METRICS_PULL,
+bench-artifact blocks) that make the numbers reachable.
 
-- `profile()` — jax.profiler trace context producing TensorBoard /
-  Perfetto traces of the XLA programs (compile + execute + transfers)
-- `span()` — lightweight wall-clock spans collected into a process
-  registry (the reference's `PUT runtime:` prints, structured)
-- `jsonl_logging()` — one-JSON-object-per-line log formatting for
-  machine-readable node logs
+Metric model
+------------
+
+- ``Counter`` — monotonically increasing totals (queries served,
+  tokens decoded, datagrams sent). Merge across nodes by summing.
+- ``Gauge`` — instantaneous values (active slots, queue depth,
+  trailing query rate). Merge by summing (cluster capacity view).
+- ``Histogram`` — streaming distributions over FIXED LOG-SPACED
+  buckets; p50/p95/p99 are computed from the bucket counts with
+  geometric interpolation, so percentiles need O(buckets) memory, are
+  mergeable across nodes, and never require keeping raw samples.
+
+All three take labels (``model=``, ``role=``, ``peer=``, ``type=``);
+one metric object fans out into per-label-set children. Updates are
+host-side, O(1), lock-protected dict writes — they live OUTSIDE any
+jitted device step, so instrumentation cannot perturb a compiled
+program (the continuous-batching decode path updates a handful of
+counters per CHUNK dispatch, not per token).
+
+Reference C1–C5 -> registry map
+-------------------------------
+
+- **C1** (per-model query count + 10 s rate): ``jobs_queries_total``
+  counter + ``jobs_query_rate_per_s`` gauge (scheduler refreshes the
+  trailing-window rate on every batch ACK).
+- **C2** (per-query processing-time mean/std/percentiles):
+  ``jobs_query_latency_seconds`` histogram per model — mean from
+  sum/count, p50/p95/p99 from the log buckets. The exact-sample C2
+  console (``Scheduler.c2_stats``) remains for parity with the
+  reference; the histogram is the mergeable cluster-wide form.
+- **C3** (batch size): ``jobs_batch_exec_seconds`` per model shows the
+  effect; the authoritative setting stays in the scheduler cost model.
+- **C5** (worker->batch assignments): ``jobs_workers_busy`` gauge +
+  ``Scheduler.c5_assignments()`` for the exact map.
+
+Beyond the reference (net-new subsystems get the same treatment):
+``lm_server_*`` (queue wait, prefill dispatch, per-step decode tokens,
+slot occupancy, compile events, readback stalls), ``worker_*``
+(fetch/infer/put stage timings, decode-cache hits), ``cluster_*``
+(SWIM suspicion/failure/false-positive events, alive-node gauge),
+``transport_*`` (datagram + byte counters by message type), and
+``store_*`` (put/get/replication timing and counts).
+
+Exposition
+----------
+
+- ``METRICS.snapshot()`` — JSON-able dump (sparse buckets) used by the
+  ``METRICS_PULL`` wire message: the leader pulls every node's
+  snapshot and ``merge_snapshots`` folds them into one cluster view
+  (``Node.pull_cluster_metrics``), the TPU-native analog of the
+  reference coordinator's console.
+- ``to_prometheus_text()`` — Prometheus exposition format (CLI
+  ``profile metrics prom``), scrape-ready.
+- ``bench_metrics_block()`` — the ``metrics`` block embedded in bench
+  artifacts so BENCH_r*.json carries per-stage breakdowns
+  (tools/claim_check.py validates its presence from round 6 on).
+
+In-process simulations (tests) run many nodes in ONE process sharing
+this module-global registry; snapshots carry the pid and
+``merge_snapshots`` counts each process once, so the sim's cluster
+totals equal the (shared) registry instead of multiplying by the node
+count, while real one-process-per-node deployments sum normally.
+
+Also here, unchanged from the seed: ``profile()`` (jax.profiler trace
+context), ``span()`` (wall-clock spans), ``jsonl_logging()``.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import json
 import logging
+import math
+import os
+import threading
 import time
+import weakref
 from collections import defaultdict
-from typing import Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+# ----------------------------------------------------------------------
+# typed metrics registry
+# ----------------------------------------------------------------------
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _fmt_key(name: str, key: _LabelKey) -> str:
+    if not key:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in key)
+    return f"{name}{{{inner}}}"
+
+
+def log_buckets(
+    lo: float = 1e-4, hi: float = 100.0, per_decade: int = 6
+) -> Tuple[float, ...]:
+    """Fixed log-spaced bucket edges: ``per_decade`` edges per decade
+    from ``lo`` up to (at least) ``hi``. Constant ratio between
+    adjacent edges bounds the worst-case percentile error to one
+    ratio step regardless of the value's magnitude."""
+    if lo <= 0 or hi <= lo or per_decade < 1:
+        raise ValueError(f"bad bucket spec lo={lo} hi={hi}/{per_decade}")
+    edges: List[float] = []
+    i = 0
+    while True:
+        e = lo * 10.0 ** (i / per_decade)
+        edges.append(e)
+        if e >= hi:
+            return tuple(edges)
+        i += 1
+
+
+#: default edges for latency-in-seconds histograms: 100 µs .. 100 s
+DEFAULT_TIME_BUCKETS = log_buckets(1e-4, 100.0, per_decade=6)
+
+
+class _Child:
+    """A metric bound to one label set. Holds only (parent, key): the
+    value slots live in the parent, so a registry reset never strands
+    a cached handle."""
+
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, metric: "_Metric", key: _LabelKey):
+        self._m = metric
+        self._key = key
+
+
+class _CounterChild(_Child):
+    def inc(self, n: float = 1.0) -> None:
+        m = self._m
+        with m._lock:
+            m._values[self._key] = m._values.get(self._key, 0.0) + n
+
+
+class _GaugeChild(_Child):
+    def set(self, v: float) -> None:
+        m = self._m
+        with m._lock:
+            m._values[self._key] = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        m = self._m
+        with m._lock:
+            m._values[self._key] = m._values.get(self._key, 0.0) + n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+
+class _HistChild(_Child):
+    def observe(self, v: float) -> None:
+        m = self._m
+        v = float(v)
+        with m._lock:
+            st = m._values.get(self._key)
+            if st is None:
+                # [count, sum, min, max, bucket_counts]; the last
+                # bucket is the +Inf overflow
+                st = m._values[self._key] = [
+                    0, 0.0, math.inf, -math.inf, [0] * (len(m.edges) + 1)
+                ]
+            st[0] += 1
+            st[1] += v
+            if v < st[2]:
+                st[2] = v
+            if v > st[3]:
+                st[3] = v
+            st[4][bisect.bisect_left(m.edges, v)] += 1
+
+
+class _Metric:
+    kind = ""
+    _child_cls = _Child
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._children: Dict[_LabelKey, _Child] = {}
+        self._values: Dict[_LabelKey, Any] = {}
+
+    def labels(self, **labels: Any):
+        """Bind a label set; returns a cached child handle. Hot paths
+        should call this once and keep the handle."""
+        key = _label_key(labels)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(
+                    key, self._child_cls(self, key)
+                )
+        return child
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def items(self) -> List[Tuple[_LabelKey, Any]]:
+        with self._lock:
+            return list(self._values.items())
+
+
+class Counter(_Metric):
+    kind = "counter"
+    _child_cls = _CounterChild
+
+    def inc(self, n: float = 1.0, **labels: Any) -> None:
+        self.labels(**labels).inc(n)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._values.get(_label_key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+    _child_cls = _GaugeChild
+
+    def set(self, v: float, **labels: Any) -> None:
+        self.labels(**labels).set(v)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._values.get(_label_key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+    _child_cls = _HistChild
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        edges: Sequence[float] = DEFAULT_TIME_BUCKETS,
+    ):
+        super().__init__(name, help)
+        edges = tuple(float(e) for e in edges)
+        if list(edges) != sorted(edges) or len(set(edges)) != len(edges):
+            raise ValueError(f"{name}: bucket edges must strictly increase")
+        self.edges = edges
+
+    def observe(self, v: float, **labels: Any) -> None:
+        self.labels(**labels).observe(v)
+
+
+class MetricsRegistry:
+    """Process-wide named-metric table. `counter`/`gauge`/`histogram`
+    are get-or-create (idempotent by name; a kind clash raises), so
+    any module can declare its metrics at import time."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        # weakly-held callables run before every exposition to refresh
+        # DERIVED values (e.g. the scheduler's trailing query rate,
+        # which must decay at read time, not freeze at its last
+        # event-driven update)
+        self._collectors: List[weakref.ref] = []
+
+    def _get(self, cls, name: str, help: str, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)  # type: ignore[return-value]
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)  # type: ignore[return-value]
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        edges: Sequence[float] = DEFAULT_TIME_BUCKETS,
+    ) -> Histogram:
+        return self._get(Histogram, name, help, edges=edges)  # type: ignore[return-value]
+
+    def reset(self) -> None:
+        """Zero every metric's values. Registered metric objects (and
+        any cached child handles) stay valid — tests isolate state
+        without invalidating module-level handles."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.reset()
+
+    # -- exposition ----------------------------------------------------
+
+    def add_collector(self, fn: Any) -> None:
+        """Register a BOUND METHOD to run before every exposition
+        (snapshot / Prometheus text), for gauges derived from state
+        that only the owner can read — e.g. a trailing-window rate
+        that must decay on an idle system. Held weakly: the collector
+        dies with its owner, so short-lived instances (tests, sims)
+        never accumulate."""
+        ref = (
+            weakref.WeakMethod(fn)
+            if hasattr(fn, "__self__")
+            else weakref.ref(fn)
+        )
+        with self._lock:
+            self._collectors.append(ref)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            refs = list(self._collectors)
+        dead = False
+        for r in refs:
+            fn = r()
+            if fn is None:
+                dead = True
+                continue
+            try:
+                fn()
+            except Exception:  # a collector must never break exposition
+                logging.getLogger(__name__).debug(
+                    "metrics collector failed", exc_info=True
+                )
+        if dead:
+            with self._lock:
+                self._collectors = [
+                    r for r in self._collectors if r() is not None
+                ]
+
+    def snapshot(self, node: Optional[str] = None) -> Dict[str, Any]:
+        """JSON-able dump. Histogram buckets are sparse ({index:
+        count} for nonzero buckets) to keep METRICS_PULL replies well
+        under the UDP frame cap."""
+        out: Dict[str, Any] = {
+            "v": 1,
+            "proc": os.getpid(),
+            "ts": time.time(),
+            "counters": {},
+            "gauges": {},
+            "histograms": {},
+        }
+        if node is not None:
+            out["node"] = node
+        self._run_collectors()
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            for key, val in m.items():
+                fk = _fmt_key(m.name, key)
+                if m.kind == "counter":
+                    out["counters"][fk] = val
+                elif m.kind == "gauge":
+                    out["gauges"][fk] = val
+                else:
+                    count, total, mn, mx, buckets = val
+                    edges = m.edges  # type: ignore[attr-defined]
+                    out["histograms"][fk] = {
+                        "count": count,
+                        "sum": total,
+                        "min": mn if count else None,
+                        "max": mx if count else None,
+                        # the common edge set compresses to a sentinel
+                        # (~37 floats per labeled entry otherwise —
+                        # real pressure against the UDP frame cap)
+                        "edges": (
+                            "default"
+                            if edges == DEFAULT_TIME_BUCKETS
+                            else list(edges)
+                        ),
+                        "bkt": {
+                            str(i): c
+                            for i, c in enumerate(buckets)
+                            if c
+                        },
+                    }
+        return out
+
+    def to_prometheus_text(self) -> str:
+        """Prometheus exposition format (text/plain version 0.0.4)."""
+        lines: List[str] = []
+        self._run_collectors()
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            items = m.items()
+            if not items:
+                continue
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, val in sorted(items):
+                if m.kind in ("counter", "gauge"):
+                    lines.append(f"{m.name}{_prom_labels(key)} {_g(val)}")
+                    continue
+                count, total, _mn, _mx, buckets = val
+                cum = 0
+                for i, edge in enumerate(m.edges):  # type: ignore[attr-defined]
+                    cum += buckets[i]
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{_prom_labels(key, le=_g(edge))} {cum}"
+                    )
+                lines.append(
+                    f"{m.name}_bucket{_prom_labels(key, le='+Inf')} {count}"
+                )
+                lines.append(f"{m.name}_sum{_prom_labels(key)} {_g(total)}")
+                lines.append(f"{m.name}_count{_prom_labels(key)} {count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _g(v: float) -> str:
+    return f"{float(v):g}"
+
+
+def _prom_labels(key: _LabelKey, **extra: str) -> str:
+    pairs = list(key) + sorted(extra.items())
+    if not pairs:
+        return ""
+    inner = ",".join(
+        '{}="{}"'.format(
+            k, str(v).replace("\\", r"\\").replace('"', r"\"")
+        )
+        for k, v in pairs
+    )
+    return f"{{{inner}}}"
+
+
+#: the process-wide registry every subsystem writes into
+METRICS = MetricsRegistry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return METRICS.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return METRICS.gauge(name, help)
+
+
+def histogram(
+    name: str, help: str = "", edges: Sequence[float] = DEFAULT_TIME_BUCKETS
+) -> Histogram:
+    return METRICS.histogram(name, help, edges)
+
+
+# ----------------------------------------------------------------------
+# snapshot math: percentiles, summaries, cross-node merge
+# ----------------------------------------------------------------------
+
+
+def _entry_edges(entry: Dict[str, Any]) -> Optional[Sequence[float]]:
+    """Resolve a snapshot entry's bucket edges: the ``"default"``
+    sentinel (wire compression), an explicit list, or None for a
+    bucket-stripped entry."""
+    e = entry.get("edges")
+    if e == "default":
+        return DEFAULT_TIME_BUCKETS
+    if isinstance(e, (list, tuple)) and e:
+        return e
+    return None
+
+
+def hist_quantile(entry: Dict[str, Any], q: float) -> Optional[float]:
+    """Quantile estimate from a snapshot histogram entry: walk the
+    cumulative bucket counts to the target rank, then geometrically
+    interpolate inside the landing bucket (log-spaced edges make the
+    geometric mean the max-likelihood point). Clamped to the observed
+    [min, max]; the overflow bucket reports the observed max.
+
+    The rank base is the number of samples the BUCKETS represent
+    (``bkt_count`` on merged entries), not the total count: a cluster
+    merge may fold in bucket-stripped nodes whose samples contribute
+    to count/sum/mean but are invisible to the buckets, and ranking
+    over the inflated total would systematically skew the walk toward
+    the high buckets. Percentiles then describe the bucketed
+    subpopulation; an entry with no bucketed samples returns None."""
+    count = entry.get("count", 0)
+    if not count:
+        return None
+    edges = _entry_edges(entry)
+    if edges is None:  # bucket-stripped entry: percentiles unknowable
+        return None
+    buckets = entry.get("bkt", {})
+    mn = entry.get("min")
+    mx = entry.get("max")
+    base = entry.get("bkt_count", count)
+    if not base:
+        return None
+    target = q * base
+    cum = 0.0
+    for i in range(len(edges) + 1):
+        c = buckets.get(str(i), 0)
+        if not c:
+            continue
+        if cum + c >= target:
+            if i >= len(edges):  # overflow: only the max is known
+                return mx
+            hi = edges[i]
+            lo = edges[i - 1] if i > 0 else (
+                mn if mn and mn > 0 else hi / 10.0
+            )
+            if lo <= 0:
+                lo = hi / 10.0
+            frac = max(0.0, min(1.0, (target - cum) / c))
+            est = lo * (hi / lo) ** frac
+            if mn is not None:
+                est = max(est, mn)
+            if mx is not None:
+                est = min(est, mx)
+            return est
+        cum += c
+    return mx
+
+
+def summarize_histogram(entry: Dict[str, Any]) -> Dict[str, Any]:
+    """C2-style roll-up of a snapshot histogram entry: count, mean,
+    min/max, p50/p95/p99."""
+    count = entry.get("count", 0)
+    out: Dict[str, Any] = {"count": count}
+    if not count:
+        return out
+    out["mean"] = entry.get("sum", 0.0) / count
+    out["min"] = entry.get("min")
+    out["max"] = entry.get("max")
+    for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+        out[name] = hist_quantile(entry, q)
+    bc = entry.get("bkt_count")
+    if bc is not None and bc < count:
+        # some merged-in nodes were bucket-stripped: the percentiles
+        # above describe only these samples (mean/min/max are global)
+        out["percentile_count"] = bc
+    return out
+
+
+def summarize_snapshot(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Human/CLI view of a snapshot (or merged cluster snapshot):
+    counters and gauges verbatim, histograms rolled up to
+    count/mean/percentiles."""
+    return {
+        "counters": dict(snap.get("counters", {})),
+        "gauges": dict(snap.get("gauges", {})),
+        "histograms": {
+            k: summarize_histogram(h)
+            for k, h in sorted(snap.get("histograms", {}).items())
+        },
+    }
+
+
+def merge_snapshots(
+    snaps: Sequence[Dict[str, Any]], dedupe_by_proc: bool = True
+) -> Dict[str, Any]:
+    """Fold per-node snapshots into one cluster view: counters and
+    gauges sum, histograms merge bucket-wise (same-name histograms
+    must share edges — they do, the metric declarations are code).
+
+    ``dedupe_by_proc`` counts each producing PROCESS once: in-process
+    simulations run every node over one shared registry, and summing
+    N identical copies would report an N× phantom cluster. Real
+    deployments are one process per node, so nothing is dropped."""
+    out: Dict[str, Any] = {
+        "v": 1,
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+        "merged_from": 0,
+    }
+    seen_procs = set()
+    for snap in snaps:
+        proc = snap.get("proc")
+        if dedupe_by_proc and proc is not None:
+            if proc in seen_procs:
+                continue
+            seen_procs.add(proc)
+        out["merged_from"] += 1
+        for k, v in snap.get("counters", {}).items():
+            out["counters"][k] = out["counters"].get(k, 0.0) + v
+        for k, v in snap.get("gauges", {}).items():
+            out["gauges"][k] = out["gauges"].get(k, 0.0) + v
+        for k, h in snap.get("histograms", {}).items():
+            cur = out["histograms"].get(k)
+            if cur is None:
+                cur = out["histograms"][k] = {
+                    "count": 0,
+                    "sum": 0.0,
+                    "min": None,
+                    "max": None,
+                    "bkt": {},
+                    # how many of `count` the buckets represent: a
+                    # bucket-stripped node's samples join count/sum
+                    # (mean stays exact) but not the buckets, and
+                    # quantile ranking must know the difference
+                    "bkt_count": 0,
+                }
+            cur["count"] += h.get("count", 0)
+            cur["sum"] += h.get("sum", 0.0)
+            for bound, pick in (("min", min), ("max", max)):
+                v = h.get(bound)
+                if v is not None:
+                    cur[bound] = v if cur[bound] is None else pick(cur[bound], v)
+            if _entry_edges(h) is None:  # stripped: no buckets to fold
+                continue
+            if "edges" not in cur:  # first bucketed contributor
+                cur["edges"] = h["edges"]
+            cur["bkt_count"] += h.get("bkt_count", h.get("count", 0))
+            for i, c in h.get("bkt", {}).items():
+                cur["bkt"][i] = cur["bkt"].get(i, 0) + c
+    return out
+
+
+def strip_buckets(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Shrink a snapshot for a constrained wire frame: histogram
+    entries keep count/sum/min/max (mean stays computable) but drop
+    the bucket counts (and with them percentiles). The METRICS_PULL
+    handler falls back to this when the full snapshot would exceed
+    the UDP frame cap."""
+    out = dict(snap)
+    out["histograms"] = {
+        k: {
+            kk: vv
+            for kk, vv in h.items()
+            if kk not in ("bkt", "edges", "bkt_count")
+        }
+        for k, h in snap.get("histograms", {}).items()
+    }
+    out["stripped"] = True
+    return out
+
+
+def bench_metrics_block() -> Dict[str, Any]:
+    """The ``metrics`` block bench.py embeds in every artifact:
+    summarized registry contents, so BENCH_r*.json carries per-stage
+    breakdowns (lm_server decode counters, worker stage timings,
+    transport totals) alongside the headline numbers.
+    tools/claim_check.py validates this block's presence and shape."""
+    block = summarize_snapshot(METRICS.snapshot())
+    block["schema"] = 1
+    return block
+
+
+# ----------------------------------------------------------------------
+# jax profiling + wall-clock spans + JSONL logging (seed surface)
+# ----------------------------------------------------------------------
 
 
 @contextlib.contextmanager

@@ -116,4 +116,12 @@ class CheckpointManager:
             state = _state_from_bytes(f.read(), like)
         if shardings is not None:
             state = jax.device_put(state, shardings)
+            # device_put of host numpy can be ZERO-COPY (CPU): the
+            # device buffers then alias the deserialized arrays'
+            # memory, and a donating train step reuses that shared
+            # memory as output scratch, corrupting the restored state
+            # mid-execution (observed as NaN loss on the first
+            # post-restore step on the virtual 8-device CPU mesh).
+            # An on-device copy forces XLA-owned buffers.
+            state = jax.tree_util.tree_map(lambda a: a.copy(), state)
         return state

@@ -255,6 +255,77 @@ def run_check(
     return out
 
 
+# ----------------------------------------------------------------------
+# bench-artifact metrics block (observability.bench_metrics_block)
+# ----------------------------------------------------------------------
+
+#: first round whose bench ran with the typed metrics registry; older
+#: BENCH_r* artifacts predate it and are exempt from the block check
+METRICS_REQUIRED_FROM_ROUND = 6
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)", re.IGNORECASE)
+
+
+def artifact_round(path: str) -> Optional[int]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def check_metrics_block(path: str) -> List[str]:
+    """Validate that a bench artifact carries the observability
+    registry's ``metrics`` block (counters/gauges/histograms summary,
+    ``schema`` stamp) — a bench that silently dropped instrumentation
+    would otherwise publish headline numbers with no per-stage
+    breakdown behind them. Returns a list of problems (empty = OK).
+
+    Artifacts from rounds before ``METRICS_REQUIRED_FROM_ROUND`` are
+    exempt (the registry didn't exist); an unnumbered artifact is held
+    to the new standard. When the artifact's LM sections actually ran
+    (neither skipped by the wall budget nor errored), the lm_server
+    decode counters must be nonzero — an instrumented serve that
+    counted nothing means the hot path lost its hooks."""
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < METRICS_REQUIRED_FROM_ROUND:
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    block = data.get("metrics")
+    if not isinstance(block, dict):
+        return [f"{name}: no `metrics` block (bench instrumentation "
+                "dropped? see observability.bench_metrics_block)"]
+    if "error" in block and "counters" not in block:
+        return [f"{name}: metrics block capture failed: {block['error']}"]
+    problems = []
+    for key in ("counters", "gauges", "histograms"):
+        if not isinstance(block.get(key), dict):
+            problems.append(f"{name}: metrics.{key} missing or not a dict")
+    if problems:
+        return problems
+    for k, h in block["histograms"].items():
+        if not isinstance(h, dict) or "count" not in h:
+            problems.append(
+                f"{name}: metrics.histograms[{k!r}] lacks a count"
+            )
+            break
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    lm_ran = not {"lm", "cluster_lm_serving"} <= not_run
+    if lm_ran and not any(
+        k.startswith("lm_server_decode_tokens_total") and v
+        for k, v in block["counters"].items()
+    ):
+        problems.append(
+            f"{name}: LM sections ran but lm_server_decode_tokens_total "
+            "is zero/absent — the decode path lost its instrumentation"
+        )
+    return problems
+
+
+def run_metrics_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_metrics_block(artifact_path or canonical_artifact_path())
+
+
 def main() -> None:
     art_path = canonical_artifact_path()
     print(f"artifact of record: {os.path.basename(art_path)}")
@@ -264,6 +335,9 @@ def main() -> None:
             total += 1
             print(f"{name}:{i}: unlabeled {v:g} {unit} not in artifact")
             print(f"    {line[:120]}")
+    for problem in run_metrics_check(art_path):
+        total += 1
+        print(f"metrics block: {problem}")
     print(f"{total} violation(s)")
     raise SystemExit(1 if total else 0)
 

@@ -54,7 +54,13 @@ def test_trainer_checkpoint_roundtrip(tmp_path):
     labels = rng.randint(0, 1000, (8,), np.int32)
     tr.step(imgs, labels)
     tr.step(imgs, labels)
-    saved = jax.device_get(tr.state)
+    # deep-copy: device_get may return zero-copy VIEWS of the state
+    # buffers (observed on CPU when the step executable loads from the
+    # persistent compilation cache), and the donated step below would
+    # overwrite them in place, corrupting the reference snapshot
+    saved = jax.tree_util.tree_map(
+        lambda x: np.array(x, copy=True), jax.device_get(tr.state)
+    )
     tr.save_checkpoint(str(tmp_path / "ck"))
     tr.step(imgs, labels)  # diverge
     step = tr.restore_checkpoint(str(tmp_path / "ck"))

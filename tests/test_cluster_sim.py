@@ -458,6 +458,80 @@ async def test_false_positive_cleanup_self_heals(tmp_path):
         assert victim.joined
 
 
+async def test_metrics_pull_leader_aggregation(tmp_path):
+    """Leader-side METRICS_PULL aggregation (the TPU-native analog of
+    the reference coordinator's C1-C5 console): every node answers
+    with its registry snapshot, the merge yields one cluster view, and
+    the summary carries the paper's per-model stats — query count,
+    trailing rate, latency mean + p50/p95/p99 (PAPER C1/C2)."""
+    from dml_tpu.jobs.service import JobService
+    from dml_tpu.observability import hist_quantile
+
+    async def backend(model, paths):
+        await asyncio.sleep(0.002)
+        results = {p: [{"label": model, "score": 1.0}] for p in paths}
+        return results, 0.002 * max(1, len(paths)), None
+
+    async with cluster(3, tmp_path, 22050) as sim:
+        jobs = {}
+        try:
+            for u, node in sim.nodes.items():
+                jobs[u] = JobService(node, sim.stores[u],
+                                     infer_backend=backend)
+                await jobs[u].start()
+            await sim.wait_converged()
+            leader_u = next(iter(sim.nodes.values())).leader_unique
+            client_u = next(u for u in sim.nodes if u != leader_u)
+            for i in range(3):
+                p = tmp_path / f"img_{i}.jpeg"
+                p.write_bytes(b"\xff\xd8fakejpeg" + bytes([i]))
+                await sim.stores[client_u].put(str(p), f"img_{i}.jpeg")
+            job_id = await jobs[client_u].submit_job("ResNet50", 8)
+            await jobs[client_u].wait_job(job_id, timeout=15.0)
+
+            view = await sim.nodes[leader_u].pull_cluster_metrics()
+            # one snapshot per alive node, keyed by unique name
+            assert set(view["nodes"]) == set(sim.nodes)
+            for snap in view["nodes"].values():
+                assert snap["v"] == 1 and "counters" in snap
+            # in-process sim: all three nodes share ONE registry, so
+            # the dedupe-by-process merge counts it once (a real
+            # deployment is one process per node and sums normally)
+            assert view["cluster"]["merged_from"] == 1
+
+            summary = view["summary"]
+            # C1: per-model query count + trailing rate gauge
+            assert summary["counters"][
+                "jobs_queries_total{model=ResNet50}"] >= 8
+            assert "jobs_query_rate_per_s{model=ResNet50}" in summary["gauges"]
+            # C2: per-model latency histogram -> count/mean/percentiles
+            lat = summary["histograms"][
+                "jobs_query_latency_seconds{model=ResNet50}"]
+            assert lat["count"] >= 1
+            for stat in ("mean", "p50", "p95", "p99"):
+                assert lat[stat] is not None and lat[stat] > 0, stat
+            assert lat["p50"] <= lat["p99"]
+            # the merged (un-summarized) view keeps raw buckets, so
+            # any quantile stays computable cluster-wide
+            raw = view["cluster"]["histograms"][
+                "jobs_query_latency_seconds{model=ResNet50}"]
+            assert hist_quantile(raw, 0.5) == pytest.approx(
+                lat["p50"], rel=1e-6)
+            # control-plane accounting saw this test's real datagrams
+            assert any(
+                k.startswith("transport_packets_sent_total") and v > 0
+                for k, v in summary["counters"].items()
+            )
+            # worker-side stage histograms populated by the batch
+            assert any(
+                k.startswith("worker_infer_seconds")
+                for k in summary["histograms"]
+            )
+        finally:
+            for j in jobs.values():
+                await j.stop()
+
+
 async def test_join_repairs_under_replication(tmp_path):
     """A file PUT while the cluster is smaller than the replication
     factor gains copies when nodes JOIN (the reference repairs only on

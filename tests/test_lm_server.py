@@ -5,6 +5,8 @@ any request's greedy output vs running `generate` on it in isolation
 — slots, per-slot positions, prompt bucketing, mid-flight joins, and
 slot reuse are all throughput mechanics, not semantics."""
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -369,6 +371,48 @@ def test_mixed_budgets_exact_and_slots_refill(params):
         )
     with pytest.raises(ValueError, match="budgets for"):
         srv.submit_many(prompts, [1, 2])
+
+
+def test_metrics_counters_after_mixed_budget_serve(params):
+    """The serve loop's registry instrumentation (observability.py):
+    a mixed-budget continuous-batching run must account every request,
+    every delivered token, and its dispatch/queue/readback timings.
+    The registry is process-global, so assertions are deltas."""
+    from dml_tpu.observability import METRICS
+
+    c_req = METRICS.counter("lm_server_requests_total")
+    c_done = METRICS.counter("lm_server_requests_completed_total")
+    c_tok = METRICS.counter("lm_server_decode_tokens_total")
+    c_steps = METRICS.counter("lm_server_steps_total")
+    h_wait = METRICS.histogram("lm_server_queue_wait_seconds")
+    h_step = METRICS.histogram("lm_server_step_seconds")
+    g_slots = METRICS.gauge("lm_server_slots_active")
+    g_total = METRICS.gauge("lm_server_slots_total")
+
+    def hist_count(h):
+        return sum(st[0] for _, st in h.items())
+
+    before = (c_req.value(), c_done.value(), c_tok.value(),
+              c_steps.value(), hist_count(h_wait), hist_count(h_step))
+
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, CFG.vocab_size, 4 + 2 * i) for i in range(5)]
+    budgets = [2, 9, 4, 7, 3]
+    srv = LMServer(params, CFG, max_slots=2, max_len=64, chunk=3)
+    srv.submit_many(prompts, budgets)
+    srv.run()
+
+    assert c_req.value() - before[0] == len(prompts)
+    assert c_done.value() - before[1] == len(prompts)
+    # every generated token is delivered exactly once: the placement
+    # firsts plus the chunked takes sum to each request's own budget
+    assert c_tok.value() - before[2] == sum(budgets)
+    assert c_steps.value() - before[3] >= math.ceil((max(budgets) - 1) / 3)
+    # one queue-wait sample per placed request; >=1 step timing
+    assert hist_count(h_wait) - before[4] == len(prompts)
+    assert hist_count(h_step) - before[5] >= 1
+    assert g_slots.value() == 0.0  # drained
+    assert g_total.value() == 2.0
 
 
 def test_backend_mixed_budget_files(params, tmp_path):
