@@ -11,6 +11,7 @@ Run:
     python -m dml_tpu localspec -n 4 -o /tmp/cluster.json
     python -m dml_tpu introducer --spec /tmp/cluster.json
     python -m dml_tpu node --spec /tmp/cluster.json --name H1
+    python -m dml_tpu chaos run --seed 7 --soak   # seeded fault plan
 """
 
 from __future__ import annotations
@@ -373,6 +374,36 @@ async def _run_node(args) -> None:
     await app.stop()
 
 
+async def _run_chaos(args) -> int:
+    """`chaos run --seed N`: generate the seeded plan, drive it
+    against an in-process cluster, print the schedule + invariant
+    report. Exit 0 iff every invariant held."""
+    from .cluster import chaos
+
+    if args.plan:
+        with open(args.plan) as f:
+            plan = chaos.ChaosPlan.from_dict(json.load(f))
+    elif args.soak:
+        plan = chaos.soak_plan(args.seed, n_nodes=args.nodes)
+    else:
+        plan = chaos.random_plan(
+            args.seed, n_nodes=args.nodes, n_disturbances=args.events
+        )
+    print(plan.describe())
+    if args.dump:
+        with open(args.dump, "w") as f:
+            json.dump(plan.to_dict(), f, indent=2)
+        print(f"plan written to {args.dump}")
+    if args.dry_run:
+        return 0
+    report = await chaos.run_plan(plan, base_port=args.base_port)
+    print(json.dumps(report.to_dict(), indent=2))
+    print("invariants:", "PASS" if report.ok else "FAIL")
+    for f in report.invariants.failures:
+        print(f"  !! {f}")
+    return 0 if report.ok else 1
+
+
 async def _run_introducer(args) -> None:
     spec = ClusterSpec.from_file(args.spec)
     svc = IntroducerService(spec)
@@ -413,6 +444,30 @@ def main(argv: Optional[List[str]] = None) -> None:
     ps.add_argument("-o", "--out", default="-", help="output path (default stdout)")
     ps.add_argument("--base-port", type=int, default=8001)
 
+    pc = sub.add_parser(
+        "chaos",
+        help="run a seeded chaos plan against an in-process cluster "
+             "and sweep the recovery invariants",
+    )
+    pc.add_argument("verb", choices=["run"], help="chaos subcommand")
+    pc.add_argument("--seed", type=int, default=0,
+                    help="plan seed (same seed = identical schedule)")
+    pc.add_argument("--nodes", type=int, default=5)
+    pc.add_argument("--events", type=int, default=4,
+                    help="disturbance count for the random plan")
+    pc.add_argument("--soak", action="store_true",
+                    help="use the canonical soak composition "
+                         "(leader-kill-mid-put/job + partition heal + "
+                         "2%% loss + duplicate delivery)")
+    pc.add_argument("--plan", default=None, metavar="FILE",
+                    help="replay a saved plan JSON instead of generating")
+    pc.add_argument("--dump", default=None, metavar="FILE",
+                    help="write the generated plan JSON here")
+    pc.add_argument("--dry-run", action="store_true",
+                    help="print/dump the schedule without running it")
+    pc.add_argument("--base-port", type=int, default=24001)
+    pc.add_argument("-v", "--verbose", action="store_true")
+
     args = p.parse_args(argv)
     if args.command == "localspec":
         spec = ClusterSpec.localhost(args.n, base_port=args.base_port)
@@ -428,6 +483,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         asyncio.run(_run_node(args))
     elif args.command == "introducer":
         asyncio.run(_run_introducer(args))
+    elif args.command == "chaos":
+        raise SystemExit(asyncio.run(_run_chaos(args)))
 
 
 if __name__ == "__main__":  # pragma: no cover

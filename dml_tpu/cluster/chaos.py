@@ -1,0 +1,1098 @@
+"""Deterministic chaos engine: composable fault plans driven against
+the in-process multi-node simulation, with machine-checked recovery.
+
+The reference's only fault story is a hard-coded 3% packet-drop bitmap
+(protocol.py:25-29) plus hand-run VM kills; dml_tpu grew the *seams*
+(seeded LossInjector, partition_filter, LinkShaper dup/reorder/delay,
+TunnelFault slow/failing bulk copies, standby relays, scheduler
+requeue) but until this module nothing composed them into reproducible
+failure scenarios. VirtualFlow (arxiv 2009.09523) makes the same
+argument for decoupled resilience: elasticity and fault handling must
+be exercised as first-class, schedulable events — not ad-hoc test
+hacks.
+
+Three layers:
+
+- **ChaosPlan / ChaosEvent**: a declarative, JSON-able schedule of
+  timed fault events (crash, restart-with-same-identity, partition,
+  heal, loss ramp, link shaping, store tunnel faults) plus workload
+  events (put, job). `random_plan(seed)` generates one from a seeded
+  RNG — the same seed always yields the identical schedule;
+  `soak_plan(seed)` builds the canonical recovery composition
+  (leader killed mid-put and mid-job + a healed partition + 2% loss +
+  duplicate delivery) with seed-jittered timing.
+- **LocalCluster**: the product-level in-process sim (introducer DNS +
+  N nodes + replicated stores + job services with a deterministic
+  stub inference backend) that the engine, the `chaos` CLI verb, and
+  the bench `chaos` section all share.
+- **ChaosRunner**: executes a plan against a LocalCluster, measures
+  recovery latencies into the metrics registry
+  (`cluster_failover_recovery_seconds`, `store_repair_seconds`), and
+  ends every run with an **invariant sweep**: exactly-one-leader
+  convergence, every acked job terminal with no lost or duplicated
+  completions, every store file back to `replication_factor` live
+  copies with seed-file content intact, and no metrics gauge negative.
+
+Determinism contract: the fault *schedule* (which events fire, their
+parameters, their planned times) and every injector's per-decision
+stream (loss slots, dup/reorder choices, tunnel failures) are
+seed-reproducible. Actual interleaving of datagram arrivals rides the
+event loop, like a real network — the invariants are what must hold
+regardless.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import errno
+import logging
+import os
+import random
+import shutil
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ..config import ClusterSpec, NodeId, StoreConfig, Timing
+from ..observability import METRICS
+from .introducer import IntroducerService
+from .node import Node
+from .store.data_plane import TunnelFault
+from .store_service import StoreService
+from .transport import LinkShaper
+
+log = logging.getLogger(__name__)
+
+# Recovery-latency histograms: the regression-visible form of the
+# paper's failover story. Observed by the runner, merged cluster-wide
+# by METRICS_PULL like every other registry metric.
+_M_FAILOVER = METRICS.histogram(
+    "cluster_failover_recovery_seconds",
+    "leader kill -> every live node reconverged on one new leader")
+_M_REPAIR = METRICS.histogram(
+    "store_repair_seconds",
+    "fault event -> every file back to replication_factor live copies")
+
+#: aggressive timing so a whole plan resolves in seconds (the same
+#: envelope tests/test_cluster_sim.py uses for its failover scenarios)
+FAST_TIMING = Timing(
+    ping_interval=0.05,
+    ack_timeout=0.15,
+    cleanup_time=0.3,
+    missed_acks_to_suspect=2,
+    leader_rpc_timeout=5.0,
+)
+
+#: model served by the deterministic stub backend (a registry CNN so
+#: the coordinator's intake accepts it without register_lm)
+STUB_MODEL = "ResNet50"
+
+
+def _child_seed(seed: int, tag: str) -> int:
+    """Stable per-subsystem seed: one plan seed fans out to every
+    injector without correlated decision streams."""
+    return zlib.crc32(f"{seed}/{tag}".encode()) & 0x7FFFFFFF
+
+
+# ----------------------------------------------------------------------
+# plan model
+# ----------------------------------------------------------------------
+
+#: event kinds the runner understands (args they consume):
+#: crash       target=name|"leader"|"standby"|"worker"; args.mid =
+#:             ["put", "job"] launches that workload just before the
+#:             kill so it is genuinely in flight when the node dies
+#: restart     target=name|"last" (the most recent crash victim):
+#:             same identity, same store root, rejoin via introducer
+#: partition   args.fraction (0..1): split the live nodes into
+#:             minority/majority by sorted name, bidirectional drop
+#: heal        clear every partition filter
+#: loss        args.pct: swap every node's loss injector to pct
+#: shape       args.{delay_s,jitter_s,dup_pct,reorder_pct,
+#:             reorder_extra_s}: install a LinkShaper per node
+#:             (all-zero clears shaping)
+#: store_fault args.{delay_s,fail_pct}: install a TunnelFault per
+#:             node's data plane
+#: store_heal  clear every tunnel fault
+#: put         args.{name,size}: replicated put of seeded bytes
+#: job         args.{n}: submit + await a stub-backend job
+EVENT_KINDS = (
+    "crash", "restart", "partition", "heal", "loss", "shape",
+    "store_fault", "store_heal", "put", "job",
+)
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One timed fault (or workload) event; `t` is seconds from plan
+    start. Frozen so a schedule can't drift after generation."""
+
+    t: float
+    kind: str
+    target: Optional[str] = None
+    args: Tuple[Tuple[str, Any], ...] = ()
+
+    def arg(self, key: str, default: Any = None) -> Any:
+        return dict(self.args).get(key, default)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"t": self.t, "kind": self.kind}
+        if self.target is not None:
+            out["target"] = self.target
+        if self.args:
+            out["args"] = dict(self.args)
+        return out
+
+
+def event(t: float, kind: str, target: Optional[str] = None,
+          **args: Any) -> ChaosEvent:
+    if kind not in EVENT_KINDS:
+        raise ValueError(f"unknown chaos event kind {kind!r}")
+    return ChaosEvent(
+        t=round(float(t), 3), kind=kind, target=target,
+        # lists normalize to tuples so a JSON round-tripped plan
+        # compares (and prints) identically to the generated one
+        args=tuple(sorted(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in args.items()
+        )),
+    )
+
+
+@dataclass(frozen=True)
+class ChaosPlan:
+    """A seeded, declarative failure scenario. JSON round-trips so
+    plans can be saved, diffed, and replayed (`chaos run --plan`)."""
+
+    seed: int
+    events: Tuple[ChaosEvent, ...]
+    n_nodes: int = 5
+    #: quiet tail after the last event before the invariant sweep
+    settle_s: float = 1.0
+    name: str = "chaos"
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "events", tuple(sorted(self.events, key=lambda e: e.t))
+        )
+
+    @property
+    def duration(self) -> float:
+        return (self.events[-1].t if self.events else 0.0) + self.settle_s
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "n_nodes": self.n_nodes,
+            "settle_s": self.settle_s,
+            "events": [e.to_dict() for e in self.events],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ChaosPlan":
+        return cls(
+            seed=int(d.get("seed", 0)),
+            n_nodes=int(d.get("n_nodes", 5)),
+            settle_s=float(d.get("settle_s", 1.0)),
+            name=str(d.get("name", "chaos")),
+            events=tuple(
+                event(e["t"], e["kind"], e.get("target"),
+                      **e.get("args", {}))
+                for e in d.get("events", [])
+            ),
+        )
+
+    def describe(self) -> str:
+        lines = [f"plan {self.name!r} seed={self.seed} "
+                 f"nodes={self.n_nodes} duration={self.duration:.1f}s"]
+        for e in self.events:
+            args = " ".join(f"{k}={v}" for k, v in e.args)
+            tgt = f" @{e.target}" if e.target else ""
+            lines.append(f"  t={e.t:6.2f}  {e.kind}{tgt}  {args}".rstrip())
+        return "\n".join(lines)
+
+
+def soak_plan(seed: int, n_nodes: int = 5) -> ChaosPlan:
+    """The canonical recovery composition the acceptance criteria
+    name: duplicate delivery + 2% loss from the start, the leader
+    killed while a put AND a job are in flight, a partition that
+    heals, and the crashed leader restarted with the same identity.
+    Timing offsets and the extra disturbance are seed-jittered, so
+    distinct seeds exercise distinct interleavings while one seed
+    always reproduces the identical schedule."""
+    rng = random.Random(_child_seed(seed, "soak"))
+    j = lambda a, b: round(rng.uniform(a, b), 3)  # noqa: E731
+    events = [
+        # duplicate delivery (every copy also a straggler) + reorder
+        event(0.0, "shape", dup_pct=25.0, reorder_pct=10.0,
+              reorder_extra_s=0.02),
+        event(0.0, "loss", pct=2.0),
+        event(j(0.2, 0.4), "put", name="soak_seeded.bin", size=2048),
+        event(j(0.5, 0.7), "job", n=24),
+        # the headline kill: leader dies with a put and a job mid-wire
+        event(j(1.0, 1.4), "crash", "leader", mid=("put", "job")),
+        # after failover settles, split and heal the survivors
+        event(j(3.2, 3.8), "partition", fraction=0.4),
+        event(j(5.8, 6.6), "heal"),
+        # the crashed ex-leader returns with the same identity
+        event(j(8.0, 8.6), "restart", "last"),
+        # post-restart traffic proves the rejoined cluster serves
+        event(j(9.0, 9.5), "job", n=16),
+    ]
+    # one seeded extra disturbance mid-run
+    extra = rng.choice(("worker_crash", "store_fault", "loss_ramp"))
+    if extra == "worker_crash":
+        t = j(4.0, 4.6)
+        events += [event(t, "crash", "worker"),
+                   event(t + j(2.0, 2.5), "restart", "last")]
+    elif extra == "store_fault":
+        t = j(3.0, 3.6)
+        events += [event(t, "store_fault", delay_s=0.02, fail_pct=10.0),
+                   event(t + j(2.0, 2.5), "store_heal")]
+    else:
+        t = j(3.0, 3.6)
+        events += [event(t, "loss", pct=5.0),
+                   event(t + j(1.5, 2.0), "loss", pct=2.0)]
+    return ChaosPlan(seed=seed, events=tuple(events), n_nodes=n_nodes,
+                     settle_s=1.5, name=f"soak-{seed}")
+
+
+def random_plan(seed: int, n_nodes: int = 5, n_disturbances: int = 4,
+                duration: float = 8.0) -> ChaosPlan:
+    """Fully random plan: `n_disturbances` seeded picks from the fault
+    menu, spread over `duration`, always book-ended by workload and a
+    final heal/restart pass so the invariant sweep has something to
+    check and a fair chance to pass."""
+    rng = random.Random(_child_seed(seed, "random_plan"))
+    events = [
+        event(0.1, "put", name="rand_seeded.bin", size=1024),
+        event(0.3, "job", n=16),
+    ]
+    crashed = 0
+    for _ in range(max(1, n_disturbances)):
+        t = round(rng.uniform(0.8, duration * 0.7), 3)
+        pick = rng.choice(
+            ("crash_leader", "crash_worker", "partition", "loss",
+             "shape", "store_fault")
+        )
+        if pick == "crash_leader":
+            events.append(event(t, "crash", "leader",
+                                mid=("job",) if rng.random() < 0.5 else ()))
+            crashed += 1
+        elif pick == "crash_worker":
+            events.append(event(t, "crash", "worker"))
+            crashed += 1
+        elif pick == "partition":
+            events.append(event(t, "partition",
+                                fraction=round(rng.uniform(0.25, 0.45), 2)))
+            events.append(event(t + round(rng.uniform(1.5, 2.5), 3), "heal"))
+        elif pick == "loss":
+            events.append(event(t, "loss",
+                                pct=round(rng.uniform(1.0, 5.0), 2)))
+        elif pick == "shape":
+            events.append(event(
+                t, "shape",
+                dup_pct=round(rng.uniform(5.0, 30.0), 1),
+                reorder_pct=round(rng.uniform(0.0, 15.0), 1),
+                reorder_extra_s=0.02,
+            ))
+        else:
+            events.append(event(t, "store_fault", delay_s=0.02,
+                                fail_pct=round(rng.uniform(5.0, 20.0), 1)))
+            events.append(event(t + round(rng.uniform(1.5, 2.5), 3),
+                                "store_heal"))
+    # recovery tail: everything heals, crash victims return, and a
+    # final job proves the healed cluster still serves
+    tail = duration * 0.75
+    events.append(event(tail, "heal"))
+    events.append(event(tail + 0.1, "store_heal"))
+    for i in range(crashed):
+        events.append(event(tail + 0.3 + 0.5 * i, "restart", "last"))
+    events.append(event(duration * 0.9, "job", n=8))
+    return ChaosPlan(seed=seed, events=tuple(events), n_nodes=n_nodes,
+                     settle_s=1.5, name=f"random-{seed}")
+
+
+# ----------------------------------------------------------------------
+# the in-process cluster under test
+# ----------------------------------------------------------------------
+
+
+def stub_backend(per_file_s: float = 0.004):
+    """Deterministic inference stub: fixed per-file latency, labels
+    echo the model. Keeps chaos runs jax-free (the control plane is
+    what's under test); tests/bench share it."""
+
+    async def backend(model: str, paths: List[str]):
+        exec_time = per_file_s * max(1, len(paths))
+        await asyncio.sleep(exec_time)
+        results = {p: [{"label": model, "score": 1.0}] for p in paths}
+        return results, exec_time, None
+
+    return backend
+
+
+@dataclass
+class SimNode:
+    """One live node's service stack inside a LocalCluster."""
+
+    node: Node
+    store: StoreService
+    jobs: Any  # JobService (imported lazily to keep jax out)
+
+
+class LocalCluster:
+    """Product-level in-process cluster: introducer + N nodes, each
+    with a replicated store and a job service on the stub backend.
+    This is the chassis the chaos engine drives; the `chaos` CLI verb
+    and the bench `chaos` section build one too."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        root: str,
+        base_port: int,
+        seed: int = 0,
+        timing: Timing = FAST_TIMING,
+        batch_size: int = 8,
+        make_jobs: Optional[Callable[[Node, StoreService], Any]] = None,
+    ):
+        self.root = root
+        self.seed = seed
+        self.batch_size = batch_size
+        self.spec = ClusterSpec.localhost(
+            n_nodes,
+            base_port=base_port,
+            introducer_port=base_port - 1,
+            timing=timing,
+            store=StoreConfig(
+                root=os.path.join(root, "roots"),
+                download_dir=os.path.join(root, "dl"),
+            ),
+        )
+        self._make_jobs = make_jobs or self._default_jobs
+        self.dns = IntroducerService(self.spec)
+        self.nodes: Dict[str, SimNode] = {}
+        #: files the replication check must account for — guards the
+        #: check against passing vacuously on a leader whose global
+        #: table lost entries (the runner registers every put)
+        self.expect_files: set = set()
+        # current fault state, re-applied to restarted nodes so a
+        # node that returns mid-scenario lives in the same weather
+        self._partition_groups: Optional[List[List[str]]] = None
+        self._loss_pct: float = 0.0
+        self._shape_args: Optional[Dict[str, float]] = None
+        self._store_fault_args: Optional[Dict[str, float]] = None
+        self._restart_counter = 0
+
+    def _default_jobs(self, node: Node, store: StoreService):
+        from ..jobs.service import JobService
+
+        js = JobService(node, store, infer_backend=stub_backend())
+        js.scheduler.set_batch_size(STUB_MODEL, self.batch_size)
+        return js
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        await self.dns.start()
+        for nid in self.spec.nodes:
+            await self.start_node(nid)
+
+    async def start_node(self, nid: NodeId) -> SimNode:
+        node = Node(self.spec, nid,
+                    seed=_child_seed(self.seed, f"node/{nid.unique_name}"))
+        store = StoreService(
+            node, root=os.path.join(self.root, f"st_{nid.port}")
+        )
+        jobs = self._make_jobs(node, store)
+        started: List[Any] = []
+        try:
+            await node.start()
+            started.append(node)
+            await store.start()
+            started.append(store)
+            await jobs.start()
+        except Exception:
+            # a partial bring-up (e.g. stale port) must not leak the
+            # services that did come up
+            for svc in reversed(started):
+                await svc.stop()
+            raise
+        sn = SimNode(node=node, store=store, jobs=jobs)
+        self.nodes[nid.unique_name] = sn
+        self._apply_faults_to(sn)
+        return sn
+
+    async def crash_node(self, uname: str) -> None:
+        """Abrupt kill: transports closed, no goodbye datagrams — the
+        reference's pulled-VM case. The node's store root stays on
+        disk (a crash does not wipe a disk), so a restart with the
+        same identity reports its old inventory."""
+        sn = self.nodes.pop(uname)
+        await sn.jobs.stop()
+        await sn.store.stop()
+        await sn.node.stop()
+
+    async def restart_node(self, uname: str) -> SimNode:
+        """Restart with the SAME identity (host:port): rebind the UDP
+        socket and rejoin through the introducer path, like a
+        supervised process coming back after a crash. The rebind is
+        retried briefly — the previous incarnation's socket can take
+        a few loop iterations to fully release the port."""
+        nid = self.spec.node_by_unique_name(uname)
+        if nid is None:
+            raise ValueError(f"unknown node {uname}")
+        self._restart_counter += 1
+        for attempt in range(10):
+            try:
+                return await self.start_node(nid)
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or attempt == 9:
+                    raise
+                await asyncio.sleep(0.2)
+        raise AssertionError("unreachable")
+
+    async def stop(self) -> None:
+        for uname in list(self.nodes):
+            await self.crash_node(uname)
+        await self.dns.stop()
+
+    # ---- fault application ----
+
+    def _apply_faults_to(self, sn: SimNode) -> None:
+        t = sn.node.transport
+        assert t is not None
+        uname = sn.node.me.unique_name
+        if self._loss_pct > 0:
+            t.set_loss(self._loss_pct,
+                       _child_seed(self.seed, f"loss/{uname}"))
+        if self._shape_args:
+            t.shaper = LinkShaper(
+                seed=_child_seed(self.seed,
+                                 f"shape/{uname}/{self._restart_counter}"),
+                **self._shape_args,
+            )
+        if self._store_fault_args:
+            sn.store.data_plane.fault = TunnelFault(
+                seed=_child_seed(self.seed, f"tunnel/{uname}"),
+                **self._store_fault_args,
+            )
+        if self._partition_groups is not None:
+            # a node restarting into an active partition must land on
+            # ONE side, not silently bridge both: assign it to the
+            # majority group (deterministic) before re-installing
+            if not any(uname in g for g in self._partition_groups):
+                max(self._partition_groups, key=len).append(uname)
+            self._install_partition(self._partition_groups)
+
+    def set_loss(self, pct: float) -> None:
+        self._loss_pct = pct
+        for uname, sn in self.nodes.items():
+            sn.node.transport.set_loss(
+                pct, _child_seed(self.seed, f"loss/{uname}")
+            )
+
+    def set_shape(self, **kw: float) -> None:
+        self._shape_args = {k: v for k, v in kw.items() if v} or None
+        for uname, sn in self.nodes.items():
+            sn.node.transport.shaper = (
+                LinkShaper(
+                    seed=_child_seed(
+                        self.seed, f"shape/{uname}/{self._restart_counter}"
+                    ),
+                    **self._shape_args,
+                )
+                if self._shape_args
+                else None
+            )
+
+    def set_store_fault(self, **kw: float) -> None:
+        self._store_fault_args = {k: v for k, v in kw.items() if v} or None
+        for uname, sn in self.nodes.items():
+            sn.store.data_plane.fault = (
+                TunnelFault(
+                    seed=_child_seed(self.seed, f"tunnel/{uname}"), **kw
+                )
+                if self._store_fault_args
+                else None
+            )
+
+    def partition(self, groups: List[List[str]]) -> None:
+        """Bidirectional control-plane partition between groups (the
+        introducer stays reachable — it is a rendezvous, not a
+        router; the TCP data plane is gated separately via
+        store_fault)."""
+        self._partition_groups = [list(g) for g in groups]
+        self._install_partition(self._partition_groups)
+
+    def _install_partition(self, groups: List[List[str]]) -> None:
+        port_group: Dict[int, int] = {}
+        for gi, unames in enumerate(groups):
+            for uname in unames:
+                nid = self.spec.node_by_unique_name(uname)
+                if nid is not None:
+                    port_group[nid.port] = gi
+        for sn in self.nodes.values():
+            mine = port_group.get(sn.node.me.port)
+
+            def blocked(addr, mine=mine):
+                other = port_group.get(addr[1])
+                return other is not None and mine is not None and other != mine
+
+            sn.node.transport.partition_filter = blocked
+
+    def heal(self) -> None:
+        self._partition_groups = None
+        for sn in self.nodes.values():
+            sn.node.transport.partition_filter = None
+
+    # ---- views ----
+
+    def leader_uname(self) -> Optional[str]:
+        """The leader every live node agrees on, else None."""
+        seen = {sn.node.leader_unique for sn in self.nodes.values()}
+        if len(seen) == 1:
+            (leader,) = seen
+            if leader in self.nodes:
+                return leader
+        return None
+
+    def any_leader_store(self) -> Optional[StoreService]:
+        for sn in self.nodes.values():
+            if sn.node.is_leader:
+                return sn.store
+        return None
+
+    def client(self, avoid: Tuple[str, ...] = ()) -> SimNode:
+        """A live node to drive client verbs from (prefers a
+        non-leader so client traffic crosses the wire)."""
+        for uname in sorted(self.nodes):
+            sn = self.nodes[uname]
+            if uname not in avoid and not sn.node.is_leader:
+                return sn
+        return self.nodes[sorted(self.nodes)[0]]
+
+    def resolve_target(self, target: Optional[str]) -> Optional[str]:
+        """Map a plan target to a live node's unique_name."""
+        if target is None:
+            return None
+        if target == "leader":
+            for uname, sn in sorted(self.nodes.items()):
+                if sn.node.is_leader:
+                    return uname
+            return self.leader_uname()
+        if target == "standby":
+            for sn in self.nodes.values():
+                if sn.node.is_leader:
+                    sb = sn.store.standby_node()
+                    return sb.unique_name if sb else None
+            return None
+        if target == "worker":
+            leader = self.resolve_target("leader")
+            standby = self.resolve_target("standby")
+            for uname in sorted(self.nodes):
+                if uname not in (leader, standby):
+                    return uname
+            return None
+        nid = self.spec.node_by_name(target)
+        if nid is not None:
+            return nid.unique_name
+        return target if target in self.nodes else None
+
+    # ---- waiting ----
+
+    async def wait_for(self, cond: Callable[[], bool], timeout: float,
+                       what: str) -> float:
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        deadline = t0 + timeout
+        while loop.time() < deadline:
+            if cond():
+                return loop.time() - t0
+            await asyncio.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def converged(self) -> bool:
+        """Every live node joined, agreeing on one live leader, with
+        identical live membership."""
+        if not self.nodes:
+            return False
+        want = set(self.nodes)
+        for sn in self.nodes.values():
+            if not sn.node.joined or sn.node.leader_unique not in want:
+                return False
+            alive = {n.unique_name for n in sn.node.membership.alive_nodes()}
+            if alive != want:
+                return False
+        return self.leader_uname() is not None
+
+    def replication_satisfied(self) -> bool:
+        """Every file the leader tracks has `replication_factor` live
+        copies (capped by cluster size) — and the leader's table
+        actually knows every expected file, so the check can't pass
+        vacuously on a table that lost entries to churn."""
+        leader_store = self.any_leader_store()
+        if leader_store is None or not self.converged():
+            return False
+        live = set(self.nodes)
+        want = min(self.spec.store.replication_factor, len(live))
+        md = leader_store.metadata
+        files = md.all_files()
+        if not self.expect_files <= set(files):
+            return False
+        for f in files:
+            if len([r for r in md.replicas_of(f) if r in live]) < want:
+                return False
+        return True
+
+
+# ----------------------------------------------------------------------
+# invariants
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class InvariantReport:
+    ok: bool
+    failures: List[str] = field(default_factory=list)
+    checks: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+async def invariant_sweep(
+    cluster: LocalCluster,
+    acked_jobs: Dict[int, Dict[str, Any]],
+    seed_files: Dict[str, bytes],
+    timeout: float = 25.0,
+) -> InvariantReport:
+    """The machine-checked end state every plan run must reach."""
+    failures: List[str] = []
+    checks: Dict[str, Any] = {}
+
+    # 1. exactly-one-leader convergence across the live nodes
+    try:
+        wall = await cluster.wait_for(
+            cluster.converged, timeout, "single-leader convergence"
+        )
+        checks["leader"] = {"leader": cluster.leader_uname(),
+                            "converged_in_s": round(wall, 2)}
+    except AssertionError:
+        views = {u: sn.node.leader_unique
+                 for u, sn in cluster.nodes.items()}
+        failures.append(f"no single-leader convergence: views={views}")
+
+    # 2. every acked job terminal, completions counted exactly once
+    leader_sn = next(
+        (sn for sn in cluster.nodes.values() if sn.node.is_leader), None
+    )
+    job_check: Dict[str, Any] = {"acked": len(acked_jobs)}
+    for job_id, meta in sorted(acked_jobs.items()):
+        outcome = meta.get("outcome")
+        if outcome in ("lost", "client_crashed"):
+            # 'lost': the coordinator lost the job across a failover
+            # (relay datagram dropped); the client was TOLD to
+            # resubmit and did — the fresh id is tracked separately.
+            # 'client_crashed': the submitting node was itself the
+            # crash victim, so nobody holds a completion promise.
+            continue
+        if outcome is None:
+            failures.append(f"job {job_id} never reached a terminal state")
+            continue
+        if leader_sn is None:
+            continue
+        st = leader_sn.jobs.scheduler.job_state(job_id)
+        if st is None:
+            # retired past the done_jobs ring or submitted to a
+            # since-crashed coordinator; the client-side outcome above
+            # is the authority
+            continue
+        if not st.done:
+            failures.append(f"job {job_id} not done on the coordinator")
+        if st.pending_batches != 0:
+            failures.append(
+                f"job {job_id} pending_batches={st.pending_batches} "
+                "(lost or duplicated completions)"
+            )
+    job_check["terminal"] = sum(
+        1 for m in acked_jobs.values() if m.get("outcome") == "done"
+    )
+    job_check["resubmitted_after_loss"] = sum(
+        1 for m in acked_jobs.values() if m.get("outcome") == "lost"
+    )
+    checks["jobs"] = job_check
+
+    # 3. store repair: factor copies + seed-file content intact
+    try:
+        wall = await cluster.wait_for(
+            cluster.replication_satisfied, timeout,
+            "replication back to factor",
+        )
+        checks["replication"] = {"repaired_in_s": round(wall, 2)}
+    except AssertionError:
+        leader_store = cluster.any_leader_store()
+        thin = {}
+        if leader_store is not None:
+            live = set(cluster.nodes)
+            md = leader_store.metadata
+            thin = {
+                f: [r for r in md.replicas_of(f) if r in live]
+                for f in md.all_files()
+            }
+        failures.append(
+            f"files not back to replication_factor copies: {thin}"
+        )
+    client = cluster.client()
+    for name, blob in sorted(seed_files.items()):
+        try:
+            got = await client.store.get_bytes(name, timeout=10.0)
+        except Exception as e:
+            failures.append(f"seed file {name} unreadable after chaos: {e}")
+            continue
+        if got != blob:
+            failures.append(f"seed file {name} content corrupted")
+    checks["seed_files"] = sorted(seed_files)
+
+    # 4. no metrics gauge negative (an in-process sim shares one
+    # registry, so this sweeps every node's gauges at once)
+    snap = METRICS.snapshot()
+    negative = {k: v for k, v in snap["gauges"].items() if v < 0}
+    if negative:
+        failures.append(f"negative gauges: {negative}")
+    checks["gauges_scanned"] = len(snap["gauges"])
+
+    return InvariantReport(ok=not failures, failures=failures, checks=checks)
+
+
+# ----------------------------------------------------------------------
+# runner
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class ChaosReport:
+    plan: ChaosPlan
+    invariants: InvariantReport
+    executed: List[Dict[str, Any]]
+    failover_recovery_s: List[float]
+    store_repair_s: List[float]
+    jobs: Dict[int, Dict[str, Any]]
+    wall_s: float
+
+    @property
+    def ok(self) -> bool:
+        return self.invariants.ok
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "plan": self.plan.to_dict(),
+            "ok": self.ok,
+            "invariants": self.invariants.to_dict(),
+            "executed": self.executed,
+            "failover_recovery_s": [
+                round(x, 3) for x in self.failover_recovery_s
+            ],
+            "store_repair_s": [round(x, 3) for x in self.store_repair_s],
+            "jobs": {str(k): dict(v) for k, v in self.jobs.items()},
+            "wall_s": round(self.wall_s, 2),
+        }
+
+
+class ChaosRunner:
+    """Executes one ChaosPlan against a LocalCluster and sweeps the
+    invariants. One runner per run."""
+
+    def __init__(self, cluster: LocalCluster, plan: ChaosPlan):
+        self.cluster = cluster
+        self.plan = plan
+        self.executed: List[Dict[str, Any]] = []
+        self.failover_recovery_s: List[float] = []
+        self.store_repair_s: List[float] = []
+        #: job_id -> {model, n, client, outcome: done|failed|lost|None}
+        self.jobs: Dict[int, Dict[str, Any]] = {}
+        self.seed_files: Dict[str, bytes] = {}
+        self._last_crashed: List[str] = []
+        self._bg: List[asyncio.Task] = []
+        self._workload: List[asyncio.Task] = []
+        self._put_counter = 0
+
+    # ---- workload ----
+
+    def _seed_blob(self, name: str, size: int) -> bytes:
+        rng = random.Random(_child_seed(self.plan.seed, f"blob/{name}"))
+        return bytes(rng.getrandbits(8) for _ in range(size))
+
+    def _client_crashed(self, client: SimNode) -> bool:
+        """True when `client`'s service stack is no longer the live
+        one — compared by OBJECT identity, not name: a crash victim
+        that already restarted re-registers the same unique_name with
+        a fresh stack, and the old handle is still dead."""
+        return (
+            self.cluster.nodes.get(client.node.me.unique_name)
+            is not client
+        )
+
+    async def _do_put(self, name: str, size: int) -> None:
+        blob = self._seed_blob(name, size)
+        last: Optional[Exception] = None
+        for _ in range(3):
+            client = self.cluster.client()
+            try:
+                await client.store.put_bytes(name, blob, timeout=20.0)
+                self.seed_files[name] = blob
+                self.cluster.expect_files.add(name)
+                return
+            except Exception as e:
+                if self._client_crashed(client):
+                    last = e  # our client node was a crash victim
+                    continue
+                raise
+        raise RuntimeError(f"put {name} failed on 3 clients") from last
+
+    async def _do_job(self, n: int) -> None:
+        """Submit + await one stub job, tracking its terminal state.
+        A job the (possibly new) coordinator lost across a failover is
+        recorded as 'lost' and resubmitted once — that is the client
+        contract wait_job documents. A job whose CLIENT node was the
+        crash victim is untrackable from that client; it is marked and
+        resubmitted from a live node."""
+        for attempt in range(3):
+            client = self.cluster.client()
+            meta = {"model": STUB_MODEL, "n": n,
+                    "client": client.node.me.unique_name, "outcome": None}
+            job_id = None
+            try:
+                job_id = await client.jobs.submit_job(
+                    STUB_MODEL, n, timeout=15.0, retries=5
+                )
+                self.jobs[job_id] = meta
+                # generous: the sandbox host can stall the whole
+                # process for tens of seconds; the job completes the
+                # moment the loop thaws
+                done = await client.jobs.wait_job(job_id, timeout=100.0)
+                if int(done.get("total_queries", 0)) != n:
+                    meta["outcome"] = "failed"
+                    raise AssertionError(
+                        f"job {job_id} completed {done} != {n} queries"
+                    )
+                meta["outcome"] = "done"
+                return
+            except Exception as e:
+                if self._client_crashed(client):
+                    # the CLIENT was a crash victim (its sends raise):
+                    # submit never acked -> meta was never tracked;
+                    # acked -> mark it so the sweep skips this id
+                    meta["outcome"] = "client_crashed"
+                    continue
+                if (isinstance(e, RuntimeError) and "lost" in str(e)
+                        and attempt < 2):
+                    meta["outcome"] = "lost"
+                    continue  # resubmit under a fresh id
+                meta["outcome"] = "failed"
+                raise
+        raise RuntimeError("job never reached a terminal state on 3 clients")
+
+    def _spawn_workload(self, coro: Awaitable, what: str) -> asyncio.Task:
+        t = asyncio.create_task(coro, name=f"chaos-{what}")
+        self._workload.append(t)
+        return t
+
+    # ---- recovery measurement ----
+
+    def _measure(self, kind: str, cond: Callable[[], bool],
+                 sink: List[float], hist, timeout: float = 30.0) -> None:
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+
+        async def poll():
+            while loop.time() - t0 < timeout:
+                if cond():
+                    wall = loop.time() - t0
+                    sink.append(wall)
+                    hist.observe(wall)
+                    return
+                await asyncio.sleep(0.02)
+            log.warning("chaos: %s recovery not observed in %.0fs",
+                        kind, timeout)
+
+        self._bg.append(asyncio.create_task(poll(), name=f"chaos-{kind}"))
+
+    # ---- event execution ----
+
+    async def _apply(self, ev: ChaosEvent) -> None:
+        c = self.cluster
+        record: Dict[str, Any] = ev.to_dict()
+        if ev.kind == "crash":
+            uname = c.resolve_target(ev.target)
+            if uname is None or uname not in c.nodes:
+                record["skipped"] = "no live target"
+                self.executed.append(record)
+                return
+            was_leader = c.nodes[uname].node.is_leader
+            mid = ev.arg("mid", ())
+            if "put" in mid:
+                self._put_counter += 1
+                self._spawn_workload(
+                    self._do_put(f"mid_crash_{self._put_counter}.bin", 1024),
+                    "mid-crash-put",
+                )
+            if "job" in mid:
+                self._spawn_workload(self._do_job(24), "mid-crash-job")
+            if mid:
+                # let the workload's datagrams actually reach the wire
+                await asyncio.sleep(3 * c.spec.timing.ping_interval)
+            await c.crash_node(uname)
+            self._last_crashed.append(uname)
+            record["resolved"] = uname
+            record["was_leader"] = was_leader
+            if was_leader:
+                self._measure("failover", c.converged,
+                              self.failover_recovery_s, _M_FAILOVER)
+            self._measure("repair", c.replication_satisfied,
+                          self.store_repair_s, _M_REPAIR)
+        elif ev.kind == "restart":
+            uname = (
+                self._last_crashed.pop()
+                if ev.target in (None, "last") and self._last_crashed
+                else c.resolve_target(ev.target)
+            )
+            if uname is None or uname in c.nodes:
+                record["skipped"] = "nothing to restart"
+            else:
+                await c.restart_node(uname)
+                record["resolved"] = uname
+                self._measure("repair", c.replication_satisfied,
+                              self.store_repair_s, _M_REPAIR)
+        elif ev.kind == "partition":
+            frac = float(ev.arg("fraction", 0.4))
+            unames = sorted(c.nodes)
+            k = max(1, min(len(unames) - 1, int(round(frac * len(unames)))))
+            groups = [unames[:k], unames[k:]]
+            c.partition(groups)
+            record["groups"] = groups
+        elif ev.kind == "heal":
+            c.heal()
+            self._measure("repair", c.replication_satisfied,
+                          self.store_repair_s, _M_REPAIR)
+        elif ev.kind == "loss":
+            c.set_loss(float(ev.arg("pct", 0.0)))
+        elif ev.kind == "shape":
+            c.set_shape(**{k: float(v) for k, v in ev.args})
+        elif ev.kind == "store_fault":
+            c.set_store_fault(**{k: float(v) for k, v in ev.args})
+        elif ev.kind == "store_heal":
+            c.set_store_fault()
+            self._measure("repair", c.replication_satisfied,
+                          self.store_repair_s, _M_REPAIR)
+        elif ev.kind == "put":
+            self._spawn_workload(
+                self._do_put(str(ev.arg("name", "chaos.bin")),
+                             int(ev.arg("size", 1024))),
+                "put",
+            )
+        elif ev.kind == "job":
+            self._spawn_workload(self._do_job(int(ev.arg("n", 16))), "job")
+        self.executed.append(record)
+
+    async def run(self) -> ChaosReport:
+        t_start = asyncio.get_running_loop().time()
+        await self.cluster.wait_for(
+            self.cluster.converged, 15.0, "initial convergence"
+        )
+        # seed the job inputs (the intake samples *.jpeg names from
+        # the store) BEFORE any fault fires; they double as the
+        # content-integrity probes of the final sweep
+        for i in range(4):
+            await self._do_put(f"chaos_img_{i}.jpeg", 512)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        for ev in self.plan.events:
+            delay = t0 + ev.t - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            try:
+                await self._apply(ev)
+            except Exception as e:
+                log.exception("chaos: event %s failed", ev)
+                self.executed.append(dict(ev.to_dict(), error=repr(e)))
+        await asyncio.sleep(self.plan.settle_s)
+        # workload must drain: a put or job still hanging here is a
+        # recovery failure in its own right
+        workload_errors: List[str] = []
+        if self._workload:
+            done, pending = await asyncio.wait(
+                self._workload, timeout=120.0
+            )
+            for t in pending:
+                t.cancel()
+                workload_errors.append(f"workload {t.get_name()} hung")
+            for t in done:
+                if not t.cancelled() and t.exception() is not None:
+                    workload_errors.append(
+                        f"workload {t.get_name()}: {t.exception()!r}"
+                    )
+        # recovery monitors get a bounded drain too
+        if self._bg:
+            await asyncio.wait(self._bg, timeout=30.0)
+            for t in self._bg:
+                if not t.done():
+                    t.cancel()
+        report = await invariant_sweep(
+            self.cluster, self.jobs, self.seed_files
+        )
+        # an event that ERRORED (failed restart, crash that threw)
+        # means the plan did not actually run as scheduled — the
+        # verdict must say so, not report a green sweep over a
+        # scenario that silently lost its headline fault. (Resolution
+        # skips — e.g. 'nothing to restart' in a random plan — are
+        # legitimate outcomes and stay informational.)
+        event_errors = [
+            f"event t={r['t']} {r['kind']} failed: {r['error']}"
+            for r in self.executed if "error" in r
+        ]
+        report.failures = workload_errors + event_errors + report.failures
+        report.ok = not report.failures
+        return ChaosReport(
+            plan=self.plan,
+            invariants=report,
+            executed=self.executed,
+            failover_recovery_s=self.failover_recovery_s,
+            store_repair_s=self.store_repair_s,
+            jobs=self.jobs,
+            wall_s=asyncio.get_running_loop().time() - t_start,
+        )
+
+
+async def run_plan(
+    plan: ChaosPlan,
+    base_port: int,
+    root: Optional[str] = None,
+    timing: Timing = FAST_TIMING,
+) -> ChaosReport:
+    """Bring up a LocalCluster, run the plan, tear down. The one
+    entry point tests, the CLI verb, and the bench section share."""
+    own_root = root is None
+    root = root or os.path.join(
+        "/tmp", f"dml_tpu_chaos_{os.getpid()}_{base_port}"
+    )
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root, exist_ok=True)
+    cluster = LocalCluster(
+        plan.n_nodes, root, base_port, seed=plan.seed, timing=timing
+    )
+    try:
+        await cluster.start()
+        return await ChaosRunner(cluster, plan).run()
+    finally:
+        await cluster.stop()
+        if own_root:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def run_plan_sync(plan: ChaosPlan, base_port: int,
+                  root: Optional[str] = None) -> ChaosReport:
+    return asyncio.run(run_plan(plan, base_port, root=root))

@@ -32,6 +32,7 @@ from ..config import ClusterSpec, NodeId
 from .election import Election
 from .membership import MembershipHooks, MembershipList
 from .transport import UdpTransport
+from .util import reap_task
 from .wire import Message, MsgType
 
 log = logging.getLogger(__name__)
@@ -99,12 +100,9 @@ class Node:
     async def stop(self) -> None:
         self._stopped.set()
         for t in self._tasks:
-            t.cancel()
-        for t in self._tasks:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+            # real teardown bugs get logged (the old blanket
+            # `except (CancelledError, Exception)` swallowed them)
+            await reap_task(t, self.me, f"task {t.get_name()}")
         self._tasks = []
         if self.transport is not None:
             self.transport.close()

@@ -23,12 +23,51 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import random
 import secrets
 from typing import Dict, List, Optional, Tuple
 
 from .local_store import LocalStore
 
 _CHUNK = 1 << 16
+
+
+class TunnelFault:
+    """Seeded data-plane fault model: slow and/or failing bulk copies.
+
+    The chaos engine installs one per node's DataPlane; every client
+    pull (GET fetch, PUT token pull, repair replicate) first consults
+    it. Decisions come from a private ``random.Random(seed)`` so a
+    plan re-run makes the identical slow/fail choices per pull.
+
+    - ``delay_s``: every pull sleeps this long first (a congested or
+      high-latency tunnel; the copy still succeeds)
+    - ``fail_pct``: percent of pulls that raise ConnectionError
+      instead of transferring (a flapping link / dying peer)
+    """
+
+    def __init__(self, seed: int = 0, delay_s: float = 0.0,
+                 fail_pct: float = 0.0):
+        if fail_pct < 0 or fail_pct > 100:
+            raise ValueError(f"fail_pct {fail_pct} out of range")
+        if delay_s < 0:
+            raise ValueError("delay_s must be >= 0")
+        self.delay_s = delay_s
+        self.fail_pct = fail_pct
+        self.enabled = True
+        self._rng = random.Random(seed)
+
+    async def apply(self) -> None:
+        """Consume one decision; sleep and/or raise per the model.
+        RNG state advances even while disabled so a plan's decision
+        stream doesn't depend on when the fault was switched on."""
+        fail = self._rng.random() * 100.0 < self.fail_pct
+        if not self.enabled:
+            return
+        if self.delay_s > 0:
+            await asyncio.sleep(self.delay_s)
+        if fail:
+            raise ConnectionError("injected tunnel fault (TunnelFault)")
 
 
 class DataPlane:
@@ -38,6 +77,12 @@ class DataPlane:
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
         self._exposed: Dict[str, str] = {}  # token -> local path
+        # fault-injection seam: slow/failing outbound pulls (chaos)
+        self.fault: Optional[TunnelFault] = None
+
+    async def _maybe_fault(self) -> None:
+        if self.fault is not None:
+            await self.fault.apply()
 
     # ---- lifecycle ----
 
@@ -158,6 +203,7 @@ class DataPlane:
         timeout: float = 30.0,
     ) -> Tuple[bytes, int]:
         """Pull one version (latest if None) from a remote node."""
+        await self._maybe_fault()
         header, payload = await self._rpc(
             addr, {"op": "fetch_store", "file": name, "version": version}, timeout
         )
@@ -170,6 +216,7 @@ class DataPlane:
     ) -> List[int]:
         """Pull ALL versions of `name` from a live replica into the
         local store (reference replicate_file, file_service.py:52-61)."""
+        await self._maybe_fault()
         header, payload = await self._rpc(
             addr, {"op": "fetch_store", "file": name, "all_versions": True}, timeout
         )
@@ -195,6 +242,7 @@ class DataPlane:
         at an explicit version (the leader assigns the version so all
         replicas agree; the reference lets each replica pick its own
         next version, which can skew)."""
+        await self._maybe_fault()
         header, payload = await self._rpc(addr, {"op": "fetch_token", "token": token}, timeout)
         if not header.get("ok"):
             raise FileNotFoundError(f"token at {addr}: {header.get('error')}")

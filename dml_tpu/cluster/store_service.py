@@ -50,7 +50,7 @@ from .node import Node
 from .store.data_plane import DataPlane
 from .store.local_store import LocalStore
 from .store.metadata import StoreMetadata
-from .util import BoundedDict, leader_retry
+from .util import BoundedDict, leader_retry, reap_task
 from .wire import Message, MsgType
 
 log = logging.getLogger(__name__)
@@ -128,26 +128,33 @@ class StoreService:
         )
 
     async def stop(self) -> None:
-        if self._resend_task is not None:
-            self._resend_task.cancel()
-            try:
-                await self._resend_task
-            except (asyncio.CancelledError, Exception):
-                pass
-            self._resend_task = None
+        await reap_task(self._resend_task, self._me, "resend loop")
+        self._resend_task = None
         await self.data_plane.stop()
 
     async def _resend_loop(self) -> None:
         """Re-send fan-out messages to replicas that haven't ACKed
         (covers a dropped DOWNLOAD_FILE/DELETE_FILE or a dropped ACK;
-        replica handlers are idempotent so re-delivery is safe)."""
+        replica handlers are idempotent so re-delivery is safe).
+
+        Non-leader side: periodically re-report the local inventory.
+        Without this, the leader's global table learns a node's files
+        ONLY from join-time ALL_LOCAL_FILES and election
+        COORDINATE_ACKs — all single unacked datagrams — so a node
+        resurrected after a partition (or whose election ACK was
+        dropped) can hold bytes the leader never finds again: GETs
+        report "file not found" and repair has no source. The chaos
+        soak exposed exactly this as a permanent metadata hole."""
         interval = max(self.node.spec.timing.ping_interval, 0.05)
         tick = 0
         while True:
             await asyncio.sleep(interval)
-            if not self.node.is_leader:
-                continue
             tick += 1
+            if not self.node.is_leader:
+                leader = self.node.leader_unique
+                if tick % 20 == 0 and self.node.joined and leader:
+                    self._send_inventory_report(leader)
+                continue
             if tick % 10 == 0:
                 # periodic under-replication sweep: joins/deaths whose
                 # event-time repair raced membership convergence heal
@@ -170,6 +177,38 @@ class StoreService:
                             self.node.send_unique(r, mtype, st.fanout_payload)
             except Exception:
                 log.exception("%s: store resend tick failed", self._me)
+
+    def _send_inventory_report(self, leader: str) -> None:
+        """Report the local inventory, chunked to fit the datagram cap
+        — a big store must not lose the metadata-hole protection the
+        periodic re-report exists for. Chunks carry ``partial`` so the
+        leader MERGES them (an authoritative overwrite per chunk would
+        erase the other chunks' entries)."""
+        inv = self.store.inventory()
+        chunk: Dict[str, List[int]] = {}
+        chunks = [chunk]
+        budget = 0
+        for f, vs in inv.items():
+            cost = len(f) + 12 * len(vs) + 8  # rough JSON bytes
+            if chunk and budget + cost > 40_000:
+                chunk = {}
+                chunks.append(chunk)
+                budget = 0
+            chunk[f] = vs
+            budget += cost
+        partial = len(chunks) > 1
+        for ch in chunks:
+            try:
+                self.node.send_unique(
+                    leader, MsgType.ALL_LOCAL_FILES,
+                    {"files": ch, "partial": partial} if partial
+                    else {"files": ch},
+                )
+            except ValueError:  # a single entry beyond the frame cap
+                log.warning(
+                    "%s: inventory chunk exceeds the datagram cap; "
+                    "re-report incomplete", self._me,
+                )
 
     # ------------------------------------------------------------------
     # helpers
@@ -465,15 +504,53 @@ class StoreService:
         )
 
     async def _h_all_local_files(self, msg: Message, addr) -> None:
-        """A joining node reported its files (reference worker.py:598-614);
-        merge and keep the standby's copy warm."""
+        """A joining node (or a replica's periodic re-report) reported
+        its files (reference worker.py:598-614); merge and keep the
+        standby's copy warm.
+
+        Reports are snapshots riding unordered UDP: one taken before a
+        DELETE committed can arrive after it. Recording such a file
+        would resurrect it (and the repair sweep would re-replicate it
+        cluster-wide), so recently-deleted names are filtered out and
+        the stale holder is told to drop its bytes instead. A no-op
+        report (inventory already matches the table) skips the standby
+        relay and the repair sweep — the steady-state re-report must
+        not cost O(files) work per tick."""
         if not self.node.is_leader:
             return
         files = {f: [int(v) for v in vs] for f, vs in msg.data.get("files", {}).items()}
+        for f in [f for f in files if f in self._recent_deletes]:
+            del files[f]
+            self.node.send_unique(
+                msg.sender, MsgType.DELETE_FILE,
+                {"file": f, "rid": self.node.new_rid()},
+            )
+        cur = self.metadata.files.get(msg.sender)
+        if msg.data.get("partial"):
+            # one chunk of a multi-datagram report: merge, never
+            # overwrite (the other chunks' entries must survive).
+            # Partial reports only ADD/refresh; removals ride the
+            # delete fan-out and failure paths.
+            if cur is not None and all(
+                cur.get(f) == sorted(vs) for f, vs in files.items()
+            ):
+                return  # chunk already reflected
+            files = {**(cur or {}), **files}
+        elif files == cur:
+            return  # steady-state re-report: nothing changed
         self.metadata.set_node_inventory(msg.sender, files)
-        self._relay_to_standby(
-            MsgType.ALL_LOCAL_FILES_RELAY, {"node": msg.sender, "files": files}
-        )
+        try:
+            self._relay_to_standby(
+                MsgType.ALL_LOCAL_FILES_RELAY,
+                {"node": msg.sender, "files": files},
+            )
+        except ValueError:
+            # merged inventory over the frame cap: the standby falls
+            # back to its COORDINATE_ACK rebuild on failover
+            log.warning(
+                "%s: inventory relay for %s exceeds the datagram cap",
+                self._me, msg.sender,
+            )
         # a JOIN can also end under-replication: files PUT while the
         # cluster was smaller than replication_factor gain copies the
         # moment capacity exists (the reference repairs only on deaths,

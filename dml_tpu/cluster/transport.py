@@ -38,6 +38,12 @@ _M_RECV = METRICS.counter(
     "well-formed datagrams received, by message type")
 _M_RECV_BYTES = METRICS.counter(
     "transport_bytes_received_total", "bytes received, by message type")
+_M_DUPED = METRICS.counter(
+    "transport_packets_duplicated_total",
+    "extra datagram copies emitted by the duplication injector")
+_M_DELAYED = METRICS.counter(
+    "transport_packets_delayed_total",
+    "outbound datagrams held back by the delay/reorder injector")
 
 
 class LossInjector:
@@ -74,6 +80,73 @@ class LossInjector:
         return drop
 
 
+class LinkShaper:
+    """Seeded transport-level fault model: per-datagram delay,
+    duplication, and reordering, composable with the drop/partition
+    seams that already live on the transport.
+
+    The chaos engine sets one shaper per node transport; every
+    decision comes from a private ``random.Random(seed)``, so a plan
+    re-run with the same seed makes the identical per-send choices
+    (the *schedule* of injected faults is deterministic; actual
+    arrival interleaving still rides the event loop, like a real
+    network).
+
+    - ``delay_s``/``jitter_s``: every datagram is held back by
+      ``delay_s + U[0, jitter_s)`` before hitting the socket.
+    - ``dup_pct``: percent of datagrams emitted twice (the second
+      copy lands after ``reorder_extra_s`` so the duplicate is also a
+      straggler, the worst case for idempotency).
+    - ``reorder_pct``: percent of datagrams additionally held for
+      ``reorder_extra_s``, so later sends overtake them —
+      reordering without modeling a full queue.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        delay_s: float = 0.0,
+        jitter_s: float = 0.0,
+        dup_pct: float = 0.0,
+        reorder_pct: float = 0.0,
+        reorder_extra_s: float = 0.05,
+        match: Optional[Callable[[Tuple[str, int]], bool]] = None,
+    ):
+        for name, pct in (("dup_pct", dup_pct), ("reorder_pct", reorder_pct)):
+            if pct < 0 or pct > 100:
+                raise ValueError(f"{name} {pct} out of range")
+        if delay_s < 0 or jitter_s < 0 or reorder_extra_s < 0:
+            raise ValueError("delays must be >= 0")
+        self.delay_s = delay_s
+        self.jitter_s = jitter_s
+        self.dup_pct = dup_pct
+        self.reorder_pct = reorder_pct
+        self.reorder_extra_s = reorder_extra_s
+        #: optional per-link scope: shape only datagrams whose dest
+        #: address matches (None = every link from this node)
+        self.match = match
+        self.enabled = True
+        self._rng = random.Random(seed)
+
+    def delays(self, addr: Tuple[str, int]) -> list:
+        """Per-copy send delays for one datagram (one entry per copy;
+        0.0 = send immediately). Consumes RNG state even for
+        unmatched links so a plan's decision stream doesn't depend on
+        which addresses happen to be dialed."""
+        rng = self._rng
+        delay = self.delay_s + (rng.uniform(0.0, self.jitter_s) if self.jitter_s else 0.0)
+        reorder = rng.random() * 100.0 < self.reorder_pct
+        dup = rng.random() * 100.0 < self.dup_pct
+        if not self.enabled or (self.match is not None and not self.match(addr)):
+            return [0.0]
+        if reorder:
+            delay += self.reorder_extra_s
+        out = [delay]
+        if dup:
+            out.append(delay + self.reorder_extra_s)
+        return out
+
+
 class UdpTransport(asyncio.DatagramProtocol):
     """Bind a UDP socket; queue inbound Messages; count outbound bytes."""
 
@@ -92,9 +165,19 @@ class UdpTransport(asyncio.DatagramProtocol):
         # are dropped (set symmetrically on every node for a full
         # bidirectional partition).
         self.partition_filter: Optional[Callable[[Tuple[str, int]], bool]] = None
+        # fault-injection seam: per-link delay/duplication/reordering
+        # (the chaos engine installs one; None = clean link)
+        self.shaper: Optional[LinkShaper] = None
 
     def set_loss_enabled(self, enabled: bool) -> None:
         self._loss.enabled = enabled
+
+    def set_loss(self, pct: float, seed: int = 0) -> None:
+        """Swap the loss schedule at runtime (chaos loss ramps). The
+        fresh injector starts at slot 0, so the drop pattern for a
+        given (pct, seed) is reproducible no matter when the ramp
+        fires."""
+        self._loss = LossInjector(pct, seed)
 
     # -- DatagramProtocol callbacks --
 
@@ -153,7 +236,29 @@ class UdpTransport(asyncio.DatagramProtocol):
         self.packets_sent += 1
         _M_SENT.inc(1, type=msg.type.name)
         _M_SENT_BYTES.inc(len(frame), type=msg.type.name)
-        self._transport.sendto(frame, addr)
+        shaper = self.shaper
+        if shaper is None:
+            self._transport.sendto(frame, addr)
+            return
+        # shaped link: the shaper decides, per copy, how long each
+        # datagram is held back (0.0 = the clean immediate path)
+        for i, delay in enumerate(shaper.delays(addr)):
+            if i:
+                _M_DUPED.inc(1, type=msg.type.name)
+            if delay <= 0.0:
+                self._transport.sendto(frame, addr)
+                continue
+            _M_DELAYED.inc(1, type=msg.type.name)
+            asyncio.get_running_loop().call_later(
+                delay, self._sendto_if_open, frame, addr
+            )
+
+    def _sendto_if_open(self, frame: bytes, addr: Tuple[str, int]) -> None:
+        """Deferred emit for shaped datagrams; a copy whose timer fires
+        after close() is dropped on the floor (the node crashed — the
+        network does the same)."""
+        if self._transport is not None:
+            self._transport.sendto(frame, addr)
 
     async def recv(self) -> Tuple[Message, Tuple[str, int]]:
         return await self._queue.get()
@@ -167,5 +272,11 @@ class UdpTransport(asyncio.DatagramProtocol):
 
     def close(self) -> None:
         if self._transport is not None:
-            self._transport.close()
+            # abort, not close: close() keeps the socket (and the
+            # PORT) alive until asyncio drains any buffered sends —
+            # an un-flushed buffer under load holds the bind for
+            # seconds, and a node restarting with the same identity
+            # then fails EADDRINUSE. This is at-most-once UDP: the
+            # buffered tail datagrams are within the loss model.
+            self._transport.abort()
             self._transport = None

@@ -4,9 +4,39 @@ control plane."""
 from __future__ import annotations
 
 import asyncio
+import itertools
+import logging
+import random
+import zlib
 from typing import Any, Dict, Optional
 
 from .wire import MsgType
+
+log = logging.getLogger(__name__)
+
+
+async def reap_task(task: Optional[asyncio.Task], who: Any, what: str) -> None:
+    """Cancel-and-await one background task during teardown, logging
+    anything other than the requested cancellation — the one shared
+    form of the stop() reap (a blanket ``except (CancelledError,
+    Exception): pass`` here used to hide real teardown bugs)."""
+    if task is None:
+        return
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        if not task.cancelled():
+            # the reaped task did NOT end cancelled, so this
+            # CancelledError was aimed at the CALLER (e.g. a timeout
+            # around stop()) — it must propagate, not be absorbed
+            raise
+    except Exception:
+        log.exception("%s: %s raised during stop", who, what)
+
+
+#: distinguishes concurrent leader_retry calls in the default-jitter seed
+_retry_nonce = itertools.count()
 
 
 class BoundedDict(dict):
@@ -38,16 +68,70 @@ async def leader_retry(
     data: Dict[str, Any],
     timeout: float,
     retries: int = 3,
+    rng: Optional[random.Random] = None,
 ) -> Dict[str, Any]:
     """node.leader_request with retry on timeout: a dropped request or
     reply datagram must not strand the caller. Callers ensure the
     operation is idempotent (reads naturally; writes via dedup
-    tokens)."""
+    tokens).
+
+    Retries back off exponentially (capped at one try-slice) with
+    deterministic jitter, so under loss the cluster's clients don't
+    re-fire in lockstep and hammer the leader in synchronized waves.
+    The default jitter stream is seeded from this node's identity,
+    the message type, and a per-call nonce — decorrelated across
+    nodes AND across concurrent calls on one node, reproducible given
+    the same call order; pass `rng` to pin it exactly. The whole loop
+    observes a hard deadline of `timeout` seconds: per-try waits and
+    backoff sleeps shrink to fit, so the caller never waits longer
+    than it asked for.
+    """
+    if rng is None:
+        # per-call nonce: concurrent retries from ONE node for the
+        # same message type must not replay the identical jitter
+        # sequence and re-fire in synchronized bursts
+        rng = random.Random(zlib.crc32(
+            f"{node.me.unique_name}/{mtype.name}/"
+            f"{next(_retry_nonce)}".encode()
+        ))
     last: Optional[Exception] = None
-    per_try = max(0.5, timeout / max(1, retries))
-    for _ in range(max(1, retries)):
+    retries = max(1, retries)
+    per_try = max(0.5, timeout / retries)
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    backoff = min(0.05, per_try / 8)
+    attempt = 0
+    while True:
+        remaining = deadline - loop.time()
+        if remaining <= 0:
+            break
+        if node.leader_node is None:
+            # mid-failover: no leader to ask yet. Waiting here burns
+            # deadline, not send attempts — firing requests into the
+            # void would exhaust `retries` before the election ends.
+            last = last or RuntimeError("no leader known")
+            await asyncio.sleep(min(0.1, remaining))
+            continue
         try:
-            return await node.leader_request(mtype, data, timeout=per_try)
+            return await node.leader_request(
+                mtype, data, timeout=min(per_try, remaining)
+            )
         except asyncio.TimeoutError as e:
             last = e
+        except RuntimeError as e:
+            if "no leader" not in str(e):
+                # only the leaderless window is retryable here; a
+                # transport-not-bound / use-after-stop RuntimeError is
+                # a real bug that must surface, not become a
+                # misleading TimeoutError
+                raise
+            last = e  # leader vanished between the check and the send
+        attempt += 1
+        if attempt >= retries:
+            break
+        # capped exponential backoff, jittered over [0.5x, 1.5x)
+        sleep = min(per_try, backoff * (2 ** attempt)) * (0.5 + rng.random())
+        sleep = min(sleep, max(0.0, deadline - loop.time()))
+        if sleep > 0:
+            await asyncio.sleep(sleep)
     raise TimeoutError(f"{mtype.name} got no reply after {retries} tries") from last

@@ -40,7 +40,7 @@ from typing import Any, Awaitable, Callable, Deque, Dict, List, Optional, Tuple
 from ..config import NodeId
 from ..cluster.node import Node
 from ..cluster.store_service import StoreService, data_addr
-from ..cluster.util import BoundedDict, leader_retry
+from ..cluster.util import BoundedDict, leader_retry, reap_task
 from ..cluster.wire import Message, MsgType
 from ..models.registry import MODEL_REGISTRY, get_model
 from ..observability import METRICS
@@ -259,11 +259,7 @@ class JobService:
     async def stop(self) -> None:
         ct = getattr(self, "_ckpt_task", None)
         if ct is not None:
-            ct.cancel()
-            try:
-                await ct
-            except (asyncio.CancelledError, Exception):
-                pass
+            await reap_task(ct, self._me, "checkpoint loop")
             self._ckpt_task = None
         if self._staged is not None:
             self._staged[3].cancel()
@@ -272,11 +268,7 @@ class JobService:
             t.cancel()
         for t in [self._sched_task] + list(self._running.values()):
             if t is not None:
-                t.cancel()
-                try:
-                    await t
-                except (asyncio.CancelledError, Exception):
-                    pass
+                await reap_task(t, self._me, f"task {t.get_name()}")
         self._sched_task = None
         self._running.clear()
 
@@ -1486,7 +1478,18 @@ class JobService:
             with open(tmp, "w") as f:
                 json.dump(results, f)
             try:
-                await self.store.put(tmp, out_name)
+                # timeout scales with the cluster's RPC envelope
+                # (capped at the old fixed 60 s): a worker wedged
+                # publishing output under churn holds its batch
+                # un-ACKed (and the job un-finishable) far past an
+                # aggressive-timing cluster's whole recovery window
+                await self.store.put(
+                    tmp, out_name,
+                    timeout=min(
+                        60.0,
+                        4 * self.node.spec.timing.leader_rpc_timeout,
+                    ),
+                )
             except Exception as e:
                 # store unavailable (e.g. mid-failover): the ACK still
                 # carries the result timing; get-output will miss this

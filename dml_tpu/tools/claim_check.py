@@ -326,6 +326,61 @@ def run_metrics_check(artifact_path: Optional[str] = None) -> List[str]:
     return check_metrics_block(artifact_path or canonical_artifact_path())
 
 
+# ----------------------------------------------------------------------
+# chaos section (bench _bench_chaos / cluster/chaos.py)
+# ----------------------------------------------------------------------
+
+#: first round whose bench carries the chaos soak section; earlier
+#: artifacts predate the chaos engine and are exempt
+CHAOS_REQUIRED_FROM_ROUND = 7
+
+
+def check_chaos_block(path: str) -> List[str]:
+    """Validate a bench artifact's ``chaos`` section WHEN IT RAN
+    (neither wall-budget-skipped nor errored): the invariant sweeps
+    must all have passed, and the recovery walls — failover and
+    replication repair — must be present, finite, and nonzero. A
+    chaos section that 'ran' but recorded no recovery evidence means
+    the fault events never actually bit. Returns problems (empty =
+    OK)."""
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < CHAOS_REQUIRED_FROM_ROUND:
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "chaos" in not_run:
+        return []  # honestly recorded as skipped/errored
+    block = matrix.get("chaos")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `chaos` section and not recorded as "
+                "skipped (bench lost its chaos soak?)"]
+    problems = []
+    if not block.get("all_invariants_ok"):
+        bad = [s for s in block.get("per_seed", [])
+               if not s.get("invariants_ok")]
+        problems.append(
+            f"{name}: chaos invariant sweep failed for seeds "
+            f"{[s.get('seed') for s in bad]}"
+        )
+    for key in ("failover_recovery_s", "store_repair_s"):
+        v = block.get(key)
+        if not isinstance(v, (int, float)) or not math.isfinite(v) or v <= 0:
+            problems.append(
+                f"{name}: chaos.{key} = {v!r} (recovery wall missing, "
+                "nonfinite, or zero — the fault plan never bit)"
+            )
+    return problems
+
+
+def run_chaos_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_chaos_block(artifact_path or canonical_artifact_path())
+
+
 def main() -> None:
     art_path = canonical_artifact_path()
     print(f"artifact of record: {os.path.basename(art_path)}")
@@ -338,6 +393,9 @@ def main() -> None:
     for problem in run_metrics_check(art_path):
         total += 1
         print(f"metrics block: {problem}")
+    for problem in run_chaos_check(art_path):
+        total += 1
+        print(f"chaos block: {problem}")
     print(f"{total} violation(s)")
     raise SystemExit(1 if total else 0)
 

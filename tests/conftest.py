@@ -50,12 +50,19 @@ def pytest_pyfunc_call(pyfuncitem):
         kwargs = {
             k: pyfuncitem.funcargs[k] for k in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        # slow-marked scenarios (chaos soaks) get headroom: this
+        # sandbox's host can stall the whole process for minutes at a
+        # time, and a recovery soak must be allowed to ride that out
+        timeout = 300 if pyfuncitem.get_closest_marker("slow") else 120
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=timeout))
         return True
     return None
 
 
 def pytest_configure(config):
+    # mirrors pytest.ini's marker registry (the canonical copy) so
+    # running a test module outside the repo root stays warning-free
     config.addinivalue_line("markers", "asyncio: async test (run via asyncio.run)")
-    config.addinivalue_line("markers", "slow: heavyweight test (keras builds etc.)")
+    config.addinivalue_line("markers", "slow: heavyweight test (keras builds, chaos soaks etc.)")
+    config.addinivalue_line("markers", "chaos: fault-injection scenario driven by the chaos engine")
 

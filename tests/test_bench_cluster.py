@@ -28,6 +28,11 @@ def test_cluster_serving_bench_with_failure_injection():
     cs = out["cluster_serving"]
     assert cs["queries"] == 24
     assert cs["qps_end_to_end"] > 0
+    # VERDICT r5: the section's numbers carry their OWN link
+    # conditions, probed at section time (not the stale bring-up probe)
+    weather = cs["link_weather_at_section"]
+    assert weather["upload_mb_per_s"] > 0
+    assert weather["readback_128kb_ms"] >= 0
     bd = cs["breakdown"]
     assert bd["batches"] > 0
     assert bd["fetch_ms"] >= 0 and bd["infer_ms"] > 0
@@ -60,6 +65,55 @@ def test_cluster_serving_bench_with_failure_injection():
         assert fi["detect_to_requeue_s"] > 0
     # a raced kill records failure_injected=False honestly; the
     # completion assertion above is the load-bearing check either way
+
+
+def test_chaos_bench_section_and_claim_check(tmp_path):
+    """The bench `chaos` section machinery: one soak seed through the
+    chaos engine yields nonzero failover/repair walls and a green
+    invariant sweep, and the resulting artifact block passes
+    claim_check's chaos validation (while a gutted block fails it)."""
+    import json
+
+    from bench import _bench_chaos
+    from dml_tpu.tools import claim_check as cc
+
+    out = {}
+    _bench_chaos(out, seeds=(5,), base_port=28971)
+    ch = out["chaos"]
+    assert ch["all_invariants_ok"], ch["per_seed"]
+    assert ch["failover_recovery_s"] > 0
+    assert ch["store_repair_s"] > 0
+    assert ch["failover_samples"] >= 1 and ch["repair_samples"] >= 1
+    per = ch["per_seed"][0]
+    assert per["seed"] == 5 and per["invariants_ok"]
+    assert "done" in per["jobs"].values()
+
+    def artifact(tmpname, matrix):
+        path = str(tmp_path / f"{tmpname}.json")
+        with open(path, "w") as f:
+            json.dump({"matrix": matrix}, f)
+        return path
+
+    # the real block is accepted
+    assert cc.check_chaos_block(artifact("ok", {"chaos": ch})) == []
+    # a wall-budget skip is honestly exempt
+    assert cc.check_chaos_block(artifact("skip", {
+        "_skipped": {"chaos": "wall budget"}, "cluster_serving": {},
+    })) == []
+    # a chaos section that "ran" but lost its recovery evidence fails
+    gutted = dict(ch, failover_recovery_s=None)
+    problems = cc.check_chaos_block(artifact("gut", {"chaos": gutted}))
+    assert any("failover_recovery_s" in p for p in problems)
+    # a failed invariant sweep fails the artifact
+    red = dict(ch, all_invariants_ok=False,
+               per_seed=[dict(per, invariants_ok=False)])
+    problems = cc.check_chaos_block(artifact("red", {"chaos": red}))
+    assert any("invariant sweep failed" in p for p in problems)
+    # dropping the section without recording a skip fails
+    problems = cc.check_chaos_block(
+        artifact("lost", {"cluster_serving": {}})
+    )
+    assert any("no `chaos` section" in p for p in problems)
 
 
 def test_nowait_window_bound():

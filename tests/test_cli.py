@@ -22,6 +22,35 @@ def test_localspec_roundtrip(capsys):
     assert spec.introducer is not None
 
 
+def test_chaos_verb_dry_run_and_plan_replay(tmp_path, capsys):
+    """`chaos run --dry-run` prints the seeded schedule and `--dump`
+    writes a plan a later `--plan` invocation parses back — the
+    save/diff/replay loop that makes a chaos schedule a shareable
+    artifact."""
+    import pytest
+
+    from dml_tpu.cluster.chaos import ChaosPlan
+
+    def run_ok(argv):
+        with pytest.raises(SystemExit) as e:
+            main(argv)
+        assert e.value.code == 0
+        return capsys.readouterr().out
+
+    dump = tmp_path / "plan.json"
+    out = run_ok(["chaos", "run", "--seed", "9", "--soak", "--dry-run",
+                  "--dump", str(dump)])
+    assert "crash @leader" in out and "seed=9" in out
+    plan = ChaosPlan.from_dict(json.loads(dump.read_text()))
+    assert plan.seed == 9 and any(e.kind == "heal" for e in plan.events)
+    # replaying the dumped plan dry prints the identical schedule
+    out2 = run_ok(["chaos", "run", "--plan", str(dump), "--dry-run"])
+    assert out.split("plan written")[0] == out2
+    with pytest.raises(SystemExit) as e:
+        main(["chaos", "bogus-verb"])
+    assert e.value.code != 0
+
+
 async def test_nodeapp_commands(tmp_path, capsys):
     from dml_tpu.cluster.introducer import IntroducerService
 
