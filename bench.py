@@ -62,7 +62,7 @@ SECTION_EST_S = {
     "cluster_serving": 150.0,
     "lm": 450.0,
     "cluster_lm_serving": 150.0,
-    "chaos": 120.0,
+    "chaos": 180.0,  # 2 soak seeds + 5 adversarial scenario families
     "train": 500.0,
     "pallas_on_device": 200.0,
     "ring_vs_ulysses": 60.0,
@@ -426,89 +426,76 @@ def _probe_tunnel():
     }
 
 
-def _cluster_stack(tmp, base_port, make_jobs):
-    """Shared bring-up/teardown for the cluster bench sections: a
-    fresh 4-node localhost cluster (introducer + UDP control plane +
-    SDFS stores), converged, as an async context manager yielding
-    `stack` = [(node, store, jobs), ...]. `make_jobs(node, store)`
-    builds each node's JobService. Teardown runs even when a mid-loop
-    start() fails (stale port), so partially-started services never
-    leak."""
-    import asyncio
+def _cluster_stack(tmp, base_port, make_jobs, n_nodes=4):
+    """Shared bring-up/teardown for the cluster bench sections, now
+    assembled via ``chaos.LocalCluster`` — the SAME cluster chassis
+    the chaos soaks validate, so every bench number is produced by an
+    assembly whose failure behavior is invariant-checked elsewhere
+    (previously this was a second, parallel bring-up harness that
+    could drift). Yields ``(cluster, stack)`` where ``stack`` =
+    [(node, store, jobs), ...] sorted by node name; crash a member
+    mid-section with ``cluster.crash_node(uname)``."""
     import contextlib
     import shutil
 
-    from dml_tpu.cluster.introducer import IntroducerService
-    from dml_tpu.cluster.node import Node
-    from dml_tpu.cluster.store_service import StoreService
-    from dml_tpu.config import ClusterSpec, StoreConfig, Timing
+    from dml_tpu.cluster.chaos import LocalCluster
+    from dml_tpu.config import Timing
 
     @contextlib.asynccontextmanager
     async def ctx():
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp, exist_ok=True)
-        spec = ClusterSpec.localhost(
-            4, base_port=base_port, introducer_port=base_port - 1,
+        cluster = LocalCluster(
+            n_nodes, tmp, base_port,
             timing=Timing(ping_interval=0.2, ack_timeout=0.3,
                           cleanup_time=1.0, leader_rpc_timeout=10.0),
-            store=StoreConfig(root=os.path.join(tmp, "roots"),
-                              download_dir=os.path.join(tmp, "dl")),
+            make_jobs=make_jobs,
         )
-        dns = IntroducerService(spec)
-        await dns.start()
-        # each service registers in `started` the moment its start()
-        # returns, so teardown reaps exactly what came up even when a
-        # later start() in the same node's tuple fails (stale port)
-        started = []
-        stack = []
         try:
-            for n in spec.nodes:
-                node = Node(spec, n)
-                store = StoreService(
-                    node, root=os.path.join(tmp, f"st_{n.port}")
-                )
-                jobs = make_jobs(node, store)
-                await node.start()
-                started.append(node)
-                await store.start()
-                started.append(store)
-                await jobs.start()
-                started.append(jobs)
-                stack.append((node, store, jobs))
-            for _ in range(100):
-                if all(n.joined and n.leader_unique for n, _, _ in stack):
-                    break
-                await asyncio.sleep(0.1)
-            else:
-                raise RuntimeError(
-                    f"bench cluster failed to converge in 10s (stale "
-                    f"process on ports {base_port - 1}-{base_port + 3}?)"
-                )
-            yield stack
+            await cluster.start()
+            await cluster.wait_for(
+                cluster.converged, 20.0,
+                f"bench cluster convergence (stale process on ports "
+                f"{base_port - 1}-{base_port + n_nodes - 1}?)",
+            )
+            stack = [
+                (sn.node, sn.store, sn.jobs)
+                for _, sn in sorted(cluster.nodes.items())
+            ]
+            yield cluster, stack
         finally:
-            for svc in reversed(started):
-                await svc.stop()
-            await dns.stop()
+            await cluster.stop()
 
     return ctx()
 
 
-def _bench_chaos(out, *, seeds=(1, 2), base_port=28861):
+def _bench_chaos(out, *, seeds=(1, 2), scenario_seeds=(1,),
+                 base_port=28861):
     """Deterministic chaos soak (cluster/chaos.py): per seed, the
     canonical recovery composition — leader killed mid-put and
     mid-job, a partition that heals, 2% loss, duplicate delivery —
-    with the invariant sweep at the end. Records failover-recovery
-    and replication-repair walls; claim_check validates they are
-    present and finite. CPU-only (stub inference backend): the
-    control plane's recovery story is what's under test."""
+    with the invariant sweep at the end, PLUS one sweep per
+    adversarial scenario family (asymmetric partition, disk
+    full/corruption, introducer-DNS outage mid-failover, clock skew,
+    byzantine datagram fuzz). Records failover-recovery and
+    replication-repair walls and per-family green/red; claim_check
+    validates the walls are finite, every family swept green, and the
+    fuzz run left a nonzero malformed-drop counter. CPU-only (stub
+    inference backend): the control plane's survival story is what's
+    under test."""
     import statistics
 
-    from dml_tpu.cluster.chaos import run_plan_sync, soak_plan
+    from dml_tpu.cluster.chaos import (
+        SCENARIO_FAMILIES, run_plan_sync, scenario_plan, soak_plan,
+    )
+    from dml_tpu.observability import METRICS
 
     per_seed = []
     failover, repair = [], []
-    for i, seed in enumerate(seeds):
-        rep = run_plan_sync(soak_plan(seed), base_port=base_port + 20 * i)
+    port = base_port
+    for seed in seeds:
+        rep = run_plan_sync(soak_plan(seed), base_port=port)
+        port += 20
         per_seed.append({
             "seed": seed,
             "invariants_ok": rep.ok,
@@ -523,11 +510,33 @@ def _bench_chaos(out, *, seeds=(1, 2), base_port=28861):
         })
         failover += rep.failover_recovery_s
         repair += rep.store_repair_s
+    scenarios = {}
+    for fam in SCENARIO_FAMILIES:
+        fam_runs = []
+        for seed in scenario_seeds:
+            rep = run_plan_sync(scenario_plan(fam, seed), base_port=port)
+            port += 20
+            fam_runs.append({
+                "seed": seed,
+                "invariants_ok": rep.ok,
+                "invariant_failures": rep.invariants.failures,
+                "wall_s": round(rep.wall_s, 1),
+            })
+        scenarios[fam] = {
+            "seeds": list(scenario_seeds),
+            "all_invariants_ok": all(r["invariants_ok"] for r in fam_runs),
+            "per_seed": fam_runs,
+        }
+    malformed = METRICS.snapshot()["counters"].get(
+        "transport_malformed_dropped_total", 0.0
+    )
     out["chaos"] = {
         "plan": "soak (leader-kill-mid-put/job + partition heal + "
-                "2% loss + duplicate delivery)",
+                "2% loss + duplicate delivery) + per-family "
+                "adversarial scenarios",
         "seeds": list(seeds),
-        "all_invariants_ok": all(s["invariants_ok"] for s in per_seed),
+        "all_invariants_ok": all(s["invariants_ok"] for s in per_seed)
+        and all(s["all_invariants_ok"] for s in scenarios.values()),
         "failover_recovery_s": (
             round(statistics.median(failover), 3) if failover else None
         ),
@@ -537,6 +546,8 @@ def _bench_chaos(out, *, seeds=(1, 2), base_port=28861):
         "failover_samples": len(failover),
         "repair_samples": len(repair),
         "per_seed": per_seed,
+        "scenarios": scenarios,
+        "malformed_dropped_total": int(malformed),
         "note": "medians over every observed recovery; timing envelope "
                 "is the FAST sim profile (ping 50ms, cleanup 300ms), "
                 "so walls measure protocol rounds, not deployed "
@@ -577,7 +588,7 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
             # in-flight inference at pipeline depth 2
             return JobService(node, store, engine=engine)
 
-        async with _cluster_stack(tmp, base_port, make_jobs) as stack:
+        async with _cluster_stack(tmp, base_port, make_jobs) as (cluster, stack):
             srcs = sorted(glob.glob("/root/reference/testfiles_more/*.jpeg"))[:32]
             client_store, client_jobs = stack[-1][1], stack[-1][2]
             if srcs:
@@ -729,9 +740,9 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
                     break
                 await asyncio.sleep(0.01)
             t_kill = time.monotonic()
-            await victim[0].stop()
-            await victim[2].stop()
-            await victim[1].stop()
+            # abrupt kill through the shared chassis (transport closed,
+            # no goodbye) — the same crash path the chaos engine uses
+            await cluster.crash_node(victim_name)
             # detection latency: kill -> first requeue of its batch.
             # Bounded at 20 s (cleanup_time is 1 s; detection lands in
             # ~2 s) and exits early if the job finishes — a kill that
@@ -812,7 +823,7 @@ def _bench_cluster_lm(out, *, n_prompts=64, new_tokens=32, base_port=28821,
             return jobs
 
         try:
-            async with _cluster_stack(tmp, base_port, make_jobs) as stack:
+            async with _cluster_stack(tmp, base_port, make_jobs) as (_, stack):
                 client_store, client_jobs = stack[-1][1], stack[-1][2]
                 rng = np.random.RandomState(0)
                 for i in range(n_prompts):
@@ -1851,6 +1862,12 @@ def main() -> None:
         "chaos_ok": g("chaos", "all_invariants_ok"),
         "chaos_failover_s": g("chaos", "failover_recovery_s"),
         "chaos_repair_s": g("chaos", "store_repair_s"),
+        "chaos_scenarios_ok": {
+            fam: v.get("all_invariants_ok")
+            for fam, v in g("chaos", "scenarios", default={}).items()
+            if isinstance(v, dict)
+        },
+        "chaos_malformed_dropped": g("chaos", "malformed_dropped_total"),
         "c4_qps": g("dual_model_c4", "combined_qps_auto"),
         "c4_mode": g("dual_model_c4", "dispatch_mode_auto"),
         "pipelining": g("dual_model_c4", "pipelining_speedup"),

@@ -12,6 +12,7 @@ Run:
     python -m dml_tpu introducer --spec /tmp/cluster.json
     python -m dml_tpu node --spec /tmp/cluster.json --name H1
     python -m dml_tpu chaos run --seed 7 --soak   # seeded fault plan
+    python -m dml_tpu chaos run --seed 1 --scenario fuzz  # one family
 """
 
 from __future__ import annotations
@@ -383,6 +384,10 @@ async def _run_chaos(args) -> int:
     if args.plan:
         with open(args.plan) as f:
             plan = chaos.ChaosPlan.from_dict(json.load(f))
+    elif args.scenario:
+        plan = chaos.scenario_plan(
+            args.scenario, args.seed, n_nodes=args.nodes
+        )
     elif args.soak:
         plan = chaos.soak_plan(args.seed, n_nodes=args.nodes)
     else:
@@ -459,6 +464,13 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="use the canonical soak composition "
                          "(leader-kill-mid-put/job + partition heal + "
                          "2%% loss + duplicate delivery)")
+    pc.add_argument("--scenario", default=None,
+                    choices=["asym", "disk", "dns", "skew", "fuzz"],
+                    help="run one adversarial scenario family: "
+                         "asym(metric partition), disk(-full + "
+                         "corruption), dns (introducer outage during "
+                         "failover), (clock) skew, fuzz (byzantine "
+                         "datagrams)")
     pc.add_argument("--plan", default=None, metavar="FILE",
                     help="replay a saved plan JSON instead of generating")
     pc.add_argument("--dump", default=None, metavar="FILE",

@@ -45,11 +45,11 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import errno
 import logging
 import os
 import random
 import shutil
+import socket
 import zlib
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
@@ -59,8 +59,11 @@ from ..observability import METRICS
 from .introducer import IntroducerService
 from .node import Node
 from .store.data_plane import TunnelFault
-from .store_service import StoreService
+from .store.local_store import DiskFault
+from .store_service import StoreService, data_addr
+from .util import rebind_retry
 from .transport import LinkShaper
+from .wire import _HEADER, Message, MsgType
 
 log = logging.getLogger(__name__)
 
@@ -100,27 +103,62 @@ def _child_seed(seed: int, tag: str) -> int:
 # ----------------------------------------------------------------------
 
 #: event kinds the runner understands (args they consume):
-#: crash       target=name|"leader"|"standby"|"worker"; args.mid =
-#:             ["put", "job"] launches that workload just before the
-#:             kill so it is genuinely in flight when the node dies
-#: restart     target=name|"last" (the most recent crash victim):
-#:             same identity, same store root, rejoin via introducer
-#: partition   args.fraction (0..1): split the live nodes into
-#:             minority/majority by sorted name, bidirectional drop
-#: heal        clear every partition filter
-#: loss        args.pct: swap every node's loss injector to pct
-#: shape       args.{delay_s,jitter_s,dup_pct,reorder_pct,
-#:             reorder_extra_s}: install a LinkShaper per node
-#:             (all-zero clears shaping)
-#: store_fault args.{delay_s,fail_pct}: install a TunnelFault per
-#:             node's data plane
-#: store_heal  clear every tunnel fault
-#: put         args.{name,size}: replicated put of seeded bytes
-#: job         args.{n}: submit + await a stub-backend job
+#: crash        target=name|"leader"|"standby"|"worker"; args.mid =
+#:              ["put", "job"] launches that workload just before the
+#:              kill so it is genuinely in flight when the node dies
+#: restart      target=name|"last" (the most recent crash victim):
+#:              same identity, same store root, rejoin via introducer
+#: partition    args.fraction (0..1): split the live nodes into
+#:              minority/majority by sorted name, bidirectional drop
+#:              (installed on BOTH the outbound and inbound filters)
+#: partition_asym  args.fraction: same split, but ONE-WAY — the
+#:              minority's datagrams to the majority are lost while
+#:              the majority's still arrive (A hears B, B doesn't
+#:              hear A); installed on both directional seams
+#: heal         clear every partition filter (both directions)
+#: loss         args.pct: swap every node's loss injector to pct
+#: shape        args.{delay_s,jitter_s,dup_pct,reorder_pct,
+#:              reorder_extra_s}: install a LinkShaper per node
+#:              (all-zero clears shaping)
+#: store_fault  args.{delay_s,fail_pct}: install a TunnelFault per
+#:              node's data plane
+#: store_heal   clear every tunnel fault
+#: disk_fault   target node; args.{write_fail_pct,corrupt_pct}:
+#:              install a DiskFault on that node's LocalStore
+#:              (failing writes = disk full; corrupted reads)
+#: disk_heal    clear every disk fault
+#: disk_corrupt args.name: flip a byte of one live replica's on-disk
+#:              copy of that file (bypassing the checksum sidecar) —
+#:              detection happens on the next read of that replica
+#: dns_crash    kill the introducer DNS (transport closed, serve
+#:              loop dead)
+#: dns_restart  bring the DNS back with STATE LOSS: it remembers only
+#:              its static default (often a dead ex-leader) and the
+#:              live leader's re-register loop must overwrite it
+#: skew         target node; args.offset_s: skew that node's SWIM
+#:              clock by offset_s seconds (0 clears)
+#: fuzz         args.n: inject n seeded byzantine datagrams at every
+#:              live node's transport — truncated / bit-flipped /
+#:              length-lying / oversized / replayed-header frames
+#:              (all must die in Message.unpack, counted) plus
+#:              well-formed frames with adversarial content (forged
+#:              senders, junk payloads — no coroutine may die)
+#: put          args.{name,size}: replicated put of seeded bytes
+#: get          args.{name,scrub}: client GET, verified against the
+#:              seeded content; scrub=True additionally reads EVERY
+#:              live replica directly, so a silently-corrupted copy
+#:              is forced through detection
+#: job          args.{n}: submit + await a stub-backend job
 EVENT_KINDS = (
-    "crash", "restart", "partition", "heal", "loss", "shape",
-    "store_fault", "store_heal", "put", "job",
+    "crash", "restart", "partition", "partition_asym", "heal", "loss",
+    "shape", "store_fault", "store_heal", "disk_fault", "disk_heal",
+    "disk_corrupt", "dns_crash", "dns_restart", "skew", "fuzz",
+    "put", "get", "job",
 )
+
+#: the adversarial scenario families `scenario_plan` generates and the
+#: bench chaos section + claim_check validate per-family
+SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz")
 
 
 @dataclass(frozen=True)
@@ -214,6 +252,191 @@ class ChaosPlan:
         return "\n".join(lines)
 
 
+def fuzz_datagrams(
+    seed: int, n: int, senders: Tuple[str, ...] = ()
+) -> Tuple[List[bytes], List[bytes]]:
+    """Seeded byzantine-wire generator: ``(malformed, byzantine)``.
+
+    ``malformed`` frames are GUARANTEED to die in ``Message.unpack``
+    (each construction breaks an invariant unpack checks), so the
+    caller can assert the malformed-drop counter moved by at least
+    their count. ``byzantine`` frames parse fine but carry adversarial
+    content — forged senders, junk field types, missing keys, deep
+    nesting — and must be survivable: handlers may log and drop, but
+    no dispatcher coroutine may die.
+    """
+    rng = random.Random(seed)
+    base = Message(
+        "127.0.0.1:65001", MsgType.PING, {"members": {}, "leader": None}
+    ).pack()
+    header = _HEADER  # the real wire header: the malformed-frame
+    # constructions below must break the CURRENT format, not a copy
+
+    def forged(mtype: MsgType, data: Dict[str, Any]) -> bytes:
+        sender = rng.choice(senders) if senders else "6.6.6.6:666"
+        return Message(sender, mtype, data).pack()
+
+    malformed: List[bytes] = []
+    byzantine: List[bytes] = []
+    for _ in range(n):
+        kind = rng.choice((
+            "trunc", "magic", "len_lie", "garbage", "oversize", "replay",
+            "byz_forged", "byz_junk_fields", "byz_missing", "byz_nested",
+        ))
+        if kind == "trunc":
+            malformed.append(base[: rng.randrange(1, len(base))])
+        elif kind == "magic":
+            b = bytearray(base)
+            b[0] ^= 1 << rng.randrange(8)  # high magic byte: unpack rejects
+            malformed.append(bytes(b))
+        elif kind == "len_lie":
+            magic_ver, mtype, slen, plen = header.unpack_from(base)
+            lie = header.pack(magic_ver, mtype, slen, plen + rng.randrange(1, 99))
+            malformed.append(lie + base[header.size:])
+        elif kind == "garbage":
+            # leading zero bytes can never match the magic, so random
+            # tails stay guaranteed-malformed
+            malformed.append(
+                b"\x00\x00" + bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 120)))
+            )
+        elif kind == "oversize":
+            # past wire.MAX_DATAGRAM, internally consistent header,
+            # non-UTF-8 payload: decode fails, frame dropped
+            plen = 60_500
+            magic_ver, mtype, slen, _ = header.unpack_from(base)
+            sender_b = base[header.size: header.size + slen]
+            malformed.append(
+                header.pack(magic_ver, mtype, slen, plen) + sender_b + b"\xff" * plen
+            )
+        elif kind == "replay":
+            # replayed header, garbled body: the original (valid)
+            # header glued onto a non-JSON payload of the right length
+            magic_ver, mtype, slen, plen = header.unpack_from(base)
+            sender_b = base[header.size: header.size + slen]
+            malformed.append(
+                header.pack(magic_ver, mtype, slen, plen) + sender_b + b"\xfe" * plen
+            )
+        elif kind == "byz_forged":
+            # parses, but the sender is outside the static universe:
+            # COORDINATE must not crown it, PING must not adopt its
+            # leader claim
+            byzantine.append(Message(
+                "6.6.6.6:666",
+                rng.choice((MsgType.COORDINATE, MsgType.PING, MsgType.ACK)),
+                {"leader": "6.6.6.6:666", "members": {"6.6.6.6:666": [9e18, 1]}},
+            ).pack())
+        elif kind == "byz_junk_fields":
+            byzantine.append(forged(MsgType.PING, {
+                "members": {s: "not-a-pair" for s in senders[:2]},
+                "leader": rng.random(),
+            }))
+        elif kind == "byz_missing":
+            byzantine.append(forged(rng.choice((
+                MsgType.PUT_REQUEST, MsgType.GET_FILE_REQUEST,
+                MsgType.SUBMIT_JOB_REQUEST, MsgType.DOWNLOAD_FILE,
+            )), {}))
+        else:  # byz_nested
+            nested: Any = rng.random()
+            for _ in range(40):
+                nested = {"d": nested}
+            byzantine.append(forged(MsgType.JOB_STATUS_REQUEST, {"rid": nested}))
+    return malformed, byzantine
+
+
+def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
+    """One focused plan per adversarial scenario family (the chaos-
+    coverage gaps ROADMAP listed after PR 2):
+
+    - ``asym``: one-way partition — the minority's datagrams to the
+      majority vanish while the reverse direction still delivers;
+      SWIM must converge on one leader without flapping, then fully
+      re-merge after the heal.
+    - ``disk``: a replica's disk fills (all writes fail) during a PUT
+      — the leader must re-place the failed slot, not fail the PUT —
+      then a stored replica is bit-flipped on disk and a scrubbed GET
+      must detect the mismatch, quarantine, and re-repair to factor.
+    - ``dns``: the introducer DNS dies, the leader is killed mid-put
+      and mid-job DURING the outage, and the DNS returns with stale
+      state — clients ride the window via leader_retry and the new
+      leader must re-register once it is back.
+    - ``skew``: one node's SWIM clock runs seconds ahead, another's
+      behind; neither may be falsely evicted — and when the skewed-
+      ahead node is killed, its future-dated gossip must not mask the
+      real failure (merge clamps future timestamps).
+    - ``fuzz``: bursts of seeded byzantine datagrams at every live
+      transport; every malformed frame dies in Message.unpack
+      (counted by transport_malformed_dropped_total), no coroutine
+      dies, and the cluster keeps serving.
+
+    Timings are seed-jittered: one seed reproduces one schedule,
+    different seeds explore different interleavings.
+    """
+    if family not in SCENARIO_FAMILIES:
+        raise ValueError(f"unknown scenario family {family!r} "
+                         f"(choose from {SCENARIO_FAMILIES})")
+    rng = random.Random(_child_seed(seed, f"scenario/{family}"))
+    j = lambda a, b: round(rng.uniform(a, b), 3)  # noqa: E731
+    seed_file = f"{family}_seed.bin"
+    events = [
+        event(j(0.1, 0.3), "put", name=seed_file, size=1024),
+        event(j(0.4, 0.6), "job", n=16),
+    ]
+    if family == "asym":
+        events += [
+            event(j(1.0, 1.3), "partition_asym",
+                  fraction=round(rng.uniform(0.25, 0.45), 2)),
+            event(j(2.0, 2.3), "job", n=12),
+            event(j(4.0, 4.5), "heal"),
+            event(j(5.2, 5.6), "job", n=12),
+        ]
+    elif family == "disk":
+        events += [
+            # two full disks: any replication_factor(4)-of-5 placement
+            # must hit at least one, so the PUT-reassignment path is
+            # exercised on every seed, not just placements that happen
+            # to include the victim
+            event(j(1.0, 1.1), "disk_fault", "worker", write_fail_pct=100.0),
+            event(j(1.15, 1.25), "disk_fault", "standby",
+                  write_fail_pct=100.0),
+            event(j(1.5, 1.7), "put", name="disk_fault_put.bin", size=2048),
+            event(j(2.6, 2.9), "disk_heal"),
+            event(j(3.2, 3.4), "disk_corrupt", name=seed_file),
+            event(j(3.6, 3.8), "get", name=seed_file, scrub=True),
+            event(j(4.8, 5.2), "job", n=12),
+        ]
+    elif family == "dns":
+        events += [
+            event(j(1.0, 1.2), "dns_crash"),
+            event(j(1.5, 1.8), "crash", "leader", mid=("put", "job")),
+            event(j(4.0, 4.4), "dns_restart"),
+            event(j(5.4, 5.8), "restart", "last"),
+            event(j(6.4, 6.8), "job", n=12),
+        ]
+    elif family == "skew":
+        events += [
+            event(j(0.7, 0.9), "skew", "worker",
+                  offset_s=round(rng.uniform(2.0, 5.0), 2)),
+            event(j(1.0, 1.2), "skew", "standby",
+                  offset_s=-round(rng.uniform(2.0, 5.0), 2)),
+            event(j(1.8, 2.2), "job", n=12),
+            # the skewed-AHEAD node dies: its future-dated gossip must
+            # not keep it looking alive (clamped at merge)
+            event(j(2.8, 3.2), "crash", "skewed"),
+            event(j(5.2, 5.6), "restart", "last"),
+            event(j(6.0, 6.4), "job", n=12),
+        ]
+    else:  # fuzz
+        events += [
+            event(j(1.0, 1.2), "fuzz", n=36),
+            event(j(1.6, 2.0), "job", n=12),
+            event(j(2.4, 2.7), "fuzz", n=36),
+            event(j(3.2, 3.5), "put", name="post_fuzz.bin", size=512),
+            event(j(4.0, 4.4), "job", n=12),
+        ]
+    return ChaosPlan(seed=seed, events=tuple(events), n_nodes=n_nodes,
+                     settle_s=1.5, name=f"{family}-{seed}")
+
+
 def soak_plan(seed: int, n_nodes: int = 5) -> ChaosPlan:
     """The canonical recovery composition the acceptance criteria
     name: duplicate delivery + 2% loss from the start, the leader
@@ -241,8 +464,13 @@ def soak_plan(seed: int, n_nodes: int = 5) -> ChaosPlan:
         # post-restart traffic proves the rejoined cluster serves
         event(j(9.0, 9.5), "job", n=16),
     ]
-    # one seeded extra disturbance mid-run
-    extra = rng.choice(("worker_crash", "store_fault", "loss_ramp"))
+    # one seeded extra disturbance mid-run — the menu spans every
+    # scenario family, so soak seeds collectively compose the
+    # adversarial faults with the canonical leader-kill recovery
+    extra = rng.choice((
+        "worker_crash", "store_fault", "loss_ramp", "asym_partition",
+        "dns_blip", "clock_skew", "fuzz_burst", "disk_corruption",
+    ))
     if extra == "worker_crash":
         t = j(4.0, 4.6)
         events += [event(t, "crash", "worker"),
@@ -251,10 +479,39 @@ def soak_plan(seed: int, n_nodes: int = 5) -> ChaosPlan:
         t = j(3.0, 3.6)
         events += [event(t, "store_fault", delay_s=0.02, fail_pct=10.0),
                    event(t + j(2.0, 2.5), "store_heal")]
-    else:
+    elif extra == "loss_ramp":
         t = j(3.0, 3.6)
         events += [event(t, "loss", pct=5.0),
                    event(t + j(1.5, 2.0), "loss", pct=2.0)]
+    elif extra == "asym_partition":
+        # after the symmetric split healed: a one-way partition that
+        # may still be live when the ex-leader restarts into it (the
+        # directional restart-placement edge)
+        t = j(6.8, 7.0)
+        events += [event(t, "partition_asym",
+                         fraction=round(rng.uniform(0.25, 0.45), 2)),
+                   event(t + j(1.4, 1.8), "heal")]
+    elif extra == "dns_blip":
+        t = j(3.0, 3.4)
+        events += [event(t, "dns_crash"),
+                   event(t + j(1.5, 2.0), "dns_restart")]
+    elif extra == "clock_skew":
+        # the heal targets "skewed" (the node actually carrying the
+        # offset), not a re-resolved role: the leader kill + partition
+        # between the two events can move who "worker" resolves to,
+        # and clearing a different node would silently leave the skew
+        # in place for the rest of the run
+        t = j(2.0, 2.4)
+        events += [event(t, "skew", "worker",
+                         offset_s=round(rng.uniform(2.0, 4.0), 2)),
+                   event(j(7.0, 7.5), "skew", "skewed", offset_s=0.0)]
+    elif extra == "fuzz_burst":
+        events += [event(j(2.0, 2.6), "fuzz", n=30),
+                   event(j(6.8, 7.4), "fuzz", n=30)]
+    else:  # disk_corruption
+        t = j(6.8, 7.2)
+        events += [event(t, "disk_corrupt", name="soak_seeded.bin"),
+                   event(t + 0.4, "get", name="soak_seeded.bin", scrub=True)]
     return ChaosPlan(seed=seed, events=tuple(events), n_nodes=n_nodes,
                      settle_s=1.5, name=f"soak-{seed}")
 
@@ -275,7 +532,7 @@ def random_plan(seed: int, n_nodes: int = 5, n_disturbances: int = 4,
         t = round(rng.uniform(0.8, duration * 0.7), 3)
         pick = rng.choice(
             ("crash_leader", "crash_worker", "partition", "loss",
-             "shape", "store_fault")
+             "shape", "store_fault", "partition_asym", "skew", "fuzz")
         )
         if pick == "crash_leader":
             events.append(event(t, "crash", "leader",
@@ -288,6 +545,15 @@ def random_plan(seed: int, n_nodes: int = 5, n_disturbances: int = 4,
             events.append(event(t, "partition",
                                 fraction=round(rng.uniform(0.25, 0.45), 2)))
             events.append(event(t + round(rng.uniform(1.5, 2.5), 3), "heal"))
+        elif pick == "partition_asym":
+            events.append(event(t, "partition_asym",
+                                fraction=round(rng.uniform(0.25, 0.45), 2)))
+            events.append(event(t + round(rng.uniform(1.5, 2.5), 3), "heal"))
+        elif pick == "skew":
+            events.append(event(t, "skew", "worker",
+                                offset_s=round(rng.uniform(-4.0, 4.0), 2)))
+        elif pick == "fuzz":
+            events.append(event(t, "fuzz", n=24))
         elif pick == "loss":
             events.append(event(t, "loss",
                                 pct=round(rng.uniform(1.0, 5.0), 2)))
@@ -381,10 +647,19 @@ class LocalCluster:
         self.expect_files: set = set()
         # current fault state, re-applied to restarted nodes so a
         # node that returns mid-scenario lives in the same weather
-        self._partition_groups: Optional[List[List[str]]] = None
+        #: active partition: {"groups": [[uname]], "asym": bool}.
+        #: asym means ONE direction is dead — group 0's datagrams to
+        #: group 1 are dropped (at both the sender's outbound filter
+        #: and the receiver's inbound filter) while group 1 -> group 0
+        #: still delivers.
+        self._partition: Optional[Dict[str, Any]] = None
         self._loss_pct: float = 0.0
         self._shape_args: Optional[Dict[str, float]] = None
         self._store_fault_args: Optional[Dict[str, float]] = None
+        #: uname -> installed DiskFault kwargs (restart re-applies)
+        self._disk_faults: Dict[str, Dict[str, float]] = {}
+        #: uname -> SWIM clock offset seconds (restart re-applies)
+        self._skews: Dict[str, float] = {}
         self._restart_counter = 0
 
     def _default_jobs(self, node: Node, store: StoreService):
@@ -439,21 +714,15 @@ class LocalCluster:
     async def restart_node(self, uname: str) -> SimNode:
         """Restart with the SAME identity (host:port): rebind the UDP
         socket and rejoin through the introducer path, like a
-        supervised process coming back after a crash. The rebind is
-        retried briefly — the previous incarnation's socket can take
-        a few loop iterations to fully release the port."""
+        supervised process coming back after a crash. The rebind
+        rides the shared retry (util.rebind_retry) — the previous
+        incarnation's socket can take a few loop iterations to fully
+        release the port."""
         nid = self.spec.node_by_unique_name(uname)
         if nid is None:
             raise ValueError(f"unknown node {uname}")
         self._restart_counter += 1
-        for attempt in range(10):
-            try:
-                return await self.start_node(nid)
-            except OSError as e:
-                if e.errno != errno.EADDRINUSE or attempt == 9:
-                    raise
-                await asyncio.sleep(0.2)
-        raise AssertionError("unreachable")
+        return await rebind_retry(lambda: self.start_node(nid))
 
     async def stop(self) -> None:
         for uname in list(self.nodes):
@@ -480,13 +749,26 @@ class LocalCluster:
                 seed=_child_seed(self.seed, f"tunnel/{uname}"),
                 **self._store_fault_args,
             )
-        if self._partition_groups is not None:
+        if uname in self._disk_faults:
+            sn.store.store.fault = DiskFault(
+                seed=_child_seed(
+                    self.seed, f"disk/{uname}/{self._restart_counter}"),
+                **self._disk_faults[uname],
+            )
+        if uname in self._skews:
+            sn.node.membership.clock_offset = self._skews[uname]
+        if self._partition is not None:
             # a node restarting into an active partition must land on
-            # ONE side, not silently bridge both: assign it to the
-            # majority group (deterministic) before re-installing
-            if not any(uname in g for g in self._partition_groups):
-                max(self._partition_groups, key=len).append(uname)
-            self._install_partition(self._partition_groups)
+            # ONE side, not silently bridge both — on BOTH directional
+            # seams. Deterministic placement: the hearing side for an
+            # asymmetric split (group 1), the majority otherwise.
+            groups = self._partition["groups"]
+            if not any(uname in g for g in groups):
+                if self._partition["asym"]:
+                    groups[-1].append(uname)
+                else:
+                    max(groups, key=len).append(uname)
+            self._install_partition()
 
     def set_loss(self, pct: float) -> None:
         self._loss_pct = pct
@@ -520,34 +802,125 @@ class LocalCluster:
                 else None
             )
 
+    def set_disk_fault(self, uname: Optional[str], **kw: float) -> None:
+        """Install a DiskFault on one node's LocalStore (uname=None or
+        empty kwargs clears every disk fault)."""
+        kw = {k: v for k, v in kw.items() if v}
+        if uname is None or not kw:
+            self._disk_faults.clear()
+            for sn in self.nodes.values():
+                sn.store.store.fault = None
+            return
+        self._disk_faults[uname] = kw
+        sn = self.nodes.get(uname)
+        if sn is not None:
+            sn.store.store.fault = DiskFault(
+                seed=_child_seed(
+                    self.seed, f"disk/{uname}/{self._restart_counter}"),
+                **kw,
+            )
+
+    def set_skew(self, uname: str, offset_s: float) -> None:
+        """Skew one node's SWIM clock (0 clears). Survives restarts —
+        a rebooted machine's clock is just as wrong."""
+        if offset_s:
+            self._skews[uname] = float(offset_s)
+        else:
+            self._skews.pop(uname, None)
+        sn = self.nodes.get(uname)
+        if sn is not None:
+            sn.node.membership.clock_offset = float(offset_s)
+
+    def corrupt_replica(self, name: str) -> Optional[str]:
+        """Flip a byte of ONE live replica's newest on-disk copy of
+        `name`, bypassing the checksum sidecar — bit rot, as the
+        platter would deliver it. Returns the victim uname (None if
+        nobody holds the file). Detection happens on the next read of
+        that replica (a scrubbed GET guarantees one)."""
+        for uname in sorted(self.nodes):
+            st = self.nodes[uname].store.store
+            if st.has(name):
+                path = st.get_path(name)
+                with open(path, "r+b") as f:
+                    first = f.read(1)
+                    f.seek(0)
+                    f.write(bytes([(first[0] if first else 0) ^ 0xFF]))
+                return uname
+        return None
+
+    async def crash_dns(self) -> None:
+        """Kill the introducer DNS mid-flight: joiners and leader
+        updates get silence until it returns."""
+        await self.dns.stop()
+
+    async def restart_dns(self) -> None:
+        """The DNS comes back with STATE LOSS: a fresh process knows
+        only its static default introducer (the full-table election
+        winner — after a failover, typically the dead ex-leader). The
+        live leader's re-register loop must overwrite it; until then
+        the stale answer is exactly what a real recovering nameserver
+        would serve."""
+        self.dns = IntroducerService(self.spec)
+        await self.dns.start()
+
     def partition(self, groups: List[List[str]]) -> None:
         """Bidirectional control-plane partition between groups (the
         introducer stays reachable — it is a rendezvous, not a
         router; the TCP data plane is gated separately via
         store_fault)."""
-        self._partition_groups = [list(g) for g in groups]
-        self._install_partition(self._partition_groups)
+        self._partition = {"groups": [list(g) for g in groups],
+                           "asym": False}
+        self._install_partition()
 
-    def _install_partition(self, groups: List[List[str]]) -> None:
+    def partition_asym(self, groups: List[List[str]]) -> None:
+        """One-way partition: ``groups[0]``'s datagrams toward
+        ``groups[1]`` (and any later group) are lost; the reverse
+        direction delivers. Group 0 still HEARS the cluster — its
+        ACKs just never arrive — the classic half-dead link SWIM's
+        bidirectional ping/ack assumption is worst at."""
+        self._partition = {"groups": [list(g) for g in groups],
+                           "asym": True}
+        self._install_partition()
+
+    def _install_partition(self) -> None:
+        part = self._partition
+        if part is None:
+            return
+        asym = part["asym"]
         port_group: Dict[int, int] = {}
-        for gi, unames in enumerate(groups):
+        for gi, unames in enumerate(part["groups"]):
             for uname in unames:
                 nid = self.spec.node_by_unique_name(uname)
                 if nid is not None:
                     port_group[nid.port] = gi
+
+        def lost(src: Optional[int], dst: Optional[int]) -> bool:
+            """Is the src-group -> dst-group direction dead?"""
+            if src is None or dst is None or src == dst:
+                return False
+            return src == 0 if asym else True
+
         for sn in self.nodes.values():
             mine = port_group.get(sn.node.me.port)
 
-            def blocked(addr, mine=mine):
-                other = port_group.get(addr[1])
-                return other is not None and mine is not None and other != mine
+            def out_blocked(addr, mine=mine):
+                return lost(mine, port_group.get(addr[1]))
 
-            sn.node.transport.partition_filter = blocked
+            def in_blocked(addr, mine=mine):
+                return lost(port_group.get(addr[1]), mine)
+
+            # both directional seams carry the same truth: the sender
+            # drops what the link would lose AND the receiver's ear is
+            # deaf to it — either alone enforces the partition, and a
+            # restart must land consistently on both
+            sn.node.transport.partition_filter = out_blocked
+            sn.node.transport.inbound_filter = in_blocked
 
     def heal(self) -> None:
-        self._partition_groups = None
+        self._partition = None
         for sn in self.nodes.values():
             sn.node.transport.partition_filter = None
+            sn.node.transport.inbound_filter = None
 
     # ---- views ----
 
@@ -597,6 +970,16 @@ class LocalCluster:
                 if uname not in (leader, standby):
                     return uname
             return None
+        if target == "skewed":
+            # the live node whose SWIM clock runs furthest AHEAD (the
+            # mask-a-real-failure victim of the skew scenario)
+            live_skews = {
+                u: off for u, off in self._skews.items()
+                if u in self.nodes and off > 0
+            }
+            if not live_skews:
+                return None
+            return max(sorted(live_skews), key=lambda u: live_skews[u])
         nid = self.spec.node_by_name(target)
         if nid is not None:
             return nid.unique_name
@@ -664,11 +1047,20 @@ class InvariantReport:
         return dataclasses.asdict(self)
 
 
+def _malformed_dropped_total() -> float:
+    snap = METRICS.snapshot()
+    return float(
+        snap["counters"].get("transport_malformed_dropped_total", 0.0)
+    )
+
+
 async def invariant_sweep(
     cluster: LocalCluster,
     acked_jobs: Dict[int, Dict[str, Any]],
     seed_files: Dict[str, bytes],
     timeout: float = 25.0,
+    fuzz_malformed_sent: int = 0,
+    malformed_baseline: float = 0.0,
 ) -> InvariantReport:
     """The machine-checked end state every plan run must reach."""
     failures: List[str] = []
@@ -685,6 +1077,24 @@ async def invariant_sweep(
         views = {u: sn.node.leader_unique
                  for u, sn in cluster.nodes.items()}
         failures.append(f"no single-leader convergence: views={views}")
+
+    # 1b. the introducer DNS (when up) must agree with the converged
+    # leader — a healed DNS outage ends with the live leader
+    # re-registered, so future joiners land on it, not on a corpse
+    if cluster.dns.transport is not None and cluster.leader_uname():
+        try:
+            await cluster.wait_for(
+                lambda: cluster.dns.current_introducer
+                == cluster.leader_uname(),
+                timeout, "introducer DNS pointing at the leader",
+            )
+            checks["dns"] = {"introducer": cluster.dns.current_introducer}
+        except AssertionError:
+            failures.append(
+                f"introducer DNS points at "
+                f"{cluster.dns.current_introducer!r} but the leader is "
+                f"{cluster.leader_uname()!r}"
+            )
 
     # 2. every acked job terminal, completions counted exactly once
     leader_sn = next(
@@ -757,6 +1167,26 @@ async def invariant_sweep(
             failures.append(f"seed file {name} content corrupted")
     checks["seed_files"] = sorted(seed_files)
 
+    # 3b. EVERY live replica's on-disk copy hashes to the seeded
+    # content (checksum-verified reads): the corruption scenario must
+    # end with the bad copy quarantined AND re-repaired, not merely
+    # routed around — a client-side read can't see the difference
+    bad_copies = []
+    for name, blob in sorted(seed_files.items()):
+        for uname in sorted(cluster.nodes):
+            st = cluster.nodes[uname].store.store
+            if not st.has(name):
+                continue
+            try:
+                data, _ = st.get_bytes(name)
+            except Exception as e:
+                bad_copies.append(f"{uname}:{name} unreadable ({e})")
+                continue
+            if data != blob:
+                bad_copies.append(f"{uname}:{name} content mismatch")
+    if bad_copies:
+        failures.append(f"replica copies corrupt on disk: {bad_copies}")
+
     # 4. no metrics gauge negative (an in-process sim shares one
     # registry, so this sweeps every node's gauges at once)
     snap = METRICS.snapshot()
@@ -764,6 +1194,38 @@ async def invariant_sweep(
     if negative:
         failures.append(f"negative gauges: {negative}")
     checks["gauges_scanned"] = len(snap["gauges"])
+
+    # 5. no core coroutine died: byzantine input, injected faults, and
+    # handler exceptions may be logged and dropped, but every live
+    # node's dispatch/failure-detection/store loops must still be
+    # running (a dead dispatcher serves nothing and says nothing)
+    dead = []
+    for uname, sn in sorted(cluster.nodes.items()):
+        for t in sn.node._tasks:
+            tname = t.get_name()
+            if (tname.endswith("-dispatch") or tname.endswith("-fd")) \
+                    and t.done():
+                dead.append(f"{uname}:{tname}")
+        rt = sn.store._resend_task
+        if rt is not None and rt.done():
+            dead.append(f"{uname}:store-resend")
+    if dead:
+        failures.append(f"core coroutines died: {dead}")
+    checks["coroutines_checked"] = 3 * len(cluster.nodes)
+
+    # 6. when the plan fuzzed the wire, every guaranteed-malformed
+    # datagram must have died in Message.unpack, visibly: the
+    # malformed-drop counter moved (silence would mean frames reached
+    # dispatch — or the seam lost its instrumentation)
+    if fuzz_malformed_sent:
+        delta = _malformed_dropped_total() - malformed_baseline
+        checks["fuzz"] = {"malformed_sent": fuzz_malformed_sent,
+                          "malformed_dropped": int(delta)}
+        if delta <= 0:
+            failures.append(
+                f"fuzz sent {fuzz_malformed_sent} malformed datagrams "
+                "but transport_malformed_dropped_total never moved"
+            )
 
     return InvariantReport(ok=not failures, failures=failures, checks=checks)
 
@@ -819,6 +1281,9 @@ class ChaosRunner:
         self._bg: List[asyncio.Task] = []
         self._workload: List[asyncio.Task] = []
         self._put_counter = 0
+        self._fuzz_counter = 0
+        self.fuzz_malformed_sent = 0
+        self._malformed_baseline = _malformed_dropped_total()
 
     # ---- workload ----
 
@@ -852,6 +1317,84 @@ class ChaosRunner:
                     continue
                 raise
         raise RuntimeError(f"put {name} failed on 3 clients") from last
+
+    async def _do_get(self, name: str, scrub: bool) -> None:
+        """Client GET verified against the seeded content. With
+        ``scrub``, every live replica is also read DIRECTLY first —
+        a corrupted copy only reveals itself when something reads it,
+        and the normal GET may be served by a healthy replica."""
+        blob = self.seed_files.get(name)
+        last: Optional[Exception] = None
+        for _ in range(3):
+            client = self.cluster.client()
+            try:
+                if scrub:
+                    for uname in await client.store.ls(name):
+                        nid = client.node.spec.node_by_unique_name(uname)
+                        if nid is None:
+                            continue
+                        try:
+                            await client.store.data_plane.fetch_from_store(
+                                data_addr(nid), name
+                            )
+                        except Exception:
+                            # a corrupt/missing copy: its replica has
+                            # now detected + quarantined it, which is
+                            # the point of the scrub
+                            pass
+                got = await client.store.get_bytes(name, timeout=15.0)
+                if blob is not None and got != blob:
+                    raise AssertionError(
+                        f"get {name}: content mismatch after chaos"
+                    )
+                return
+            except AssertionError:
+                raise
+            except Exception as e:
+                if self._client_crashed(client):
+                    last = e
+                    continue
+                raise
+        raise RuntimeError(f"get {name} failed on 3 clients") from last
+
+    def _do_fuzz(self, n: int) -> Dict[str, int]:
+        """Inject one seeded byzantine burst at every live transport
+        (raw socket — below every product abstraction, like the
+        network would)."""
+        self._fuzz_counter += 1
+        c = self.cluster
+        senders = tuple(sorted(c.nodes))
+        malformed, byzantine = fuzz_datagrams(
+            _child_seed(self.plan.seed, f"fuzz/{self._fuzz_counter}"),
+            n, senders,
+        )
+        targets = []
+        for uname in sorted(c.nodes):
+            nid = c.spec.node_by_unique_name(uname)
+            if nid is not None:
+                targets.append((nid.host, nid.port))
+        if not targets:
+            return {"malformed": 0, "byzantine": 0}
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sent = {"malformed": 0, "byzantine": 0}
+        try:
+            for i, frame in enumerate(malformed + byzantine):
+                pool = "malformed" if i < len(malformed) else "byzantine"
+                try:
+                    sock.sendto(frame, targets[i % len(targets)])
+                    sent[pool] += 1
+                except OSError:
+                    # e.g. EMSGSIZE: non-Linux UDP stacks cap datagrams
+                    # well under the ~60 KB oversize frame — a frame
+                    # the OS refuses to emit is not a frame the node
+                    # must survive, so it simply doesn't count
+                    continue
+        finally:
+            sock.close()
+        # only frames that actually left the socket count toward the
+        # sweep's "the drop counter must have moved" obligation
+        self.fuzz_malformed_sent += sent["malformed"]
+        return sent
 
     async def _do_job(self, n: int) -> None:
         """Submit + await one stub job, tracking its terminal state.
@@ -967,12 +1510,18 @@ class ChaosRunner:
                 record["resolved"] = uname
                 self._measure("repair", c.replication_satisfied,
                               self.store_repair_s, _M_REPAIR)
-        elif ev.kind == "partition":
+        elif ev.kind in ("partition", "partition_asym"):
             frac = float(ev.arg("fraction", 0.4))
             unames = sorted(c.nodes)
             k = max(1, min(len(unames) - 1, int(round(frac * len(unames)))))
             groups = [unames[:k], unames[k:]]
-            c.partition(groups)
+            if ev.kind == "partition":
+                c.partition(groups)
+            else:
+                # groups[0] is the mute side: it hears the majority,
+                # the majority never hears it
+                c.partition_asym(groups)
+                record["mute"] = groups[0]
             record["groups"] = groups
         elif ev.kind == "heal":
             c.heal()
@@ -988,11 +1537,48 @@ class ChaosRunner:
             c.set_store_fault()
             self._measure("repair", c.replication_satisfied,
                           self.store_repair_s, _M_REPAIR)
+        elif ev.kind == "disk_fault":
+            uname = c.resolve_target(ev.target or "worker")
+            if uname is None or uname not in c.nodes:
+                record["skipped"] = "no live target"
+            else:
+                c.set_disk_fault(uname, **{k: float(v) for k, v in ev.args})
+                record["resolved"] = uname
+        elif ev.kind == "disk_heal":
+            c.set_disk_fault(None)
+            self._measure("repair", c.replication_satisfied,
+                          self.store_repair_s, _M_REPAIR)
+        elif ev.kind == "disk_corrupt":
+            name = str(ev.arg("name", ""))
+            victim = c.corrupt_replica(name)
+            if victim is None:
+                record["skipped"] = f"no live replica holds {name!r}"
+            else:
+                record["resolved"] = victim
+        elif ev.kind == "dns_crash":
+            await c.crash_dns()
+        elif ev.kind == "dns_restart":
+            await c.restart_dns()
+        elif ev.kind == "skew":
+            uname = c.resolve_target(ev.target or "worker")
+            if uname is None or uname not in c.nodes:
+                record["skipped"] = "no live target"
+            else:
+                c.set_skew(uname, float(ev.arg("offset_s", 0.0)))
+                record["resolved"] = uname
+        elif ev.kind == "fuzz":
+            record["injected"] = self._do_fuzz(int(ev.arg("n", 36)))
         elif ev.kind == "put":
             self._spawn_workload(
                 self._do_put(str(ev.arg("name", "chaos.bin")),
                              int(ev.arg("size", 1024))),
                 "put",
+            )
+        elif ev.kind == "get":
+            self._spawn_workload(
+                self._do_get(str(ev.arg("name", "chaos.bin")),
+                             bool(ev.arg("scrub", True))),
+                "get",
             )
         elif ev.kind == "job":
             self._spawn_workload(self._do_job(int(ev.arg("n", 16))), "job")
@@ -1042,7 +1628,9 @@ class ChaosRunner:
                 if not t.done():
                     t.cancel()
         report = await invariant_sweep(
-            self.cluster, self.jobs, self.seed_files
+            self.cluster, self.jobs, self.seed_files,
+            fuzz_malformed_sent=self.fuzz_malformed_sent,
+            malformed_baseline=self._malformed_baseline,
         )
         # an event that ERRORED (failed restart, crash that threw)
         # means the plan did not actually run as scheduled — the

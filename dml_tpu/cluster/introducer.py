@@ -24,6 +24,7 @@ from typing import Optional
 
 from ..config import ClusterSpec, NodeId
 from .transport import UdpTransport
+from .util import rebind_retry
 from .wire import Message, MsgType
 
 log = logging.getLogger(__name__)
@@ -47,7 +48,13 @@ class IntroducerService:
         self._task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
-        self.transport = await UdpTransport.bind(self.me.host, self.me.port)
+        """Bind and serve. The bind rides the shared same-identity
+        rebind retry (util.rebind_retry): a restarting DNS (chaos
+        introducer-outage scenario, or a supervised process bouncing)
+        can race its previous incarnation's socket release."""
+        self.transport = await rebind_retry(
+            lambda: UdpTransport.bind(self.me.host, self.me.port)
+        )
         self._task = asyncio.create_task(self._serve(), name="introducer-serve")
         log.info("introducer DNS up at %s, introducer=%s",
                  self.me.unique_name, self.current_introducer)

@@ -60,10 +60,28 @@ class MembershipList:
     me: NodeId
     hooks: MembershipHooks = field(default_factory=MembershipHooks)
     clock: Callable[[], float] = time.time
+    #: fault-injection seam: this node's wall clock is wrong by this
+    #: many seconds. Every SWIM timestamp this node mints (self
+    #: heartbeats, suspicion marks, merge bookkeeping) is skewed, so
+    #: the chaos clock-skew scenario exercises the real gossip paths.
+    clock_offset: float = 0.0
+    #: merge-time clamp on FUTURE timestamps, in seconds past our own
+    #: now (None disables). Without it, gossip from a skewed-AHEAD
+    #: node is unbeatable once that node dies: our SUSPECT mark uses
+    #: our clock, the circulating ALIVE entry carries the future ts,
+    #: and every merge "resurrects" the corpse until our clock catches
+    #: up — clock skew would mask a real failure for its full
+    #: magnitude. Clamping to now+cleanup_time bounds the extra
+    #: eviction delay to one cleanup window. (SWIM proper uses
+    #: incarnation numbers; the reference — and this repro — use wall
+    #: timestamps, so the clamp is the minimal skew armor.)
+    max_future_skew: Optional[float] = None
 
     def __post_init__(self):
+        if self.max_future_skew is None:
+            self.max_future_skew = self.spec.timing.cleanup_time
         self._members: Dict[str, Tuple[float, int]] = {
-            self.me.unique_name: (self.clock(), ALIVE)
+            self.me.unique_name: (self._now(), ALIVE)
         }
         self._suspect_since: Dict[str, float] = {}
         # tombstones: uname -> last gossip timestamp at cleanup time.
@@ -77,6 +95,10 @@ class MembershipList:
         self.cleaned_since_replication: List[str] = []
         self._ping_targets: List[NodeId] = []
         self.recompute_ping_targets()
+
+    def _now(self) -> float:
+        """This node's (possibly skewed) SWIM clock."""
+        return self.clock() + self.clock_offset
 
     # ---- views ----
 
@@ -106,7 +128,7 @@ class MembershipList:
     # ---- mutation ----
 
     def heartbeat_self(self) -> None:
-        self._members[self.me.unique_name] = (self.clock(), ALIVE)
+        self._members[self.me.unique_name] = (self._now(), ALIVE)
 
     def merge(self, gossip: Dict[str, Tuple[float, int]]) -> None:
         """Newest-timestamp merge (reference update(),
@@ -114,8 +136,22 @@ class MembershipList:
         SUSPECT entry un-suspects the node (false-positive accounting,
         membershipList.py:113-118)."""
         changed = False
+        horizon = (
+            None if self.max_future_skew is None
+            else self._now() + self.max_future_skew
+        )
         for uname, entry in gossip.items():
-            ts, status = float(entry[0]), int(entry[1])
+            try:
+                ts, status = float(entry[0]), int(entry[1])
+            except (TypeError, ValueError, IndexError, KeyError):
+                continue  # garbled/byzantine entry: skip, keep the rest
+            if status not in (ALIVE, SUSPECT):
+                continue
+            if horizon is not None and ts > horizon:
+                # future-dated gossip (a skewed-ahead clock): clamp to
+                # our horizon so the entry is still beatable by our own
+                # observations once its producer stops refreshing it
+                ts = horizon
             if uname == self.me.unique_name:
                 continue
             if self.spec.node_by_unique_name(uname) is None:
@@ -129,7 +165,7 @@ class MembershipList:
                 self._members[uname] = (ts, status)
                 changed = True
                 if status == SUSPECT:
-                    self._suspect_since[uname] = self.clock()
+                    self._suspect_since[uname] = self._now()
                     self.indirect_failures += 1
                     _M_SUSPECT.inc()
                 continue
@@ -139,7 +175,7 @@ class MembershipList:
                     _M_FALSE_POS.inc()
                     self._suspect_since.pop(uname, None)
                 if cur[1] == ALIVE and status == SUSPECT:
-                    self._suspect_since[uname] = self.clock()
+                    self._suspect_since[uname] = self._now()
                     self.indirect_failures += 1
                     _M_SUSPECT.inc()
                 if cur[1] != status:
@@ -158,8 +194,8 @@ class MembershipList:
         cur = self._members.get(unique_name)
         if cur is None or cur[1] == SUSPECT:
             return
-        self._members[unique_name] = (self.clock(), SUSPECT)
-        self._suspect_since[unique_name] = self.clock()
+        self._members[unique_name] = (self._now(), SUSPECT)
+        self._suspect_since[unique_name] = self._now()
         _M_SUSPECT.inc()
         self.recompute_ping_targets()
         if self.hooks.on_topology_change:
@@ -176,7 +212,7 @@ class MembershipList:
             _M_FALSE_POS.inc()
         self._tombstones.pop(unique_name, None)  # direct evidence beats a tombstone
         self._suspect_since.pop(unique_name, None)
-        self._members[unique_name] = (self.clock(), ALIVE)
+        self._members[unique_name] = (self._now(), ALIVE)
         if changed:
             self.recompute_ping_targets()
             if self.hooks.on_topology_change:
@@ -190,7 +226,7 @@ class MembershipList:
 
     def reset(self) -> None:
         """Leave the cluster: forget everyone but self."""
-        self._members = {self.me.unique_name: (self.clock(), ALIVE)}
+        self._members = {self.me.unique_name: (self._now(), ALIVE)}
         self._suspect_since.clear()
         self._tombstones.clear()
         self.leader = None
@@ -199,7 +235,7 @@ class MembershipList:
     # ---- cleanup + hooks (reference _cleanup, membershipList.py:26-59) ----
 
     def cleanup(self) -> List[str]:
-        now = self.clock()
+        now = self._now()
         expired = [
             u
             for u, since in self._suspect_since.items()

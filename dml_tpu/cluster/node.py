@@ -65,6 +65,7 @@ class Node:
         self._pending: Dict[str, asyncio.Future] = {}
         self._rid_counter = itertools.count(1)
         self._tasks: List[asyncio.Task] = []
+        self._introducer_reg_task: Optional[asyncio.Task] = None
         self._stopped = asyncio.Event()
         self._left = False
         self._probe_idx = 0  # anti-entropy probe round-robin cursor
@@ -159,7 +160,9 @@ class Node:
         call this from their ACK handlers (or rely on the dispatcher's
         fallback, which resolves any un-handled message with a rid)."""
         rid = msg.data.get("rid")
-        fut = self._pending.get(rid) if rid else None
+        if not isinstance(rid, str):
+            return False  # absent — or byzantine junk (unhashable)
+        fut = self._pending.get(rid)
         if fut is not None and not fut.done():
             fut.set_result(msg.data)
             return True
@@ -359,6 +362,19 @@ class Node:
         log.info("%s is the leader", self.me)
         for cb in self.on_became_leader_cbs:
             cb()
+        # own the DNS record for as long as we lead (see the loop's
+        # docstring) — spawned here, not in _announce_coordinator, so
+        # the bootstrap leader (who never runs an election) keeps a
+        # restarted DNS honest too
+        if self.spec.introducer is not None and (
+            self._introducer_reg_task is None
+            or self._introducer_reg_task.done()
+        ):
+            self._introducer_reg_task = asyncio.create_task(
+                self._introducer_registration_loop(),
+                name=f"{self.me}-introducer-reg",
+            )
+            self._tasks.append(self._introducer_reg_task)
 
     def _set_leader(self, unique_name: Optional[str]) -> None:
         prev = self.membership.leader
@@ -400,21 +416,28 @@ class Node:
         for node in self.membership.alive_nodes():
             if node.unique_name != self.me.unique_name:
                 self.send(node, MsgType.COORDINATE, {})
-        if self.spec.introducer is not None:
-            # COORDINATE loss self-heals via election gossip, but this
-            # is the only copy of the new leader's identity the DNS will
-            # ever get — retry until ACKed or a packet drop would strand
-            # future joiners at the dead leader forever
-            self._tasks.append(
-                asyncio.create_task(
-                    self._update_introducer_until_acked(),
-                    name=f"{self.me}-update-introducer",
-                )
-            )
+        # (the DNS registration loop is spawned by _become_leader)
 
-    async def _update_introducer_until_acked(self, attempts: int = 20) -> None:
+    async def _introducer_registration_loop(self) -> None:
+        """Keep the introducer DNS pointing at us for as long as we
+        lead. Two regimes:
+
+        - un-ACKed: tight capped-backoff retries. No fixed attempt
+          budget — a DNS *outage* spanning a failover (the chaos
+          introducer-outage scenario) outlives any fixed count, and
+          giving up strands every future joiner at the dead
+          ex-leader; the moment the DNS returns, we register.
+        - ACKed: slow periodic re-assert. A one-shot update is not
+          enough: a nameserver that restarts WITH STATE LOSS after
+          our ACK serves its stale static default (typically a dead
+          ex-leader) until someone re-teaches it — and nothing else
+          ever would. One datagram per interval is the whole cost.
+
+        Exits when we stop being leader; the next leader runs its
+        own."""
         assert self.spec.introducer is not None
-        for _ in range(attempts):
+        attempt = 0
+        while self.is_leader:
             try:
                 await self.request(
                     self.spec.introducer,
@@ -422,10 +445,20 @@ class Node:
                     {"introducer": self.me.unique_name},
                     timeout=self.spec.timing.ack_timeout,
                 )
-                return
+                attempt = 0
+                await asyncio.sleep(
+                    max(1.0, 4 * self.spec.timing.ping_interval)
+                )
             except asyncio.TimeoutError:
-                continue
-        log.warning("%s: introducer DNS never ACKed the leader update", self.me)
+                attempt += 1
+                if attempt == 20:
+                    log.warning(
+                        "%s: introducer DNS not ACKing the leader update "
+                        "(outage?); retrying until it returns", self.me,
+                    )
+                await asyncio.sleep(
+                    min(1.0, self.spec.timing.ack_timeout * 2 ** min(attempt, 6))
+                )
 
     # ------------------------------------------------------------------
     # core handlers
@@ -545,6 +578,8 @@ class Node:
         self.membership.merge(msg.data.get("members", {}))
         self.membership.mark_alive(msg.sender)
         their_leader = msg.data.get("leader")
+        if their_leader and self.spec.node_by_unique_name(their_leader) is None:
+            their_leader = None  # forged/garbled leader outside the universe
         if their_leader and self.membership.leader is None and not self.election.in_progress:
             self._set_leader(their_leader)
         self._check_leader_conflict(their_leader)
@@ -590,7 +625,14 @@ class Node:
     async def _h_coordinate(self, msg: Message, addr) -> None:
         """Accept the new leader (reference worker.py:631-637); reply
         COORDINATE_ACK carrying our file inventory so the new leader
-        can rebuild the global table (worker.py:639-649)."""
+        can rebuild the global table (worker.py:639-649).
+
+        Senders outside the static node table are ignored: a byzantine
+        datagram that parses as COORDINATE must not be able to crown a
+        phantom leader (the membership list applies the same static-
+        universe rule to gossip)."""
+        if self.spec.node_by_unique_name(msg.sender) is None:
+            return
         self.election.resolved(msg.sender)
         self.membership.mark_alive(msg.sender)
         self._set_leader(msg.sender)

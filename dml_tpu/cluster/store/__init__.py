@@ -10,6 +10,6 @@ Three pieces:
   (reference file_service.py:52-91, config.py:29-37)
 """
 
-from .local_store import LocalStore  # noqa: F401
+from .local_store import CorruptionError, DiskFault, LocalStore  # noqa: F401
 from .metadata import StoreMetadata  # noqa: F401
 from .data_plane import DataPlane  # noqa: F401

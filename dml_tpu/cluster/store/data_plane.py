@@ -27,7 +27,7 @@ import random
 import secrets
 from typing import Dict, List, Optional, Tuple
 
-from .local_store import LocalStore
+from .local_store import CorruptionError, LocalStore
 
 _CHUNK = 1 << 16
 
@@ -143,13 +143,19 @@ class DataPlane:
         name = req.get("file", "")
         if req.get("all_versions"):
             versions = self.store.versions(name)
-            if not versions:
-                await self._reply(writer, {"ok": False, "error": "not found"})
-                return
             blobs = []
             for v in versions:
-                data, _ = self.store.get_bytes(name, v)
+                # a corrupt version is quarantined by the verified read
+                # and NOT served: a repair pull from this replica gets
+                # only the good versions — corruption cannot propagate
+                try:
+                    data, _ = self.store.get_bytes(name, v)
+                except (FileNotFoundError, CorruptionError):
+                    continue
                 blobs.append((v, data))
+            if not blobs:
+                await self._reply(writer, {"ok": False, "error": "not found"})
+                return
             header = {
                 "ok": True,
                 "versions": [[v, len(d)] for v, d in blobs],
@@ -159,8 +165,14 @@ class DataPlane:
             return
         try:
             data, v = self.store.get_bytes(name, req.get("version"))
-        except FileNotFoundError:
-            await self._reply(writer, {"ok": False, "error": "not found"})
+        except (FileNotFoundError, CorruptionError) as e:
+            # checksum mismatch quarantined the version; to the caller
+            # this replica simply doesn't have the bytes — it retries
+            # the next replica, and the re-report + repair sweep heal
+            # this one in the background
+            await self._reply(writer, {"ok": False, "error": f"not found ({e})"
+                                       if isinstance(e, CorruptionError)
+                                       else "not found"})
             return
         await self._reply(writer, {"ok": True, "version": v, "size": len(data)}, data)
 

@@ -24,6 +24,9 @@ class RequestStatus:
     file: str
     requester: str  # unique_name of the client
     replicas: Dict[str, str] = field(default_factory=dict)  # node -> pending|ok|fail
+    #: nodes that already failed this request (write fault, dead mid-
+    #: pull): reassignment must not hand the slot straight back
+    tried: Set[str] = field(default_factory=set)
     version: int = 0
     client_rid: str = ""  # the requester's rid, echoed in the final reply
     # fan-out resend support (the control plane is at-most-once UDP):

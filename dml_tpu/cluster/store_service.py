@@ -636,10 +636,38 @@ class StoreService:
             reply,
         )
 
+    def _reassign_failed_put(self, st) -> int:
+        """A replica NAKed its PUT pull (full disk, dying data plane):
+        move every failed slot to a live node not yet tried, so one
+        bad disk degrades placement instead of failing the client's
+        whole PUT. Returns how many replacement slots were fanned out
+        (0 = no candidates left)."""
+        failed = [n for n, s in st.replicas.items() if s == "fail"]
+        for n in failed:
+            st.replicas.pop(n, None)
+            st.tried.add(n)
+        candidates = [
+            n for n in self._live_node_names()
+            if n not in st.tried and n not in st.replicas
+        ]
+        moved = 0
+        for n in candidates[: len(failed)]:
+            st.replicas[n] = "pending"
+            st.last_sent = time.monotonic()
+            self.node.send_unique(n, MsgType.DOWNLOAD_FILE, st.fanout_payload)
+            moved += 1
+        if moved:
+            log.info(
+                "%s: PUT %s reassigned %d failed replica slot(s) -> %s",
+                self._me, st.file, moved, candidates[:moved],
+            )
+        return moved
+
     async def _h_download_result(self, msg: Message, addr) -> None:
         """Replica finished (or failed) pulling a PUT (reference
         worker.py:702-730). All ok -> answer the client; any fail ->
-        reassign to another live node or fail the request."""
+        reassign the slot to another live node, or resolve with what
+        actually landed."""
         if not self.node.is_leader:
             return
         req_id = msg.data.get("req", "")
@@ -650,6 +678,24 @@ class StoreService:
         st.set_status(msg.sender, "ok" if ok else "fail")
         if ok:
             self.metadata.record_replica(msg.sender, st.file, st.version)
+        if st.failed:
+            if self._reassign_failed_put(st):
+                return  # fresh pending slots; their results resolve us
+            # no candidates left: the request resolves on whatever
+            # actually lands — wait out any stragglers, then succeed
+            # degraded-but-durable if at least one replica holds the
+            # bytes (the periodic under-replication sweep tops it back
+            # up as capacity heals), or fail honestly if none do
+            if st.pending_nodes:
+                return
+            if not any(s == "ok" for s in st.replicas.values()):
+                self._resolve_put(req_id, st, False, {
+                    "rid": st.client_rid,
+                    "ok": False,
+                    "error": f"no replica could store it "
+                             f"(last: {msg.sender}: {msg.data.get('error')})",
+                })
+                return
         if st.completed:
             self._resolve_put(req_id, st, True, {
                 "rid": st.client_rid,
@@ -657,12 +703,6 @@ class StoreService:
                 "file": st.file,
                 "version": st.version,
                 "replicas": self.metadata.replicas_of(st.file),
-            })
-        elif st.failed:
-            self._resolve_put(req_id, st, False, {
-                "rid": st.client_rid,
-                "ok": False,
-                "error": f"replica {msg.sender} failed: {msg.data.get('error')}",
             })
 
     async def _h_get_file_request(self, msg: Message, addr) -> None:
@@ -842,10 +882,13 @@ class StoreService:
             )
         except Exception as e:
             log.warning("%s: PUT pull failed: %s", self._me, e)
+            # .get: a byzantine DOWNLOAD_FILE with missing keys must
+            # fail into THIS reply, not crash the error path itself
             self.node.send_unique(
                 msg.sender,
                 MsgType.DOWNLOAD_FILE_FAIL,
-                {"req": msg.data.get("req"), "file": msg.data["file"], "error": str(e)},
+                {"req": msg.data.get("req"), "file": msg.data.get("file"),
+                 "error": str(e)},
             )
 
     async def _h_delete_file(self, msg: Message, addr) -> None:

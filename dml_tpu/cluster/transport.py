@@ -44,6 +44,18 @@ _M_DUPED = METRICS.counter(
 _M_DELAYED = METRICS.counter(
     "transport_packets_delayed_total",
     "outbound datagrams held back by the delay/reorder injector")
+_M_DROPPED_IN = METRICS.counter(
+    "transport_packets_dropped_inbound_total",
+    "inbound datagrams dropped by the directional partition filter")
+_M_MALFORMED = METRICS.counter(
+    "transport_malformed_dropped_total",
+    "inbound datagrams Message.unpack rejected (truncated, bit-flipped, "
+    "bad magic/length, non-JSON, oversized — the byzantine-wire drop)")
+# pre-touch so the counters are visible (as 0) in `profile metrics`
+# and bench metrics blocks even before the first adversarial datagram
+# — the fuzz/corruption scenarios must be observable, not silent
+_M_MALFORMED.inc(0)
+_M_DROPPED_IN.inc(0)
 
 
 class LossInjector:
@@ -165,6 +177,14 @@ class UdpTransport(asyncio.DatagramProtocol):
         # are dropped (set symmetrically on every node for a full
         # bidirectional partition).
         self.partition_filter: Optional[Callable[[Tuple[str, int]], bool]] = None
+        # fault-injection seam: DIRECTIONAL partition — inbound
+        # datagrams whose source address matches are dropped before
+        # decode. With only the outbound filter, "A hears B but B
+        # doesn't hear A" is unrepresentable: one-way link loss needs
+        # a seam at the receiving ear, not just the sending mouth.
+        self.inbound_filter: Optional[Callable[[Tuple[str, int]], bool]] = None
+        self.packets_dropped_inbound = 0
+        self.malformed_dropped = 0
         # fault-injection seam: per-link delay/duplication/reordering
         # (the chaos engine installs one; None = clean link)
         self.shaper: Optional[LinkShaper] = None
@@ -185,11 +205,20 @@ class UdpTransport(asyncio.DatagramProtocol):
         self._transport = transport
 
     def datagram_received(self, data: bytes, addr: Tuple[str, int]) -> None:
+        if self.inbound_filter is not None and self.inbound_filter(addr):
+            self.packets_dropped_inbound += 1
+            _M_DROPPED_IN.inc()
+            return
         msg = Message.unpack(data)
-        if msg is not None:
-            _M_RECV.inc(1, type=msg.type.name)
-            _M_RECV_BYTES.inc(len(data), type=msg.type.name)
-            self._queue.put_nowait((msg, addr))
+        if msg is None:
+            # byzantine wire input: anything unpack rejects dies HERE,
+            # counted — never reaches a dispatcher coroutine
+            self.malformed_dropped += 1
+            _M_MALFORMED.inc()
+            return
+        _M_RECV.inc(1, type=msg.type.name)
+        _M_RECV_BYTES.inc(len(data), type=msg.type.name)
+        self._queue.put_nowait((msg, addr))
 
     def error_received(self, exc) -> None:  # pragma: no cover - asyncio
         pass

@@ -4,35 +4,83 @@ control plane."""
 from __future__ import annotations
 
 import asyncio
+import errno
 import itertools
 import logging
 import random
 import zlib
-from typing import Any, Dict, Optional
+from typing import Any, Awaitable, Callable, Dict, Optional, TypeVar
 
 from .wire import MsgType
 
 log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+async def rebind_retry(
+    fn: Callable[[], Awaitable[T]], attempts: int = 10, delay: float = 0.2
+) -> T:
+    """Run a bind-ish coroutine factory, retrying briefly on
+    EADDRINUSE: UdpTransport.close aborts its socket, but the kernel
+    can take a few loop ticks to release the port, so a same-identity
+    restart (node or introducer DNS) may race its previous
+    incarnation. The one shared form of the retry — node restart and
+    DNS restart must not drift apart."""
+    for attempt in range(attempts):
+        try:
+            return await fn()
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE or attempt == attempts - 1:
+                raise
+            await asyncio.sleep(delay)
+    raise AssertionError("unreachable")
 
 
 async def reap_task(task: Optional[asyncio.Task], who: Any, what: str) -> None:
     """Cancel-and-await one background task during teardown, logging
     anything other than the requested cancellation — the one shared
     form of the stop() reap (a blanket ``except (CancelledError,
-    Exception): pass`` here used to hide real teardown bugs)."""
+    Exception): pass`` here used to hide real teardown bugs).
+
+    The cancel is RE-ISSUED until the task actually ends: a single
+    ``task.cancel()`` can be silently eaten by Python 3.10's
+    ``asyncio.wait_for`` completion/cancellation race (bpo-42130 — if
+    the inner future completes in the same tick the cancel arrives,
+    wait_for returns the result and the CancelledError evaporates).
+    A dispatch loop mid-data-plane-RPC hit exactly that under chaos
+    crash timing, looped back to ``recv()`` un-cancelled, and the old
+    single-shot reap awaited it forever — wedging every teardown
+    above it. Each round gives the task a grace period to run its
+    cleanup before the next cancel."""
     if task is None:
         return
-    task.cancel()
-    try:
-        await task
-    except asyncio.CancelledError:
-        if not task.cancelled():
-            # the reaped task did NOT end cancelled, so this
-            # CancelledError was aimed at the CALLER (e.g. a timeout
-            # around stop()) — it must propagate, not be absorbed
-            raise
-    except Exception:
-        log.exception("%s: %s raised during stop", who, what)
+    for attempt in range(30):
+        task.cancel()
+        try:
+            await asyncio.wait_for(asyncio.shield(task), timeout=2.0)
+            return  # completed with a result despite the cancel
+        except asyncio.TimeoutError:
+            if attempt:
+                log.warning(
+                    "%s: %s survived cancel x%d (swallowed "
+                    "cancellation?); re-issuing", who, what, attempt + 1,
+                )
+            continue
+        except asyncio.CancelledError:
+            if not task.cancelled():
+                # the reaped task did NOT end cancelled, so this
+                # CancelledError was aimed at the CALLER (e.g. a
+                # timeout around stop()) — it must propagate
+                raise
+            return
+        except Exception:
+            log.exception("%s: %s raised during stop", who, what)
+            return
+    log.error(
+        "%s: %s would not die after %d cancels; abandoning it to the "
+        "event loop's teardown", who, what, 30,
+    )
 
 
 #: distinguishes concurrent leader_retry calls in the default-jitter seed

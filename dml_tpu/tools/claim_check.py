@@ -334,6 +334,16 @@ def run_metrics_check(artifact_path: Optional[str] = None) -> List[str]:
 #: artifacts predate the chaos engine and are exempt
 CHAOS_REQUIRED_FROM_ROUND = 7
 
+#: first round whose chaos section must ALSO carry the per-family
+#: adversarial scenario sweeps (asym/disk/dns/skew/fuzz) and the
+#: malformed-drop evidence; earlier artifacts predate them
+CHAOS_SCENARIOS_REQUIRED_FROM_ROUND = 8
+
+#: the adversarial families the bench must sweep (mirror of
+#: cluster/chaos.py SCENARIO_FAMILIES — kept literal here so this
+#: tool stays importable without the cluster stack)
+CHAOS_SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz")
+
 
 def check_chaos_block(path: str) -> List[str]:
     """Validate a bench artifact's ``chaos`` section WHEN IT RAN
@@ -341,8 +351,11 @@ def check_chaos_block(path: str) -> List[str]:
     must all have passed, and the recovery walls — failover and
     replication repair — must be present, finite, and nonzero. A
     chaos section that 'ran' but recorded no recovery evidence means
-    the fault events never actually bit. Returns problems (empty =
-    OK)."""
+    the fault events never actually bit. From round 8 on the section
+    must also carry one green sweep per adversarial scenario family
+    and, since the fuzz family ran, a nonzero malformed-drop counter
+    (a fuzz run that dropped nothing means the byzantine datagrams
+    never reached the wire). Returns problems (empty = OK)."""
     name = os.path.basename(path)
     rnd = artifact_round(path)
     if rnd is not None and rnd < CHAOS_REQUIRED_FROM_ROUND:
@@ -373,6 +386,35 @@ def check_chaos_block(path: str) -> List[str]:
             problems.append(
                 f"{name}: chaos.{key} = {v!r} (recovery wall missing, "
                 "nonfinite, or zero — the fault plan never bit)"
+            )
+    if rnd is not None and rnd < CHAOS_SCENARIOS_REQUIRED_FROM_ROUND:
+        return problems
+    scenarios = block.get("scenarios")
+    if not isinstance(scenarios, dict):
+        problems.append(
+            f"{name}: chaos.scenarios missing (the adversarial "
+            "family sweeps were dropped from the bench?)"
+        )
+        return problems
+    for fam in CHAOS_SCENARIO_FAMILIES:
+        entry = scenarios.get(fam)
+        if not isinstance(entry, dict):
+            problems.append(f"{name}: chaos.scenarios[{fam!r}] missing")
+        elif not entry.get("all_invariants_ok"):
+            bad = [s.get("seed") for s in entry.get("per_seed", [])
+                   if not s.get("invariants_ok")]
+            problems.append(
+                f"{name}: chaos scenario {fam!r} invariant sweep "
+                f"failed for seeds {bad}"
+            )
+    if isinstance(scenarios.get("fuzz"), dict):
+        dropped = block.get("malformed_dropped_total")
+        if not isinstance(dropped, (int, float)) or dropped <= 0:
+            problems.append(
+                f"{name}: fuzz scenario ran but "
+                f"malformed_dropped_total = {dropped!r} (byzantine "
+                "datagrams never hit the transport, or the drop "
+                "counter lost its hook)"
             )
     return problems
 

@@ -70,15 +70,17 @@ def test_cluster_serving_bench_with_failure_injection():
 def test_chaos_bench_section_and_claim_check(tmp_path):
     """The bench `chaos` section machinery: one soak seed through the
     chaos engine yields nonzero failover/repair walls and a green
-    invariant sweep, and the resulting artifact block passes
-    claim_check's chaos validation (while a gutted block fails it)."""
+    invariant sweep, every adversarial scenario family sweeps green
+    with the fuzz run leaving a nonzero malformed-drop counter, and
+    the resulting artifact block passes claim_check's chaos
+    validation (while gutted variants fail it)."""
     import json
 
     from bench import _bench_chaos
     from dml_tpu.tools import claim_check as cc
 
     out = {}
-    _bench_chaos(out, seeds=(5,), base_port=28971)
+    _bench_chaos(out, seeds=(5,), scenario_seeds=(1,), base_port=28971)
     ch = out["chaos"]
     assert ch["all_invariants_ok"], ch["per_seed"]
     assert ch["failover_recovery_s"] > 0
@@ -87,6 +89,11 @@ def test_chaos_bench_section_and_claim_check(tmp_path):
     per = ch["per_seed"][0]
     assert per["seed"] == 5 and per["invariants_ok"]
     assert "done" in per["jobs"].values()
+    # round 8: every adversarial family swept, fuzz left evidence
+    assert set(ch["scenarios"]) == set(cc.CHAOS_SCENARIO_FAMILIES)
+    for fam, entry in ch["scenarios"].items():
+        assert entry["all_invariants_ok"], (fam, entry)
+    assert ch["malformed_dropped_total"] > 0
 
     def artifact(tmpname, matrix):
         path = str(tmp_path / f"{tmpname}.json")
@@ -114,6 +121,29 @@ def test_chaos_bench_section_and_claim_check(tmp_path):
         artifact("lost", {"cluster_serving": {}})
     )
     assert any("no `chaos` section" in p for p in problems)
+    # round 8: losing the scenario sweeps (or one family) fails
+    problems = cc.check_chaos_block(
+        artifact("noscen", {"chaos": {k: v for k, v in ch.items()
+                                      if k != "scenarios"}})
+    )
+    assert any("chaos.scenarios missing" in p for p in problems)
+    onefam = dict(ch, scenarios={
+        **ch["scenarios"],
+        "skew": dict(ch["scenarios"]["skew"], all_invariants_ok=False,
+                     per_seed=[{"seed": 1, "invariants_ok": False}]),
+    })
+    problems = cc.check_chaos_block(artifact("redfam", {"chaos": onefam}))
+    assert any("scenario 'skew'" in p for p in problems)
+    # fuzz that ran but counted no drops fails
+    nofuzz = dict(ch, malformed_dropped_total=0)
+    problems = cc.check_chaos_block(artifact("nofuzz", {"chaos": nofuzz}))
+    assert any("malformed_dropped_total" in p for p in problems)
+    # pre-round-8 artifacts are exempt from the scenario requirement
+    assert cc.check_chaos_block(artifact(
+        "BENCH_r07", {"chaos": {k: v for k, v in ch.items()
+                                if k not in ("scenarios",
+                                             "malformed_dropped_total")}}
+    )) == []
 
 
 def test_nowait_window_bound():

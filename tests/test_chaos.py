@@ -19,7 +19,8 @@ import pytest
 
 from dml_tpu.cluster import chaos
 from dml_tpu.cluster.chaos import (
-    ChaosPlan, LocalCluster, event, random_plan, soak_plan,
+    SCENARIO_FAMILIES, ChaosPlan, LocalCluster, event, fuzz_datagrams,
+    random_plan, scenario_plan, soak_plan,
 )
 from dml_tpu.cluster.transport import LinkShaper, UdpTransport
 from dml_tpu.cluster.wire import Message, MsgType
@@ -33,12 +34,65 @@ from dml_tpu.cluster.wire import Message, MsgType
 def test_plan_schedule_is_seed_deterministic():
     """The acceptance contract: re-running a seed reproduces the
     IDENTICAL event schedule; distinct seeds differ."""
-    for gen in (soak_plan, random_plan):
+    gens = [soak_plan, random_plan] + [
+        (lambda s, fam=fam: scenario_plan(fam, s))
+        for fam in SCENARIO_FAMILIES
+    ]
+    for gen in gens:
         a = [e.to_dict() for e in gen(7).events]
         b = [e.to_dict() for e in gen(7).events]
-        assert a == b, f"{gen.__name__} schedule drifted for one seed"
+        assert a == b, "schedule drifted for one seed"
         c = [e.to_dict() for e in gen(8).events]
-        assert a != c, f"{gen.__name__} identical across seeds"
+        assert a != c, "identical across seeds"
+
+
+def test_scenario_plans_compose_their_signature_faults():
+    """Each adversarial family's plan must actually carry its fault:
+    one-way split, disk write-fault + corruption + scrubbed get, DNS
+    outage spanning a leader kill, skew on two nodes + the skewed
+    crash, fuzz bursts — each JSON round-trips intact."""
+    for seed in (1, 2, 3):
+        kinds = {
+            fam: {e.kind for e in scenario_plan(fam, seed).events}
+            for fam in SCENARIO_FAMILIES
+        }
+        assert {"partition_asym", "heal"} <= kinds["asym"]
+        assert {"disk_fault", "disk_heal", "disk_corrupt",
+                "get"} <= kinds["disk"]
+        assert {"dns_crash", "dns_restart", "crash",
+                "restart"} <= kinds["dns"]
+        assert {"skew", "crash", "restart"} <= kinds["skew"]
+        assert "fuzz" in kinds["fuzz"]
+        dns = scenario_plan("dns", seed)
+        t = {e.kind: e.t for e in dns.events}
+        # the leader dies INSIDE the DNS outage window
+        assert t["dns_crash"] < t["crash"] < t["dns_restart"]
+        skew = scenario_plan("skew", seed)
+        crash = next(e for e in skew.events if e.kind == "crash")
+        assert crash.target == "skewed"
+        plan = scenario_plan("disk", seed)
+        clone = ChaosPlan.from_dict(plan.to_dict())
+        assert [e.to_dict() for e in clone.events] == [
+            e.to_dict() for e in plan.events
+        ]
+    with pytest.raises(ValueError):
+        scenario_plan("meteor", 1)
+
+
+def test_fuzz_datagrams_guarantees():
+    """The fuzzer's contract: seeded determinism; every 'malformed'
+    frame dies in Message.unpack, every 'byzantine' frame parses."""
+    senders = ("127.0.0.1:9001", "127.0.0.1:9002")
+    m1, b1 = fuzz_datagrams(11, 60, senders)
+    m2, b2 = fuzz_datagrams(11, 60, senders)
+    assert m1 == m2 and b1 == b2
+    m3, _ = fuzz_datagrams(12, 60, senders)
+    assert m1 != m3
+    assert m1 and b1  # both pools populated at n=60
+    assert all(Message.unpack(f) is None for f in m1)
+    assert all(Message.unpack(f) is not None for f in b1)
+    # the byzantine pool includes an out-of-universe forgery
+    assert any(Message.unpack(f).sender == "6.6.6.6:666" for f in b1)
 
 
 def test_plan_json_round_trip():
@@ -447,3 +501,138 @@ async def test_chaos_soak(tmp_path, seed):
     assert snap["histograms"][
         "cluster_failover_recovery_seconds"]["count"] >= 1
     assert snap["histograms"]["store_repair_seconds"]["count"] >= 1
+
+
+# ----------------------------------------------------------------------
+# adversarial scenario coverage
+# ----------------------------------------------------------------------
+
+
+async def test_restart_lands_in_directional_partition(tmp_path):
+    """Satellite (chaos.py restart edge): a node restarting while a
+    DIRECTIONAL partition is live must land in the hearing group on
+    BOTH seams — its outbound filter must not block the majority, its
+    inbound filter must drop the mute side — and the symmetric case
+    must block both directions. A restarted node silently bridging a
+    partition would invalidate every partition scenario."""
+    async with _cluster(4, 23400, tmp_path) as c:
+        unames = sorted(c.nodes)
+        victim = unames[-1]
+        await c.crash_node(victim)
+        live = sorted(c.nodes)
+        mute, hearing = [live[0]], live[1:]
+        c.partition_asym([mute, hearing])
+        sn = await c.restart_node(victim)
+        groups = c._partition["groups"]
+        assert victim in groups[-1] and victim not in groups[0]
+        mute_nid = c.spec.node_by_unique_name(mute[0])
+        t = sn.node.transport
+        # hearing side: sends to the mute node DELIVER (g1 -> g0 open)
+        assert not t.partition_filter(mute_nid.addr)
+        # ...but its ear is deaf to the mute side (g0 -> g1 dead)
+        assert t.inbound_filter(mute_nid.addr)
+        # and the mute node's own filters agree, post-reinstall
+        mt = c.nodes[mute[0]].node.transport
+        assert mt.partition_filter(sn.node.me.addr)  # mute -> hearing dead
+        assert not mt.inbound_filter(sn.node.me.addr)  # hearing -> mute open
+        # symmetric partition: the restarted node blocks BOTH ways
+        await c.crash_node(victim)
+        c.partition([[live[0]], live[1:]])
+        sn = await c.restart_node(victim)
+        t = sn.node.transport
+        assert t.partition_filter(mute_nid.addr)
+        assert t.inbound_filter(mute_nid.addr)
+        c.heal()
+        assert t.partition_filter is None and t.inbound_filter is None
+
+
+async def test_disk_scenario_smoke(tmp_path):
+    """Tier-1 smoke for the disk family: a full disk during a PUT gets
+    its replica slot re-placed (write-failure counter moves), a
+    bit-flipped replica is detected on the scrubbed GET, quarantined,
+    and repaired back to factor with content intact."""
+    from dml_tpu.observability import METRICS
+
+    def ctr(name):
+        return METRICS.snapshot()["counters"].get(name, 0.0)
+
+    corrupt0 = ctr("store_corruption_detected_total")
+    wfail0 = ctr("store_write_failures_total")
+    report = await chaos.run_plan(
+        scenario_plan("disk", 1), base_port=23500,
+        root=str(tmp_path / "disk"),
+    )
+    assert report.ok, report.invariants.failures
+    assert ctr("store_corruption_detected_total") > corrupt0
+    assert ctr("store_write_failures_total") > wfail0
+    corrupted = next(
+        r for r in report.executed if r["kind"] == "disk_corrupt"
+    )
+    assert "resolved" in corrupted  # a real replica was bit-flipped
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("family", list(SCENARIO_FAMILIES))
+async def test_adversarial_scenario_soak(tmp_path, family, seed):
+    """The acceptance matrix: every adversarial family sweeps green
+    for seeds 1-3, with the family's own evidence — fuzz must show
+    malformed drops, dns must end with the DNS pointing at the live
+    leader, skew must show the skewed crash was detected (cleaned),
+    disk must show corruption detections."""
+    from dml_tpu.observability import METRICS
+
+    def ctr(name):
+        return METRICS.snapshot()["counters"].get(name, 0.0)
+
+    plan = scenario_plan(family, seed)
+    assert [e.to_dict() for e in plan.events] == [
+        e.to_dict() for e in scenario_plan(family, seed).events
+    ]
+    failures0 = ctr("cluster_node_failures_total")
+    corrupt0 = ctr("store_corruption_detected_total")
+    base = 23600 + 40 * seed + 200 * list(SCENARIO_FAMILIES).index(family)
+    report = await chaos.run_plan(
+        plan, base_port=base, root=str(tmp_path / "soak")
+    )
+    assert report.ok, (family, seed, report.invariants.failures)
+    checks = report.invariants.checks
+    if family == "fuzz":
+        assert checks["fuzz"]["malformed_dropped"] > 0
+    if family == "dns":
+        assert checks["dns"]["introducer"] == checks["leader"]["leader"]
+        assert report.failover_recovery_s  # the mid-outage kill bit
+    if family == "skew":
+        # the skewed-ahead crash was DETECTED (cleaned), not masked
+        assert ctr("cluster_node_failures_total") > failures0
+    if family == "disk":
+        assert ctr("store_corruption_detected_total") > corrupt0
+
+
+async def test_dns_state_loss_after_failover_is_retaught(tmp_path):
+    """Review-found gap: after a failover completes and the new
+    leader's DNS update ACKs, a DNS that later restarts WITH STATE
+    LOSS serves its stale static default (the dead ex-leader). A
+    one-shot registration never fixes it; the leader's standing
+    re-assert loop must re-teach the reborn DNS unprompted."""
+    async with _cluster(4, 23550, tmp_path) as c:
+        old_leader = c.resolve_target("leader")
+        await c.crash_node(old_leader)
+        await c.wait_for(c.converged, 20.0, "failover")
+        new_leader = c.leader_uname()
+        assert new_leader != old_leader
+        # let the new leader's registration ACK land
+        await c.wait_for(
+            lambda: c.dns.current_introducer == new_leader, 10.0,
+            "post-failover DNS registration",
+        )
+        await c.crash_dns()
+        await c.restart_dns()
+        # state loss: the reborn DNS defaults to the full-table
+        # election winner — the node we just killed
+        assert c.dns.current_introducer == old_leader
+        await c.wait_for(
+            lambda: c.dns.current_introducer == new_leader, 15.0,
+            "re-assert after DNS state loss",
+        )

@@ -46,6 +46,13 @@ def test_chaos_verb_dry_run_and_plan_replay(tmp_path, capsys):
     # replaying the dumped plan dry prints the identical schedule
     out2 = run_ok(["chaos", "run", "--plan", str(dump), "--dry-run"])
     assert out.split("plan written")[0] == out2
+    # every adversarial family has a one-flag repro command
+    for fam, signature in (("asym", "partition_asym"), ("disk", "disk_corrupt"),
+                           ("dns", "dns_crash"), ("skew", "skew"),
+                           ("fuzz", "fuzz")):
+        out3 = run_ok(["chaos", "run", "--seed", "2",
+                       "--scenario", fam, "--dry-run"])
+        assert signature in out3 and f"{fam}-2" in out3
     with pytest.raises(SystemExit) as e:
         main(["chaos", "bogus-verb"])
     assert e.value.code != 0

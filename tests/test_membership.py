@@ -121,3 +121,78 @@ def test_unknown_nodes_ignored():
     spec, (a, *_), clock = make()
     a.merge({"rogue:9999": (clock.t + 100, ALIVE)})
     assert not a.is_alive("rogue:9999")
+
+
+# ---------------- clock skew (chaos seam + future-ts clamp) ----------------
+
+def test_clock_offset_skews_minted_timestamps():
+    spec, (a, b, *_), clock = make()
+    b.clock_offset = 50.0
+    b.heartbeat_self()
+    ts, status = b.snapshot()[b.me.unique_name]
+    assert ts == clock.t + 50.0 and status == ALIVE
+
+
+def test_future_gossip_clamped_so_skew_cannot_mask_a_real_failure():
+    """A node whose clock runs far ahead mints future-dated ALIVE
+    entries. Unclamped, those entries outrank every local SUSPECT mark
+    until the observers' clocks catch up — a dead skewed node would
+    stay 'alive' for the full skew. The merge clamp bounds the extra
+    eviction delay to max_future_skew (default cleanup_time)."""
+    spec, (a, b, c, *_), clock = make()
+    skew = 100.0  # >> cleanup_time (10)
+    b.clock_offset = skew
+    b.heartbeat_self()
+    future_gossip = b.snapshot()
+    a.merge(future_gossip)
+    c.merge(future_gossip)
+    ts_a, _ = a.snapshot()[b.me.unique_name]
+    assert ts_a <= clock.t + spec.timing.cleanup_time  # ingest-clamped
+    # b dies; a's failure detector reports missed ACKs
+    clock.advance(spec.timing.cleanup_time + 1)
+    a.suspect(b.me.unique_name)
+    # circulating SECOND-HAND gossip (c's stored, clamped entry) must
+    # not resurrect the corpse...
+    a.merge({b.me.unique_name: c.snapshot()[b.me.unique_name]})
+    assert not a.is_alive(b.me.unique_name)
+    # ...and cleanup evicts on schedule
+    clock.advance(spec.timing.cleanup_time + 1)
+    assert b.me.unique_name in a.cleanup()
+
+
+def test_unclamped_future_gossip_would_mask_the_failure():
+    """The counterfactual the clamp exists for: with clamping disabled
+    the dead skewed node's future entry beats the SUSPECT mark and the
+    failure is masked."""
+    spec, (a, b, c, *_), clock = make()
+    for m in (a, b, c):
+        m.max_future_skew = float("inf")
+    b.clock_offset = 100.0
+    b.heartbeat_self()
+    future_gossip = b.snapshot()
+    a.merge(future_gossip)
+    c.merge(future_gossip)
+    clock.advance(spec.timing.cleanup_time + 1)
+    a.suspect(b.me.unique_name)
+    a.merge({b.me.unique_name: c.snapshot()[b.me.unique_name]})
+    assert a.is_alive(b.me.unique_name)  # masked: resurrection won
+
+
+def test_merge_skips_garbled_byzantine_entries():
+    """Junk gossip entries (fuzzed datagrams that parse as JSON) are
+    skipped individually; well-formed entries in the same payload
+    still merge."""
+    spec, (a, b, *_), clock = make()
+    clock.advance(1)
+    b.heartbeat_self()
+    good = b.snapshot()[b.me.unique_name]
+    a.merge({
+        b.me.unique_name: good,
+        spec.nodes[2].unique_name: "not-a-pair",
+        spec.nodes[3].unique_name: 17,
+        spec.nodes[4].unique_name: (clock.t, 99),  # unknown status
+    })
+    assert a.is_alive(b.me.unique_name)
+    assert not a.is_alive(spec.nodes[2].unique_name)
+    assert not a.is_alive(spec.nodes[3].unique_name)
+    assert not a.is_alive(spec.nodes[4].unique_name)

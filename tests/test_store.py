@@ -160,3 +160,105 @@ async def test_data_plane_put_get_replicate(tmp_path):
     finally:
         for dp in (a, b, client):
             await dp.stop()
+
+
+# ---------------- durability + integrity (local store) ----------------
+
+def test_atomic_write_leaves_no_temp_debris(tmp_path):
+    """Crash-safe writes: data lands via temp file + fsync + atomic
+    rename, the checksum sidecar is durable BEFORE the version becomes
+    visible, and no .tmp files survive a completed put."""
+    import os
+
+    root = str(tmp_path / "store")
+    s = LocalStore(root)
+    s.put_bytes("a.bin", b"payload")
+    files = sorted(os.listdir(root))
+    assert not any(".tmp" in f for f in files), files
+    assert "a.bin_version1" in files and "a.bin_version1.sum" in files
+    # sidecars are invisible to the inventory
+    assert s.inventory() == {"a.bin": [1]}
+    assert LocalStore(root).inventory() == {"a.bin": [1]}
+
+
+def test_corruption_detected_quarantined_and_evicted(tmp_path):
+    """A bit-flipped on-disk version fails its checksum on read: the
+    read raises CorruptionError, the version leaves the inventory (so
+    the next re-report drops it and repair re-copies), and the bytes
+    move aside as forensics."""
+    import os
+
+    import pytest
+
+    from dml_tpu.cluster.store import CorruptionError
+
+    root = str(tmp_path / "store")
+    s = LocalStore(root)
+    s.put_bytes("f.bin", b"good bytes")
+    path = s.get_path("f.bin", 1)
+    with open(path, "r+b") as f:
+        first = f.read(1)
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]))
+    with pytest.raises(CorruptionError):
+        s.get_bytes("f.bin")
+    assert s.corruption_detected == 1
+    assert s.inventory() == {}
+    assert os.path.exists(path + ".corrupt")
+    # a restart scan does not resurrect the quarantined version
+    assert LocalStore(root).inventory() == {}
+
+
+def test_disk_fault_seeded_write_and_read_faults(tmp_path):
+    """The DiskFault seam: seeded, reproducible failing writes (disk
+    full -> ENOSPC, nothing written) and corrupted reads (detected by
+    the checksum, version quarantined)."""
+    import errno
+
+    import pytest
+
+    from dml_tpu.cluster.store import CorruptionError, DiskFault
+
+    s = LocalStore(str(tmp_path / "store"))
+    s.fault = DiskFault(seed=3, write_fail_pct=100.0)
+    with pytest.raises(OSError) as ei:
+        s.put_bytes("w.bin", b"x")
+    assert ei.value.errno == errno.ENOSPC
+    assert s.inventory() == {}
+    s.fault = None
+    s.put_bytes("r.bin", b"healthy")
+    s.fault = DiskFault(seed=4, corrupt_pct=100.0)
+    with pytest.raises(CorruptionError):
+        s.get_bytes("r.bin")
+    s.fault = None
+    # same-seed fault streams are identical
+    a = DiskFault(seed=9, write_fail_pct=40.0)
+    b = DiskFault(seed=9, write_fail_pct=40.0)
+    assert [a.write_fails() for _ in range(100)] == [
+        b.write_fails() for _ in range(100)
+    ]
+    with pytest.raises(ValueError):
+        DiskFault(write_fail_pct=101)
+
+
+@pytest.mark.asyncio
+async def test_data_plane_refuses_corrupt_replica(tmp_path):
+    """A fetch from a replica whose copy rotted reports 'not found'
+    (the client falls through to the next replica) and the serving
+    store quarantines the bad version."""
+    src = LocalStore(str(tmp_path / "src"))
+    src.put_bytes("x.bin", b"content")
+    plane = DataPlane(src, port=0)
+    await plane.start()
+    try:
+        addr = ("127.0.0.1", plane.port)
+        path = src.get_path("x.bin", 1)
+        with open(path, "r+b") as f:
+            f.seek(0)
+            f.write(b"\x00")
+        with pytest.raises(FileNotFoundError):
+            await plane.fetch_from_store(addr, "x.bin")
+        assert src.corruption_detected == 1
+        assert src.inventory() == {}
+    finally:
+        await plane.stop()

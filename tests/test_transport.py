@@ -47,3 +47,64 @@ async def test_drop_injection_counts():
     a.send(msg, ("127.0.0.1", 9))
     assert a.packets_dropped == 1 and a.packets_sent == 0
     a.close()
+
+
+@pytest.mark.asyncio
+async def test_inbound_filter_directional_drop():
+    """The directional seam: an inbound filter on B's ear drops A's
+    datagrams while B->A still delivers — one-way link loss, which
+    the outbound-only partition filter cannot represent."""
+    a = await UdpTransport.bind("127.0.0.1", 0)
+    b = await UdpTransport.bind("127.0.0.1", 0)
+    try:
+        a_port = a._transport.get_extra_info("sockname")[1]
+        b_port = b._transport.get_extra_info("sockname")[1]
+        b.inbound_filter = lambda addr: addr[1] == a_port
+        a.send(Message("x:1", MsgType.PING, {"i": 1}), ("127.0.0.1", b_port))
+        b.send(Message("x:2", MsgType.PING, {"i": 2}), ("127.0.0.1", a_port))
+        got, _ = await asyncio.wait_for(a.recv(), 2)
+        assert got.data["i"] == 2  # B -> A open
+        await asyncio.sleep(0.1)
+        assert b._queue.empty()  # A -> B deaf
+        assert b.packets_dropped_inbound == 1
+        b.inbound_filter = None
+        a.send(Message("x:1", MsgType.PING, {"i": 3}), ("127.0.0.1", b_port))
+        got, _ = await asyncio.wait_for(b.recv(), 2)
+        assert got.data["i"] == 3  # healed
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.asyncio
+async def test_malformed_datagrams_dropped_and_counted():
+    """Byzantine wire input dies at the transport boundary, counted by
+    transport_malformed_dropped_total — never queued for dispatch."""
+    from dml_tpu.observability import METRICS
+
+    t = await UdpTransport.bind("127.0.0.1", 0)
+    try:
+        before = t.malformed_dropped
+        ctr_before = METRICS.snapshot()["counters"].get(
+            "transport_malformed_dropped_total", 0.0
+        )
+        good = Message("x:1", MsgType.PING, {}).pack()
+        junk = [
+            good[:5],                    # truncated mid-header
+            b"\x00" * 16,                # wrong magic
+            good + b"extra",             # length mismatch
+            b"\xff" * 200,               # garbage
+        ]
+        for frame in junk:
+            t.datagram_received(frame, ("127.0.0.1", 9))
+        t.datagram_received(good, ("127.0.0.1", 9))
+        assert t.malformed_dropped - before == len(junk)
+        ctr_after = METRICS.snapshot()["counters"][
+            "transport_malformed_dropped_total"
+        ]
+        assert ctr_after - ctr_before == len(junk)
+        got, _ = await asyncio.wait_for(t.recv(), 2)
+        assert got.type == MsgType.PING  # the well-formed one survived
+        assert t._queue.empty()
+    finally:
+        t.close()
