@@ -1,9 +1,12 @@
 """Bench matrix for the TPU serving stack.
 
-Output protocol (VERDICT r4 item 1): one compact JSON line per section
-AS IT COMPLETES (so a mid-run kill leaves every finished measurement in
-the stdout tail), then the combined artifact as the FINAL line with the
-summary as its last key. A global wall budget (default 1,400 s hard
+Output protocol (VERDICT r4 item 1 + r5 item 3): one compact JSON line
+per section AS IT COMPLETES (so a mid-run kill leaves every finished
+measurement in the stdout tail), then the combined artifact line with
+the summary as its last key, then a FINAL standalone compact summary
+line (<1,500 chars, ``bench_summary_v1``) that survives the driver's
+2,000-char stdout tail — the driver's structured parse reads it, and
+parity_table/claim_check accept either form. A global wall budget (default 1,400 s hard
 cap, `DML_TPU_BENCH_BUDGET_S`) skips any section whose cold-cache
 estimate would overrun it rather than running into the driver's
 timeout; SIGTERM/SIGINT jump straight to the final combined print.
@@ -59,11 +62,11 @@ class _Interrupted(BaseException):
 SECTION_EST_S = {
     "models": 800.0,
     "dual_model_c4": 120.0,
-    "cluster_serving": 150.0,
+    "cluster_serving": 210.0,  # + cache-matched static + adaptive serves
     "lm": 450.0,
-    "cluster_lm_serving": 150.0,
+    "cluster_lm_serving": 210.0,  # + >=15 s steady-state refill phase
     "chaos": 180.0,  # 2 soak seeds + 5 adversarial scenario families
-    "train": 500.0,
+    "train": 750.0,  # + b64/b128/grad-accum sweep points
     "pallas_on_device": 200.0,
     "ring_vs_ulysses": 60.0,
     "imagenet_parity": 30.0,
@@ -617,22 +620,35 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
                 assert done["total_queries"] == n
                 return time.monotonic() - t0
 
-            # depth-1 reference run: the reference's serialize-per-batch
-            # worker loop (download -> infer, worker.py:518-537). Then
-            # the pipelined (depth 2) run: prepare + device dispatch of
-            # batch N+1 overlap batch N's drain — through a remoted
-            # chip that blocking per-batch round-trip is the
-            # bottleneck, so this is where dispatch pipelining shows a
-            # measured win (VERDICT r3 item 5).
+            # Four serves, VERDICT r5 item 2's cure. (1) depth-1 with
+            # the cache OFF: the reference-faithful serial loop, the
+            # historical qps_unpipelined point. (2)+(3) BOTH static
+            # depths forced with the decode cache ON — the SAME
+            # configuration the adaptive run gets, so (4) adaptive vs
+            # best-static is a pure depth-choice comparison: with the
+            # cache only on the adaptive side, its savings would mask
+            # a wrong depth commit and the claim_check floor could
+            # never fire. Run (2) first so the cache's one-time cold
+            # fill (32 files) is paid before the static comparison.
             for _, _, j in stack:
-                j.scheduler.pipeline_depth = 1
+                j.set_pipeline_depth(1)
                 j.decode_cache_bytes = 0  # reference-faithful serial run
-            wall_d1 = await timed_job(model, n_q)
-            for _, _, j in stack:
-                j.scheduler.pipeline_depth = 2
-            wall_cold = await timed_job(model, n_q)
+            wall_d1_nocache = await timed_job(model, n_q)
             for _, _, j in stack:
                 j.decode_cache_bytes = 256 << 20
+            wall_d1 = await timed_job(model, n_q)
+            for _, _, j in stack:
+                j.set_pipeline_depth(2)
+            wall_d2 = await timed_job(model, n_q)
+            for _, _, j in stack:
+                j.set_pipeline_depth(None)  # adaptive (fresh controller)
+                if j.depth_ctl is not None:
+                    # probe sized to the job: two phases of 2 counted
+                    # ACKs (+ per-worker transition discards) commit
+                    # well inside the 16-batch serve, so the artifact
+                    # records a full cycle
+                    j.depth_ctl.probe_batches = 2
+                    j.depth_ctl.min_probe_backlog = 4
                 j.batch_timing.clear()  # breakdown = final run only
             wall = await timed_job(model, n_q)
             leader = next(
@@ -640,6 +656,7 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
             )
             hits = sum(j.decode_cache_hits for _, _, j in stack)
             misses = sum(j.decode_cache_misses for _, _, j in stack)
+            wall_best_static = min(wall_d1, wall_d2)
             out["cluster_serving"] = {
                 "nodes": 4,
                 "input_source": source,
@@ -650,25 +667,42 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
                 "queries": n_q,
                 "wall_s": round(wall, 2),
                 "qps_end_to_end": round(n_q / wall, 1),
-                "qps_pipelined_cold_cache": round(n_q / wall_cold, 1),
-                "qps_unpipelined": round(n_q / wall_d1, 1),
-                "pipelining_speedup": round(wall_d1 / wall_cold, 2),
+                "qps_unpipelined": round(n_q / wall_d1_nocache, 1),
+                "qps_depth1_static": round(n_q / wall_d1, 1),
+                "qps_pipelined_static": round(n_q / wall_d2, 1),
+                # what the decode cache alone buys at depth 1
+                "decode_cache_speedup": round(wall_d1_nocache / wall_d1, 2),
+                # what forcing overlap does on THIS link, cache-matched
+                # (r4 won 1.47-1.57x congested; r5 lost 0.91x/0.85x)
+                "pipelining_speedup_static": round(wall_d1 / wall_d2, 2),
+                # the serving ratio that must never sit below ~1.0:
+                # adaptive vs the better forced static, all three runs
+                # in the identical cache configuration
+                "pipelining_speedup": round(wall_best_static / wall, 2),
+                # the probe-and-commit verdict the serve ran under:
+                # chosen depth, per-phase probe rates, trigger, and
+                # the drift signature it is now watching
+                "adaptive": leader[2].depth_controller_stats(),
                 "decode_cache_hit_rate": round(hits / max(hits + misses, 1), 3),
                 # where each batch's wall time went, from ACK-carried
                 # worker timings (VERDICT r2 item 9)
                 "breakdown": leader[2].breakdown_stats(),
                 "note": "full stack: UDP control plane + SDFS-replicated "
                         "inputs + host JPEG decode + engine on chip. "
-                        "qps_unpipelined serializes fetch->decode->infer "
-                        "per batch with no decode cache (the reference "
-                        "worker loop, worker.py:518-537); "
-                        "qps_pipelined_cold_cache adds depth-2 worker "
-                        "pipelining (batch N+1's fetch+decode+dispatch "
-                        "overlaps batch N's in-flight inference); "
-                        "qps_end_to_end additionally serves repeated "
-                        "immutable store objects from the decoded-input "
-                        "cache (the job wrap-around-samples 32 files, "
-                        "reference worker.py:188-245)",
+                        "qps_unpipelined forces depth 1 with the decode "
+                        "cache off (the reference worker loop, "
+                        "worker.py:518-537); qps_depth1_static / "
+                        "qps_pipelined_static force depths 1 / 2 with "
+                        "the cache ON — the same configuration the "
+                        "ADAPTIVE run gets, so pipelining_speedup "
+                        "(adaptive vs the better static) is a pure "
+                        "depth-choice ratio and < 1.0 beyond probe "
+                        "noise means the controller chose wrong. "
+                        "qps_end_to_end is the adaptive product path: "
+                        "the coordinator probes both depths on the "
+                        "job's first batches and commits to the "
+                        "measured winner (the job wrap-around-samples "
+                        "32 files, reference worker.py:188-245)",
             }
 
             # throughput variant: batch 128 amortizes the per-batch
@@ -785,7 +819,8 @@ def _bench_cluster_serving(engine, out, *, model="ResNet50",
 
 
 def _bench_cluster_lm(out, *, n_prompts=64, new_tokens=32, base_port=28821,
-                      lm_overrides=None):
+                      lm_overrides=None, steady_s=16.0, ramp_s=2.0,
+                      steady_sample_dt=1.0):
     """Distributed LM serving END-TO-END (net-new subsystem, r3
     PARITY row; device-level LM numbers live in `lm.*`): prompt-token
     files in the replicated store, `submit_job` through the SAME
@@ -796,8 +831,23 @@ def _bench_cluster_lm(out, *, n_prompts=64, new_tokens=32, base_port=28821,
     `cluster_serving` for sequences (the reference has no sequence
     serving at all, SURVEY §0). Uses the bench LM config (198M,
     GQA-4, bf16) so the gap to the device-level decode rate is
-    directly readable."""
+    directly readable.
+
+    Two phases (VERDICT r5 item 4): the TRANSIENT comparison
+    (interleaved serial/overlap pairs of one n_prompts job — ~1 s of
+    wall, mostly prefill/placement) and a STEADY-STATE run: jobs
+    continuously refilled for >= `steady_s` seconds of decode past a
+    `ramp_s` warm-up window, with a tok/s-vs-wall curve sampled every
+    `steady_sample_dt` s — so the transient figure either rises
+    toward the device CB ceiling under sustained load or the curve
+    shows exactly where the control plane flattens it."""
     import asyncio
+
+    # In-section link-weather probe (same discipline as
+    # cluster_serving, VERDICT r5): the LM section's rates must carry
+    # the tunnel conditions THEY ran under. Probed before the event
+    # loop starts — the blocking device round-trips would stall SWIM.
+    weather = _probe_tunnel()
 
     async def run():
         import numpy as np
@@ -903,6 +953,9 @@ def _bench_cluster_lm(out, *, n_prompts=64, new_tokens=32, base_port=28821,
                     "nodes": 4,
                     "prompts": n_prompts,
                     "new_tokens_per_prompt": new_tokens,
+                    # measured at section entry — the conditions these
+                    # rates actually ran under (VERDICT r5)
+                    "link_weather_at_section": weather,
                     "mode_chosen": mode_chosen,
                     "wall_s": round(wall, 2),
                     "prompts_per_s": round(n_prompts / wall, 2),
@@ -932,6 +985,92 @@ def _bench_cluster_lm(out, *, n_prompts=64, new_tokens=32, base_port=28821,
                             "generate() per prompt (LMServer "
                             "batching-exactness contract)",
                 }
+
+                # ---- steady state: continuous refill (VERDICT r5
+                # item 4). The transient job above is ~1 s of wall,
+                # mostly prefill/placement — it cannot distinguish "the
+                # stack sustains much more" from "a control-plane
+                # ceiling". Keep 2 jobs in flight in the chosen mode
+                # for >= steady_s past the ramp, sample the backend's
+                # delivered-token count on a fixed cadence, and report
+                # the post-ramp rate plus the tok/s-vs-wall curve.
+                be.overlap = mode_chosen == "overlap"
+                t0 = time.monotonic()
+                samples = [(0.0, be.decode_tokens_total())]
+                inflight: set = set()
+                jobs_launched = 0
+                jobs_done = [0]
+
+                async def one_job():
+                    job_id = await client_jobs.submit_job(
+                        "BenchLM", n_prompts
+                    )
+                    await client_jobs.wait_job(job_id, timeout=600.0)
+                    jobs_done[0] += 1
+
+                def ramp_edge():
+                    """First sample at/after the ramp cutoff, or None
+                    while the ramp is still running."""
+                    for s in samples:
+                        if s[0] >= ramp_s:
+                            return s
+                    return None
+
+                # refill until the POST-RAMP window itself covers
+                # steady_s — a fixed wall deadline would undershoot by
+                # sampling jitter + event-loop overshoot, and the
+                # window is the number claim_check holds to >= 15 s
+                while True:
+                    lo = ramp_edge()
+                    if lo is not None and (
+                        samples[-1][0] - lo[0] >= steady_s
+                    ):
+                        break
+                    while len(inflight) < 2:
+                        t = asyncio.ensure_future(one_job())
+                        inflight.add(t)
+                        t.add_done_callback(inflight.discard)
+                        jobs_launched += 1
+                    await asyncio.sleep(steady_sample_dt)
+                    samples.append(
+                        (time.monotonic() - t0, be.decode_tokens_total())
+                    )
+                if inflight:
+                    await asyncio.gather(
+                        *list(inflight), return_exceptions=True
+                    )
+
+                (t_lo, c_lo) = ramp_edge()
+                (t_hi, c_hi) = samples[-1]
+                window = max(t_hi - t_lo, 1e-9)
+                curve = []
+                for (ta, ca), (tb, cb) in zip(samples, samples[1:]):
+                    dt = tb - ta
+                    if dt > 1e-9:
+                        curve.append(
+                            [round(tb, 2), round((cb - ca) / dt, 1)]
+                        )
+                out["cluster_lm_serving"]["steady_state"] = {
+                    "mode": mode_chosen,
+                    "target_steady_s": steady_s,
+                    "ramp_excluded_s": round(t_lo, 2),
+                    "measured_steady_s": round(window, 2),
+                    "gen_tok_per_s_steady": round((c_hi - c_lo) / window, 1),
+                    "tokens_post_ramp": int(c_hi - c_lo),
+                    "jobs_launched": jobs_launched,
+                    "jobs_completed": jobs_done[0],
+                    "prompts_per_job": n_prompts,
+                    "concurrent_jobs": 2,
+                    # [wall_s, tok/s over the preceding sample
+                    # interval] — ramp included so the climb (and any
+                    # later sag) is visible, post-ramp rate excludes it
+                    "curve_tok_per_s": curve,
+                    "note": "continuous refill: 2 jobs kept in flight "
+                            "in the transient winner's mode; rate = "
+                            "decode-token counter delta over the post-"
+                            "ramp window, curve sampled every "
+                            f"{steady_sample_dt:g}s (ramp included)",
+                }
         finally:
             be.close()
 
@@ -940,14 +1079,20 @@ def _bench_cluster_lm(out, *, n_prompts=64, new_tokens=32, base_port=28821,
 
 def _bench_train(engine, out, *, cnn_model="ResNet50", cnn_batch=32,
                  cnn_hw=224, cnn_chains=(5, 45), phase_chains=((10, 80), (6, 46)),
+                 cnn_sweep=((64, 1, (4, 28)), (128, 1, (3, 13)),
+                            (128, 4, (3, 13))),
                  lm_dims=None, lm_chains=(3, 18), mesh=None):
     """Training-step throughput on the chip (VERDICT r3 item 6): the
     training subsystem (parallel/train.py, parallel/long_context.py)
     had correctness tests and a multichip dryrun but no driver-visible
-    on-chip perf number. Two rows:
+    on-chip perf number. Rows:
 
     - ResNet50 train step (fwd+bwd+SGD update) at b32, img/s + MFU
-      (XLA's own cost analysis counts the fwd+bwd FLOPs);
+      (XLA's own cost analysis counts the fwd+bwd FLOPs), plus a
+      batch-scaling sweep (`cnn_sweep`: (batch, grad_accum, chains)
+      points — b64/b128 and one grad-accum point) so the "b32 MFU is
+      structural" claim is tested against batch scaling instead of
+      argued from one point (VERDICT r5 item 7);
     - the bench LM (198M params, GQA-4) train step at T=2048, tok/s.
 
     Slope-timed over a lax.scan that CARRIES the train state and
@@ -1108,6 +1253,53 @@ def _bench_train(engine, out, *, cnn_model="ResNet50", cnn_batch=32,
     }
     del tr
     gc.collect()
+
+    # -- batch scaling (VERDICT r5 item 7): b64/b128 + one grad-accum
+    #    point next to the b32 row, so "the b32 MFU is structural" is
+    #    tested against batch scaling rather than asserted from one
+    #    point. grad_accum splits the batch into micro-batches under a
+    #    lax.scan — same effective batch, ~accum-fold lower activation
+    #    memory — so its row shows what the memory-saving config costs
+    #    in step time at the same FLOPs.
+    for b, ga, chains in cnn_sweep:
+        tr_b = Trainer(cnn_model, mesh, batch_size=b, grad_accum=ga)
+        imgs_b = jnp.asarray(rng.randint(
+            0, 255, (b, cnn_hw, cnn_hw, 3), np.uint8
+        ))
+        labels_b = jnp.asarray(
+            rng.randint(0, 1000, (b,)).astype(np.int32)
+        )
+
+        def chain_b(n, state, imgs, labels, _tr=tr_b):
+            def body(i, carry):
+                st, acc = carry
+                st, m = _tr._step(st, imgs, labels)
+                return (st, acc + m["loss"])
+
+            _, acc = jax.lax.fori_loop(
+                0, n, body, (state, jnp.float32(0))
+            )
+            return acc
+
+        st_b = dynamic_slope_stats(
+            chain_b, (tr_b.state, imgs_b, labels_b), chains, 5
+        )
+        secs_b = st_b["median"]
+        fl_b = _flops_of(tr_b._step, tr_b.state, imgs_b, labels_b)
+        key = f"{cnn_model.lower()}_b{b}" + (f"_ga{ga}" if ga > 1 else "")
+        train[key] = {
+            "img_per_s": round(b / secs_b, 1),
+            "img_per_s_range": [round(b / st_b["max"], 1),
+                                round(b / st_b["min"], 1)],
+            "step_ms": round(secs_b * 1e3, 3),
+            "mfu_fwd_bwd": (
+                round(fl_b / secs_b / peak, 4) if fl_b else None
+            ),
+        }
+        if ga > 1:
+            train[key]["grad_accum"] = ga
+        del tr_b, imgs_b, labels_b
+        gc.collect()
 
     from dml_tpu.parallel.long_context import LongContextLM
 
@@ -1855,7 +2047,16 @@ def main() -> None:
         "tunnel_up_mbps": g("tunnel", "upload_mb_per_s"),
         "cluster_qps": g("cluster_serving", "qps_end_to_end"),
         "cluster_qps_unpipelined": g("cluster_serving", "qps_unpipelined"),
+        "cluster_qps_pipelined_static": g(
+            "cluster_serving", "qps_pipelined_static"),
+        # adaptive vs the BETTER forced static — the never-below-1 one
         "cluster_pipelining": g("cluster_serving", "pipelining_speedup"),
+        "cluster_pipelining_static": g(
+            "cluster_serving", "pipelining_speedup_static"),
+        "cluster_depth": g("cluster_serving", "adaptive", "depth"),
+        "cluster_readback_ms": g(
+            "cluster_serving", "link_weather_at_section",
+            "readback_128kb_ms"),
         "cluster_qps_b128": g("cluster_serving_b128", "qps_end_to_end"),
         "fail_completed": g("cluster_serving_failure", "completed"),
         "fail_detect_s": g("cluster_serving_failure", "detect_to_requeue_s"),
@@ -1883,8 +2084,14 @@ def main() -> None:
         },
         "cb_gain": g("lm", "continuous_batching", "batching_gain_8_vs_1"),
         "cluster_lm_tok_s": g("cluster_lm_serving", "gen_tok_per_s_end_to_end"),
+        "cluster_lm_steady_tok_s": g(
+            "cluster_lm_serving", "steady_state", "gen_tok_per_s_steady"),
+        "cluster_lm_steady_s": g(
+            "cluster_lm_serving", "steady_state", "measured_steady_s"),
         "train_img_s": g("train", "resnet50_b32", "img_per_s"),
         "train_mfu": g("train", "resnet50_b32", "mfu_fwd_bwd"),
+        "train_mfu_b128": g("train", "resnet50_b128", "mfu_fwd_bwd"),
+        "train_mfu_b128_ga4": g("train", "resnet50_b128_ga4", "mfu_fwd_bwd"),
         "train_lm_tok_s": g("train", "lm_198m_t2048", "tok_per_s"),
         "pallas_parity": g("pallas_on_device", "parity_pass"),
         "imagenet_parity": (
@@ -1922,6 +2129,64 @@ def main() -> None:
         "metrics": metrics_block,
         "summary": summary,  # keep LAST: must survive the driver tail
     }, default=str), flush=True)
+    # Final STANDALONE compact summary line (VERDICT r5 item 3): the
+    # driver keeps only a 2,000-char stdout tail and parses it — the
+    # one giant artifact line above has failed that parse in all five
+    # rounds (`parsed: null`). This line is < 1,500 chars by
+    # construction (keys are dropped least-essential-first until it
+    # fits), so the tail always ends with a complete, parseable JSON
+    # object. parity_table.load_bench / claim_check accept either form.
+    print(compact_summary_line(hl, device_str, baseline_qps, summary),
+          flush=True)
+
+
+#: summary keys dropped (in order) until the compact line fits its
+#: budget — least headline-worthy first. Everything always survives in
+#: the full artifact line; this only bounds the driver-tail form.
+_COMPACT_DROP_ORDER = (
+    "section_wall_s", "kv_heads_tok_s", "chaos_scenarios_ok",
+    "lm_tok_s", "fail_detect_s", "fail_completed", "cluster_readback_ms",
+    "chaos_malformed_dropped", "train_mfu_b128_ga4", "opt_batch",
+    "inception_mfu_b128", "b4_mfu_b128", "headline_qps_range",
+)
+
+COMPACT_SUMMARY_BUDGET = 1500
+
+
+def compact_summary_line(hl, device_str, baseline_qps, summary) -> str:
+    """One JSON line, < COMPACT_SUMMARY_BUDGET chars, self-identifying
+    via ``bench_summary_v1`` so downstream tools can find it in a
+    truncated stdout tail."""
+    doc = {
+        "bench_summary_v1": True,
+        "metric": "ResNet50 b32 inference throughput per chip",
+        "value": hl.get("qps"),
+        "unit": "queries/sec",
+        "vs_baseline": (
+            round(hl["qps"] / baseline_qps, 2) if hl.get("qps") else None
+        ),
+        "device": device_str,
+        "summary": dict(summary),
+    }
+    line = json.dumps(doc, separators=(",", ":"), default=str)
+    for key in _COMPACT_DROP_ORDER:
+        if len(line) <= COMPACT_SUMMARY_BUDGET:
+            break
+        doc["summary"].pop(key, None)
+        line = json.dumps(doc, separators=(",", ":"), default=str)
+    if len(line) > COMPACT_SUMMARY_BUDGET:  # last resort: never exceed
+        # cluster_lm_tok_s and cluster_lm_steady_s MUST survive with
+        # cluster_lm_steady_tok_s: claim_check's summary-only
+        # steady-window gate keys off their presence together
+        doc["summary"] = {
+            k: doc["summary"].get(k)
+            for k in ("headline_qps", "cluster_qps", "cluster_pipelining",
+                      "cluster_lm_tok_s", "cluster_lm_steady_tok_s",
+                      "cluster_lm_steady_s", "section_errors",
+                      "sections_skipped")
+        }
+        line = json.dumps(doc, separators=(",", ":"), default=str)
+    return line
 
 
 if __name__ == "__main__":

@@ -74,7 +74,8 @@ job commands (ML inference):
   C5                                current worker->batch assignments
                                     (incl. staged pipeline batches)
   breakdown                         coordinator per-batch wall-time split +
-                                    worker pipeline/decode-cache stats
+                                    adaptive pipeline-depth verdict (chosen
+                                    depth + why) + decode-cache stats
 observability:
   profile metrics [prom|json]       this node's metrics registry — summary
                                     roll-up (default), Prometheus exposition
@@ -306,6 +307,9 @@ class NodeApp:
             print(json.dumps({
                 "per_batch_ms": j.breakdown_stats(),
                 "pipeline_depth": j.pipeline_depth,
+                # adaptive controller: the chosen depth AND why (probe
+                # rates, trigger, drift signature) — or the static pin
+                "depth_controller": j.depth_controller_stats(),
                 "decode_cache": j.decode_cache_stats(),
             }, indent=2))
         else:

@@ -505,10 +505,14 @@ class Node:
                     {"rid": rid, "ok": True, "metrics": tier()},
                 )
                 if i:
+                    # msg.sender is already the unique_name string
+                    # (wire.Message contract) — an attribute access
+                    # here raised AttributeError and turned every
+                    # degraded reply into a handler-failure traceback
                     log.warning(
                         "%s: metrics snapshot over the frame cap, "
                         "degraded to tier %d for %s",
-                        self.me.unique_name, i, msg.sender.unique_name,
+                        self.me.unique_name, i, msg.sender,
                     )
                 return
             except ValueError:

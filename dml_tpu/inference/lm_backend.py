@@ -237,6 +237,14 @@ class LMBackend:
         finishes first."""
         self.driver.stop()
 
+    def decode_tokens_total(self) -> int:
+        """Delivered-token count of THIS backend's server — the
+        steady-state bench samples this on a fixed cadence to build
+        its tok/s-vs-wall curve (the registry's
+        lm_server_decode_tokens_total is process-global and would
+        conflate co-resident servers)."""
+        return int(self.server.tokens_delivered)
+
     def cost_constants(self) -> Dict[str, float]:
         return {
             "load_time": 0.0,

@@ -192,6 +192,10 @@ class LMServer:
         self._pos_dev = jnp.zeros(max_slots, jnp.int32)
         self.rid_vec = np.zeros(max_slots, np.int32)  # slot -> request id
         self._slot_req: List[Optional[_Request]] = [None] * max_slots
+        # per-instance delivered-token count (the registry's
+        # _M_TOKENS is process-global; steady-state measurement wants
+        # THIS server's stream without registry key coupling)
+        self.tokens_delivered = 0
         # placement groups whose first tokens haven't been read back
         # yet: (requests in row order, device [group_rows] tokens —
         # rows past the requests are group padding). Flushed into the
@@ -499,7 +503,9 @@ class LMServer:
         vals = np.asarray(jnp.concatenate([v for _, v in entries]))
         _M_READBACK.observe(time.monotonic() - t0)
         self._distribute_firsts(entries, vals, 0)
-        _M_TOKENS.inc(sum(len(reqs) for reqs, _ in entries))
+        flushed = sum(len(reqs) for reqs, _ in entries)
+        self.tokens_delivered += flushed
+        _M_TOKENS.inc(flushed)
 
     def step(self) -> None:
         """One chunked dispatch: every active slot advances up to
@@ -551,6 +557,7 @@ class LMServer:
             if req.done:
                 self._retire(slot)
         self._place_waiting()
+        self.tokens_delivered += delivered
         _M_TOKENS.inc(delivered)
         _M_STEPS.inc()
         _M_SLOTS.set(sum(1 for r in self._slot_req if r is not None))

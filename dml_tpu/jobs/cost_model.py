@@ -90,6 +90,32 @@ def query_rate(cost: ModelCost, n_workers: int, batch: Optional[int] = None) -> 
     return n_workers * b / t
 
 
+def overlap_headroom(
+    fetch_s: float, decode_s: float, infer_s: float, put_s: float
+) -> float:
+    """Analytic upper bound on the depth-2 worker-pipelining speedup
+    given measured per-batch stage walls.
+
+    Depth-2 staging overlaps batch N+1's prepare (store fetch + host
+    decode) with batch N's in-flight inference; the PUT and residue
+    stay serial. Perfect overlap takes the serial wall
+    ``prep + infer + put`` to ``max(prep, infer) + put``, so the bound
+    is their ratio — ≤ (prep+infer)/max(prep,infer) ≤ 2. A bound near
+    1.0 predicts the overlap state machine cannot pay for itself (the
+    r5 regime: fast link, prep ≪ infer); the DepthController's probe
+    is the measurement this prior is checked against, never a
+    substitute for it.
+    """
+    prep = max(fetch_s + decode_s, 0.0)
+    infer = max(infer_s, 0.0)
+    put = max(put_s, 0.0)
+    serial = prep + infer + put
+    overlapped = max(prep, infer) + put
+    if overlapped <= 0.0 or serial <= 0.0:
+        return 1.0
+    return round(serial / overlapped, 3)
+
+
 def fair_split(
     n_workers: int, cost_a: ModelCost, cost_b: ModelCost
 ) -> Tuple[int, int]:

@@ -44,8 +44,8 @@ from ..cluster.util import BoundedDict, leader_retry, reap_task
 from ..cluster.wire import Message, MsgType
 from ..models.registry import MODEL_REGISTRY, get_model
 from ..observability import METRICS
-from .cost_model import ModelCost
-from .scheduler import Assignment, Batch, Scheduler
+from .cost_model import ModelCost, overlap_headroom
+from .scheduler import Assignment, Batch, DepthController, Scheduler
 
 log = logging.getLogger(__name__)
 
@@ -88,18 +88,25 @@ class JobService:
         infer_backend: Optional[InferBackend] = None,
         image_patterns: Tuple[str, ...] = ("*.jpeg", "*.jpg"),
         engine=None,
-        pipeline_depth: int = 2,
+        pipeline_depth: Optional[int] = None,
     ):
         """`engine` shares one InferenceEngine across co-located
-        services (one weights copy + one compile per model per chip);
-        `pipeline_depth` > 1 turns on depth-2 worker pipelining: the
-        coordinator stages batch N+1 on each busy worker so its
-        store-fetch + host JPEG decode + device dispatch overlap batch
-        N's in-flight inference. The reference's workers serialize
-        download -> infer per batch (worker.py:518-537); through a
-        high-latency device link the blocking per-batch round-trip is
-        the cluster-serving bottleneck, so overlap is where the
-        throughput is."""
+        services (one weights copy + one compile per model per chip).
+
+        `pipeline_depth=None` (default) runs the ADAPTIVE controller:
+        the coordinator probes depth-1 vs depth-2 on real batches at
+        job warmup, commits to the measured winner, and re-probes when
+        the ACK-carried stage walls drift (DepthController — the
+        worker-pipeline analog of `engine.choose_dispatch_mode`).
+        An explicit int pins a STATIC depth: 1 restores the
+        reference's strict one-outstanding-batch worker loop
+        (worker.py:518-537), >1 forces staging batch N+1's store-fetch
+        + host JPEG decode + device dispatch under batch N's in-flight
+        inference. Through a high-latency device link the blocking
+        per-batch round-trip is the cluster-serving bottleneck and
+        overlap wins; on a fast link the overlap state machine can
+        LOSE (r5 measured 0.91×/0.85×) — which is why measured, not
+        assumed, is the default."""
         self.node = node
         self.store = store
         self.image_patterns = image_patterns
@@ -129,7 +136,8 @@ class JobService:
         self.decode_cache_hits = 0
         self.decode_cache_misses = 0
         self.scheduler = Scheduler(costs=self._seed_costs())
-        self.scheduler.pipeline_depth = max(1, int(pipeline_depth))
+        self.depth_ctl: Optional[DepthController] = None
+        self.set_pipeline_depth(pipeline_depth)
         # worker-side execution state: running batches (primary + an
         # early-promoted staged batch draining concurrently, <= depth)
         # and the one staged batch whose prepare runs eagerly
@@ -493,6 +501,38 @@ class JobService:
         owns the knob)."""
         return self.scheduler.pipeline_depth
 
+    def set_pipeline_depth(self, depth: Optional[int]) -> None:
+        """`None` → adaptive (probe-and-commit DepthController, the
+        product default); an int → static depth, controller off (the
+        bench's forced-comparison runs and reference-faithful depth-1
+        use this)."""
+        if depth is None:
+            self.depth_ctl = DepthController()
+            self.scheduler.pipeline_depth = self.depth_ctl.depth
+        else:
+            self.depth_ctl = None
+            self.scheduler.pipeline_depth = max(1, int(depth))
+
+    def depth_controller_stats(self) -> Dict[str, Any]:
+        """CLI `breakdown`: the depth in force and WHY (probe rates,
+        trigger, drift signature) — or the pinned static depth."""
+        if self.depth_ctl is None:
+            return {
+                "mode": "static", "depth": self.scheduler.pipeline_depth,
+            }
+        out = {"mode": "adaptive", **self.depth_ctl.explain()}
+        # analytic prior next to the measurement: the upper bound on
+        # what depth-2 overlap COULD buy given the current stage walls
+        bd = self.breakdown_stats()
+        if bd:
+            out["overlap_headroom_bound"] = overlap_headroom(
+                fetch_s=bd.get("fetch_ms", 0.0) / 1e3,
+                decode_s=bd.get("decode_ms", 0.0) / 1e3,
+                infer_s=bd.get("infer_ms", 0.0) / 1e3,
+                put_s=bd.get("put_ms", 0.0) / 1e3,
+            )
+        return out
+
     def decode_cache_stats(self) -> Dict[str, int]:
         """Worker decoded-input cache counters (operator surface for
         the CLI `breakdown` verb)."""
@@ -577,6 +617,9 @@ class JobService:
                 log.exception("%s: scheduling tick failed", self._me)
 
     def _run_schedule(self) -> None:
+        if self.depth_ctl is not None:
+            queued = sum(len(q) for q in self.scheduler.queues.values())
+            self.scheduler.pipeline_depth = self.depth_ctl.tick(queued)
         assigns = self.scheduler.schedule(self.worker_pool())
         for w, key in self.scheduler.pop_revoked_stages():
             sat = self._staged_at.get(w)
@@ -756,6 +799,16 @@ class JobService:
         sat = self._staged_at.get(msg.sender)
         if sat is not None and sat[0] == (job_id, batch_id):
             del self._staged_at[msg.sender]
+        # freshness BEFORE on_batch_done marks it complete: the depth
+        # controller must see each batch exactly once (a duplicated
+        # ACK — LinkShaper dup injection, re-ACK of a resent task —
+        # counted into a probe phase would inflate that phase's rate
+        # and could flip the commit)
+        st_pre = self.scheduler.jobs.get(job_id)
+        fresh_ack = (
+            st_pre is not None
+            and batch_id not in st_pre.completed_batches
+        )
         done = self.scheduler.on_batch_done(
             msg.sender, job_id, batch_id,
             float(d.get("exec_time", 0.0)), int(d.get("n_images", 0)),
@@ -768,6 +821,16 @@ class JobService:
         if cur is not None and sat is not None and sat[0] == cur.key:
             self._assigned_at[msg.sender] = sat
             del self._staged_at[msg.sender]
+        if self.depth_ctl is not None and fresh_ack:
+            # adaptive depth: fold the ACK (and its stage walls) into
+            # the probe/drift machinery and apply what it decides
+            self.scheduler.pipeline_depth = self.depth_ctl.on_ack(
+                int(d.get("n_images", 0)),
+                fetch=float(d.get("fetch_time", 0.0)),
+                infer=float(d.get("infer_time", 0.0)),
+                put=float(d.get("put_time", 0.0)),
+                worker=msg.sender,
+            )
         if "fetch_time" in d:
             self.batch_timing.append({
                 "model": d.get("model", ""),

@@ -48,10 +48,14 @@ Reference C1–C5 -> registry map
 Beyond the reference (net-new subsystems get the same treatment):
 ``lm_server_*`` (queue wait, prefill dispatch, per-step decode tokens,
 slot occupancy, compile events, readback stalls), ``worker_*``
-(fetch/infer/put stage timings, decode-cache hits), ``cluster_*``
-(SWIM suspicion/failure/false-positive events, alive-node gauge),
-``transport_*`` (datagram + byte counters by message type), and
-``store_*`` (put/get/replication timing and counts).
+(fetch/infer/put stage timings, decode-cache hits),
+``jobs_pipeline_depth`` / ``jobs_depth_*`` (the probe-adaptive
+worker-pipelining controller: depth in force, per-phase probe-rate
+histogram by depth, probe-cycle counters by trigger and aborts),
+``cluster_*`` (SWIM suspicion/failure/false-positive events,
+alive-node gauge), ``transport_*`` (datagram + byte counters by
+message type), and ``store_*`` (put/get/replication timing and
+counts).
 
 Exposition
 ----------

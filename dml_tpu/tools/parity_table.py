@@ -79,10 +79,133 @@ def _mfu_txt(mfu, label="MFU", prefix=" (", suffix=")"):
     return f"{prefix}{mfu * 100:.0f}% {label}{suffix}"
 
 
+def _summary_rows(s: Dict[str, Any]) -> List[List[str]]:
+    """Rows computable from the compact driver summary alone (the
+    artifact of record when only the driver's stdout tail survived).
+    Fewer rows than the full matrix — every cell still traces to the
+    driver capture, which is the point."""
+    rows: List[List[str]] = []
+
+    def row(metric: str, ref: str, ours: str) -> None:
+        rows.append([metric, ref, ours])
+
+    qps = s.get("headline_qps")
+    if isinstance(qps, (int, float)) and qps > 0:
+        row(
+            "ResNet50 steady inference",
+            "250 ms/image (4 q/s/node)",
+            f"≈{1000.0/qps:.3f} ms/image at batch 32 (≈{_num(qps)} "
+            f"q/s/chip{_mfu_txt(s.get('headline_mfu'), prefix=', ', suffix='')})",
+        )
+    if isinstance(s.get("c4_qps"), (int, float)):
+        row(
+            "Dual-model C4 fair-share", "manual 10-VM runs",
+            f"{s['c4_qps']} q/s serving with the probe-chosen "
+            f"'{s.get('c4_mode', 'n/a')}' dispatch "
+            f"({s.get('pipelining', 'n/a')}× vs the reference-shaped "
+            "sync loop)",
+        )
+    if isinstance(s.get("cluster_qps"), (int, float)):
+        depth = s.get("cluster_depth")
+        b128 = s.get("cluster_qps_b128")
+        b128_txt = (
+            f"; b128 {b128} q/s" if isinstance(b128, (int, float)) else ""
+        )
+        if depth is not None:  # r6+ schema: probe-adaptive serving
+            detail = (
+                f"adaptive depth (committed {depth}) — forced "
+                f"statics: depth-1 {s.get('cluster_qps_unpipelined', 'n/a')} "
+                f"q/s / depth-2 "
+                f"{s.get('cluster_qps_pipelined_static', 'n/a')} q/s; "
+                f"adaptive vs best static "
+                f"{s.get('cluster_pipelining', 'n/a')}×"
+            )
+        else:  # r3..r5 schema: static depth-2 pipelining keys
+            detail = (
+                f"serial depth-1 {s.get('cluster_qps_unpipelined', 'n/a')} "
+                f"q/s; static depth-2 pipelining ratio "
+                f"{s.get('cluster_pipelining', 'n/a')}× (cold cache)"
+            )
+        row(
+            "Cluster serving end-to-end (4 nodes, SDFS-replicated "
+            "JPEGs, batch 32)",
+            "≈0.8 q/s/node (25-image task in ~31 s)",
+            f"≈{s['cluster_qps']} q/s through the full stack with "
+            f"{detail}{b128_txt}",
+        )
+    if isinstance(s.get("cluster_lm_tok_s"), (int, float)):
+        steady = s.get("cluster_lm_steady_tok_s")
+        steady_txt = (
+            f"; steady state (≥{_num(s.get('cluster_lm_steady_s', 15))} s "
+            f"refill, ramp excluded) {_num(steady)} tok/s"
+            if isinstance(steady, (int, float)) else ""
+        )
+        row(
+            "Distributed LM serving end-to-end (4 nodes, "
+            "store-replicated prompts)",
+            "— (reference has no sequence serving)",
+            f"{_num(s['cluster_lm_tok_s'])} gen tok/s transient"
+            f"{steady_txt}",
+        )
+    lm_tok = s.get("lm_tok_s")
+    if isinstance(lm_tok, dict) and lm_tok:
+        row(
+            "LM decode by weight form (B=1)", "—",
+            ", ".join(
+                f"{k} {_num(v)} tok/s" for k, v in lm_tok.items()
+                if isinstance(v, (int, float))
+            ),
+        )
+    if isinstance(s.get("cb_gain"), (int, float)):
+        row("Continuous-batching decode (8 vs 1 slots)", "—",
+            f"{s['cb_gain']}× aggregate")
+    if isinstance(s.get("train_img_s"), (int, float)):
+        row(
+            "ResNet50 train step (fwd+bwd+SGD, b32)",
+            "— (reference does no training)",
+            f"{_num(s['train_img_s'])} img/s"
+            + _mfu_txt(s.get("train_mfu"), label="fwd+bwd MFU"),
+        )
+    if isinstance(s.get("train_lm_tok_s"), (int, float)):
+        row(
+            "LM train step (198M, T=2048)",
+            "— (reference does no training)",
+            f"{_num(s['train_lm_tok_s'])} tok/s",
+        )
+    if isinstance(qps, (int, float)) and qps > 0:
+        row("`vs_baseline` (bench.py headline)", "1×",
+            f"≈{_num(qps / 4.0)}×")
+    return rows
+
+
 def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
     """The markdown block, markers included. Missing sections render
     as 'n/a (pending next bench run)' so a schema change degrades the
-    table instead of faking numbers."""
+    table instead of faking numbers. A driver capture recovered as
+    summary-only renders the summary-derived rows and says so."""
+    if bench.get("_summary_only"):
+        rows = _summary_rows(bench.get("summary") or {})
+        lines = [
+            f"<!-- BENCH-TABLE:BEGIN source={source} sha1={sha1} -->",
+            "",
+            f"*Generated by `python -m dml_tpu.tools.parity_table` from "
+            f"`{source}` (sha1 {sha1}) — do not edit by hand; "
+            "tests/test_parity_table.py enforces this.*",
+            "",
+            "*Source is the DRIVER capture's compact summary (the "
+            "artifact of record); per-section detail beyond these rows "
+            "lives in the same-round preview artifact.*",
+            "",
+            "| Metric | Reference (CPU, CS425 VMs) | dml_tpu (1× TPU v5e) |",
+            "|---|---|---|",
+        ]
+        for r in rows:
+            lines.append("| " + " | ".join(r) + " |")
+        if not rows:
+            lines.append("| (driver summary carried no renderable "
+                         "rows) | — | — |")
+        lines += ["", END_MARK]
+        return "\n".join(lines)
     m = bench.get("matrix", bench)
     rows: List[List[str]] = []
 
@@ -177,7 +300,23 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
                 f"{fi.get('wall_s', 'n/a')} s"
             )
         pipe_txt = ""
-        if "qps_unpipelined" in cs:
+        if "adaptive" in cs:  # r6 schema: probe-adaptive depth
+            ad = cs.get("adaptive") or {}
+            d1c = cs.get("qps_depth1_static",
+                         cs.get("qps_unpipelined", "n/a"))
+            pipe_txt = (
+                f" — reference serial loop "
+                f"{cs.get('qps_unpipelined', 'n/a')} q/s; cache-matched "
+                f"forced statics: depth-1 {d1c} / depth-2 "
+                f"{cs.get('qps_pipelined_static', 'n/a')} q/s "
+                f"({cs.get('pipelining_speedup_static', 'n/a')}×); the "
+                f"adaptive controller committed depth "
+                f"{ad.get('depth', 'n/a')} and served "
+                f"{cs.get('qps_end_to_end', 'n/a')} q/s "
+                f"({cs.get('pipelining_speedup', 'n/a')}× vs the "
+                "better static)"
+            )
+        elif "qps_unpipelined" in cs:  # r3..r5 schema: static depth 2
             pipe_txt = (
                 f" — serial worker loop {cs['qps_unpipelined']} q/s → "
                 f"depth-2 pipelined "
@@ -323,23 +462,59 @@ def render_table(bench: Dict[str, Any], source: str, sha1: str) -> str:
 
 
 def load_bench(bench_path: str) -> Dict[str, Any]:
-    """A bench artifact: either the raw ONE-json-line bench.py output
-    (preview files this tool writes tables from) or the driver's
-    wrapper ({"cmd", "rc", "tail", ...}) whose `tail` holds the stdout
-    — possibly truncated, in which case the error says so rather than
-    rendering a silently empty table."""
+    """A bench artifact in any of its shipped forms:
+
+    - the raw bench.py stdout saved as JSON (preview files) — the
+      giant artifact line, parsed whole;
+    - the driver's wrapper ({"cmd", "rc", "tail", ...}) whose 2,000-
+      char `tail` usually truncates the artifact line. Recovery, in
+      preference order: (1) the artifact line survived whole; (2) the
+      bench's final STANDALONE compact summary line
+      (``bench_summary_v1``, emitted since round 6 precisely to
+      survive this tail); (3) the trailing ``summary`` object salvaged
+      from the truncated artifact line (it is the artifact's LAST key
+      by design). Salvaged forms carry ``_summary_only=True`` — the
+      table renders from summary keys and says so.
+
+    Only when none of that works does this degrade to
+    ``{"_unparseable_wrapper": True}`` (deterministic empty table with
+    a note) rather than aborting."""
     with open(bench_path) as f:
         data = json.load(f)
-    if "tail" in data and "metric" not in data:
+    if "tail" not in data or "metric" in data:
+        return data
+    tail = data["tail"]
+    try:
+        # raw_decode, not loads: a round-6+ tail holds the artifact
+        # line FOLLOWED by the compact summary line — trailing data
+        # must not disqualify an intact full artifact
+        doc, _ = json.JSONDecoder().raw_decode(tail[tail.index("{"):])
+        if isinstance(doc, dict) and (
+            "matrix" in doc or "metric" in doc
+        ):
+            return doc
+    except Exception:
+        pass
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if '"bench_summary_v1"' not in line:
+            continue
         try:
-            return json.loads(data["tail"][data["tail"].index("{"):])
+            doc = json.loads(line[line.index("{"):])
         except Exception:
-            # the driver truncates long stdout; degrade to a
-            # deterministic empty matrix (the table renders a note)
-            # rather than aborting, so the PARITY test can still
-            # enforce committed-table == regeneration
-            return {"_unparseable_wrapper": True}
-    return data
+            continue
+        doc["_summary_only"] = True
+        return doc
+    pos = tail.rfind('"summary"')
+    if pos >= 0:
+        try:
+            start = tail.index("{", pos)
+            summ, _ = json.JSONDecoder().raw_decode(tail[start:])
+            if isinstance(summ, dict):
+                return {"summary": summ, "_summary_only": True}
+        except Exception:
+            pass
+    return {"_unparseable_wrapper": True}
 
 
 def sanity_check(bench: Dict[str, Any]) -> List[str]:
@@ -357,6 +532,20 @@ def sanity_check(bench: Dict[str, Any]) -> List[str]:
             return
         if not isinstance(val, (int, float)) or not (lo <= val <= hi):
             bad.append(f"{path} = {val!r} outside [{lo}, {hi}]")
+
+    if bench.get("_summary_only"):
+        # driver-capture compact form: screen the summary-level numbers
+        s = bench.get("summary") or {}
+        rng("summary.headline_qps", s.get("headline_qps"), 1e3, 1e5)
+        rng("summary.headline_mfu", s.get("headline_mfu"), 0.05, 1.0)
+        rng("summary.cluster_qps", s.get("cluster_qps"), 1, 1e4)
+        rng("summary.cluster_pipelining",
+            s.get("cluster_pipelining"), 0.2, 20)
+        rng("summary.cluster_lm_tok_s", s.get("cluster_lm_tok_s"), 0.5, 1e5)
+        rng("summary.cluster_lm_steady_tok_s",
+            s.get("cluster_lm_steady_tok_s"), 0.5, 1e5)
+        rng("summary.train_img_s", s.get("train_img_s"), 10, 1e5)
+        return bad
 
     hl = m.get("headline_resnet50_b32") or {}
     rng("headline.qps", hl.get("qps"), 1e3, 1e5)
@@ -396,10 +585,20 @@ def sanity_check(bench: Dict[str, Any]) -> List[str]:
     cs = m.get("cluster_serving") or {}
     rng("cluster.qps", cs.get("qps_end_to_end"), 1, 1e4)
     rng("cluster.qps_unpipelined", cs.get("qps_unpipelined"), 1, 1e4)
+    rng("cluster.qps_depth1_static", cs.get("qps_depth1_static"), 1, 1e4)
+    rng("cluster.qps_pipelined_static",
+        cs.get("qps_pipelined_static"), 1, 1e4)
+    rng("cluster.decode_cache_speedup",
+        cs.get("decode_cache_speedup"), 0.2, 50)
     rng("cluster.pipelining_speedup", cs.get("pipelining_speedup"), 0.2, 20)
+    rng("cluster.pipelining_speedup_static",
+        cs.get("pipelining_speedup_static"), 0.2, 20)
     clm = m.get("cluster_lm_serving") or {}
     rng("cluster_lm.gen_tok_per_s",
         clm.get("gen_tok_per_s_end_to_end"), 0.5, 1e5)
+    rng("cluster_lm.steady_tok_per_s",
+        (clm.get("steady_state") or {}).get("gen_tok_per_s_steady"),
+        0.5, 1e5)
     tr = m.get("train") or {}
     cnn_tr = tr.get("resnet50_b32") or {}
     rng("train.cnn.img_per_s", cnn_tr.get("img_per_s"), 10, 1e5)

@@ -30,6 +30,9 @@ def test_bench_train_section_with_phase_split():
         engine, out, mesh=mesh,
         cnn_model="TinyNet", cnn_batch=4, cnn_hw=32,
         cnn_chains=(2, 6), phase_chains=((2, 6), (2, 6)),
+        # machinery-speed sweep: one bigger batch + one grad-accum
+        # point (the driver runs b64/b128/b128_ga4)
+        cnn_sweep=((8, 1, (2, 6)), (8, 2, (2, 6))),
         lm_dims={"seq_len": 32, "vocab_size": 64, "d_model": 16,
                  "n_heads": 2, "n_layers": 1, "d_ff": 32,
                  "n_kv_heads": 1},
@@ -39,6 +42,13 @@ def test_bench_train_section_with_phase_split():
     assert tr["img_per_s"] > 0 and tr["step_ms"] > 0
     lo, hi = tr["img_per_s_range"]
     assert lo <= tr["img_per_s"] <= hi
+
+    # batch-scaling sweep rows (VERDICT r5 item 7): plain batch point
+    # and the grad-accum point, keyed distinctly
+    b8 = out["train"]["tinynet_b8"]
+    assert b8["img_per_s"] > 0 and b8["step_ms"] > 0
+    ga = out["train"]["tinynet_b8_ga2"]
+    assert ga["img_per_s"] > 0 and ga["grad_accum"] == 2
 
     ps = tr["phase_split"]
     assert ps["fwd_ms"] > 0 and ps["fwd_bwd_ms"] > 0

@@ -1,0 +1,544 @@
+"""The probe-adaptive pipeline-depth controller (ISSUE 4 tentpole):
+pure-logic determinism under an injected clock, the commit/fallback/
+drift/abort state machine, the service wiring on the in-process
+chaos.LocalCluster (tier-1-speed smoke: one full probe cycle through
+the real coordinator ACK path), a leader kill mid-probe, and the
+claim_check validation of the new round-6 bench fields."""
+
+import asyncio
+import contextlib
+import json
+import os
+import shutil
+
+import pytest
+
+from dml_tpu.jobs.scheduler import DepthController
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def step(self, dt):
+        self.t += dt
+        return self.t
+
+
+def drive(ctl, clock, acks):
+    """Feed (dt, n_images, fetch, infer, put) acks; returns depths."""
+    out = []
+    for dt, n, f, i, p in acks:
+        clock.step(dt)
+        out.append(ctl.on_ack(n, fetch=f, infer=i, put=p))
+    return out
+
+
+PHASE = lambda dt, n=8: [(dt, n, 0.01, 0.05, 0.001)]  # noqa: E731
+
+
+def make_probed(d1_dt, d2_dt, probe_batches=3):
+    """A controller driven through one full probe cycle with the given
+    per-ack spacing per phase; returns (ctl, clock)."""
+    clock = Clock()
+    ctl = DepthController(probe_batches=probe_batches, now=clock)
+    assert ctl.tick(4 * probe_batches) in (1, 2)
+    assert ctl.state == "probing"
+    drive(ctl, clock, PHASE(d1_dt) * (probe_batches + 1))  # depth-1 phase
+    drive(ctl, clock, PHASE(d2_dt) * (probe_batches + 1))  # depth-2 phase
+    return ctl, clock
+
+
+def test_probe_is_deterministic():
+    """Identical ack streams commit identical verdicts — the probe is
+    a pure function of the stream + clock (seeded-stub property the
+    cluster smoke below relies on)."""
+    a, _ = make_probed(0.10, 0.05)
+    b, _ = make_probed(0.10, 0.05)
+    assert a.state == b.state == "settled"
+    assert a.depth == b.depth == 2
+    assert a.explain() == b.explain()
+    assert a.last_probe["qps_depth1"] == b.last_probe["qps_depth1"]
+
+
+def test_depth_falls_back_to_1_when_overlap_loses():
+    """The r5 regime: depth-2 measures SLOWER -> commit depth 1 (the
+    cheap sync path), with the reason recorded."""
+    ctl, _ = make_probed(0.05, 0.10)
+    assert ctl.state == "settled" and ctl.depth == 1
+    assert ctl.last_probe["winner"] == 1
+    assert "overlap did not pay" in ctl.last_probe["reason"]
+
+
+def test_noise_margin_prefers_depth_1():
+    """A depth-2 'win' inside the noise margin is not a win: the
+    overlap state machine must pay for itself."""
+    ctl, _ = make_probed(0.100, 0.098)  # 1.02x < 1.05 margin
+    assert ctl.depth == 1
+    ctl2, _ = make_probed(0.100, 0.080)  # 1.25x: a real win
+    assert ctl2.depth == 2
+
+
+def test_commit_then_drift_reprobes():
+    """Stage walls drifting past drift_ratio re-arm the probe; the
+    next sufficient backlog starts a fresh cycle tagged 'drift'."""
+    ctl, clock = make_probed(0.10, 0.05)
+    assert ctl.state == "settled" and ctl.signature["fetch"] > 0
+    # trailing window full of 5x-fetch acks -> drift
+    for _ in range(2 * ctl.probe_batches):
+        clock.step(0.05)
+        ctl.on_ack(8, fetch=0.05, infer=0.05, put=0.001)
+    assert ctl.state == "warmup" and ctl.reprobes == 1
+    assert ctl.tick(4 * ctl.probe_batches) == 1  # probing restarts at d1
+    assert ctl.state == "probing"
+    drive(ctl, clock, PHASE(0.05) * (ctl.probe_batches + 1))
+    drive(ctl, clock, PHASE(0.10) * (ctl.probe_batches + 1))
+    assert ctl.state == "settled" and ctl.probes == 2
+    assert ctl.last_probe["trigger"] == "drift"
+
+
+def test_steady_walls_do_not_reprobe():
+    """Acks matching the committed signature keep the commitment."""
+    ctl, clock = make_probed(0.10, 0.05)
+    for _ in range(6 * ctl.probe_batches):
+        clock.step(0.05)
+        ctl.on_ack(8, fetch=0.01, infer=0.05, put=0.001)
+    assert ctl.state == "settled" and ctl.reprobes == 0
+
+
+def test_ttl_reprobe_and_phase_abort():
+    clock = Clock()
+    ctl = DepthController(probe_batches=2, reprobe_ttl_s=100.0,
+                          probe_phase_timeout_s=10.0, now=clock)
+    ctl.tick(12)
+    drive(ctl, clock, PHASE(0.1) * 3 + PHASE(0.2) * 3)
+    assert ctl.state == "settled" and ctl.depth == 1
+    clock.step(101.0)
+    ctl.tick(0)  # TTL re-arms even with no backlog to probe yet
+    assert ctl.state == "warmup"
+    ctl.tick(12)
+    assert ctl.state == "probing"
+    clock.step(0.1)
+    ctl.on_ack(8)  # transition ack starts the phase clock
+    clock.step(11.0)  # ...then the work drains away
+    ctl.tick(12)  # timeout -> abort, fall back to the last verdict
+    assert ctl.aborted_probes == 1
+    assert ctl.depth == 1  # last commit's winner
+
+
+def test_zero_ack_probe_phase_times_out():
+    """A probe whose phase never receives ANY ACK (workers died right
+    after it started) must still abort on the phase timeout — TTL
+    only covers 'settled', so without the phase-start wall the
+    controller would wedge in 'probing' forever."""
+    clock = Clock()
+    ctl = DepthController(probe_batches=2, probe_phase_timeout_s=10.0,
+                          now=clock)
+    ctl.tick(12)
+    assert ctl.state == "probing"
+    clock.step(11.0)  # no on_ack at all
+    ctl.tick(12)
+    assert ctl.aborted_probes == 1
+    assert ctl.depth == 1  # nothing ever committed: cheap sync path
+    # abort imposes a cooldown: the SAME standing backlog must not
+    # re-begin the probe immediately (a stalled pool would otherwise
+    # cycle probe/abort forever, flapping the depth)
+    assert ctl.state == "warmup"
+    ctl.tick(12)
+    assert ctl.state == "warmup"
+    clock.step(10.5)  # past the cooldown
+    ctl.tick(12)
+    assert ctl.state == "probing"
+
+
+def test_slow_but_flowing_phase_does_not_abort():
+    """The phase timeout measures from the LAST ACK, not the first —
+    a congested link delivering an ACK every 8 s (exactly where
+    depth-2 overlap wins) is a measurement in progress, not a stall."""
+    clock = Clock()
+    ctl = DepthController(probe_batches=5, probe_phase_timeout_s=10.0,
+                          now=clock)
+    ctl.tick(24)
+    for _ in range(6):  # 48 s of phase wall at 8 s/ACK: no abort
+        clock.step(8.0)
+        ctl.on_ack(8, fetch=0.01, infer=0.05, put=0.001)
+        ctl.tick(24)
+    assert ctl.aborted_probes == 0
+    assert ctl._phase_rates.get(1)  # the d1 phase completed
+
+
+def test_per_worker_transition_discard():
+    """Each phase discards the FIRST ACK from EVERY worker — on a
+    multi-worker pool up to W in-flight batches predate the depth
+    switch, and one global discard would count wrong-depth batches
+    into the phase rate."""
+    clock = Clock()
+    ctl = DepthController(probe_batches=2, now=clock)
+    ctl.tick(12)
+    # depth-1 phase: w1's and w2's first ACKs (stragglers, absurdly
+    # fast) are BOTH discarded; the counted acks set the honest rate
+    for worker, dt in (("w1", 0.001), ("w2", 0.001),
+                       ("w1", 0.1), ("w2", 0.1)):
+        clock.step(dt)
+        ctl.on_ack(8, fetch=0.01, infer=0.05, put=0.001, worker=worker)
+    assert ctl._phase_rates[1] == pytest.approx(16 / 0.2)
+    # depth-2 phase: same shape
+    for worker, dt in (("w1", 0.001), ("w2", 0.001),
+                       ("w1", 0.05), ("w2", 0.05)):
+        clock.step(dt)
+        ctl.on_ack(8, fetch=0.01, infer=0.05, put=0.001, worker=worker)
+    assert ctl.state == "settled" and ctl.depth == 2
+    assert ctl.last_probe["qps_depth2"] == pytest.approx(16 / 0.1)
+
+
+def test_unprobed_default_is_depth_1():
+    """Un-probed (short jobs, not enough backlog), the controller
+    serves the reference-faithful cheap sync path — never the mode
+    both r5 captures measured as a pessimization."""
+    ctl = DepthController(now=Clock())
+    assert ctl.depth == 1 and ctl.state == "warmup"
+
+
+def test_insufficient_backlog_never_probes():
+    clock = Clock()
+    ctl = DepthController(probe_batches=3, now=clock)
+    for _ in range(20):
+        assert ctl.tick(3) == ctl.depth  # < min_probe_backlog (8)
+        clock.step(0.1)
+        ctl.on_ack(8)
+    assert ctl.state == "warmup" and ctl.probes == 0
+
+
+# ----------------------------------------------------------------------
+# service wiring on the in-process cluster (chaos.LocalCluster — the
+# same chassis the soaks validate)
+# ----------------------------------------------------------------------
+
+
+@contextlib.asynccontextmanager
+async def _cluster(n, base_port, tmp_path):
+    from dml_tpu.cluster.chaos import LocalCluster
+
+    root = str(tmp_path / f"adapt_{base_port}")
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root)
+    c = LocalCluster(n, root, base_port)
+    try:
+        await c.start()
+        await c.wait_for(c.converged, 15.0, "initial convergence")
+        for sn in c.nodes.values():
+            ctl = sn.jobs.depth_ctl
+            assert ctl is not None  # adaptive is the product default
+            ctl.probe_batches = 2
+            ctl.min_probe_backlog = 4
+        yield c
+    finally:
+        await c.stop()
+
+
+@pytest.mark.adaptive
+def test_probe_cycle_smoke_on_local_cluster(tmp_path):
+    """Tier-1-speed smoke: one full probe cycle through the REAL
+    coordinator ACK path on the stub-backend cluster — the controller
+    path can never silently rot to untested (ISSUE 4 CI satellite)."""
+    from dml_tpu.cluster import chaos
+    from dml_tpu.observability import METRICS
+
+    async def run():
+        async with _cluster(3, 23400, tmp_path) as c:
+            client = c.client()
+            await client.store.put_bytes("img.jpeg", b"stub-bytes",
+                                         timeout=20.0)
+            leader = next(
+                sn for sn in c.nodes.values() if sn.node.is_leader
+            )
+            # 64 queries / batch 8 = 8 batches >= the 4-batch backlog
+            job_id = await client.jobs.submit_job(
+                chaos.STUB_MODEL, 64, timeout=15.0, retries=5
+            )
+            await client.jobs.wait_job(job_id, timeout=30.0)
+            ctl = leader.jobs.depth_ctl
+            assert ctl.state == "settled", ctl.explain()
+            assert ctl.probes == 1 and ctl.depth in (1, 2)
+            assert ctl.last_probe["qps_depth1"] > 0
+            assert ctl.last_probe["qps_depth2"] > 0
+            # the scheduler runs what the controller committed
+            assert leader.jobs.scheduler.pipeline_depth == ctl.depth
+            # operator surface: the breakdown verdict carries the why
+            stats = leader.jobs.depth_controller_stats()
+            assert stats["mode"] == "adaptive"
+            assert "reason" in stats["last_probe"]
+            assert "overlap_headroom_bound" in stats
+            # observability: the gauge shows the committed depth and
+            # the probe histogram saw both phases
+            snap = METRICS.snapshot()
+            assert snap["gauges"].get("jobs_pipeline_depth") == ctl.depth
+            hist = {
+                k: v for k, v in snap["histograms"].items()
+                if k.startswith("jobs_depth_probe_qps")
+            }
+            assert any("depth=1" in k for k in hist)
+            assert any("depth=2" in k for k in hist)
+
+    asyncio.run(run())
+
+
+@pytest.mark.adaptive
+def test_leader_kill_mid_probe_recovers(tmp_path):
+    """Chaos: the coordinator dies WHILE its controller is probing.
+    Failover must complete the job exactly once (shadow relays), end
+    with exactly one leader, and the new coordinator's own controller
+    must still be operable — the invariant set the chaos sweeps
+    enforce, scoped to the probe window."""
+    from dml_tpu.cluster import chaos
+
+    async def run():
+        async with _cluster(4, 23420, tmp_path) as c:
+            client = c.client()
+            await client.store.put_bytes("img.jpeg", b"stub-bytes",
+                                         timeout=20.0)
+            leader = next(
+                sn for sn in c.nodes.values() if sn.node.is_leader
+            )
+            leader_u = leader.node.me.unique_name
+            n = 400  # 50 batches: the probe window is easy to hit
+            job_id = await client.jobs.submit_job(
+                chaos.STUB_MODEL, n, timeout=15.0, retries=5
+            )
+            for _ in range(600):
+                if leader.jobs.depth_ctl.state == "probing":
+                    break
+                await asyncio.sleep(0.01)
+            assert leader.jobs.depth_ctl.state == "probing"
+            await c.crash_node(leader_u)  # abrupt: no goodbye
+            done = await client.jobs.wait_job(job_id, timeout=60.0)
+            assert done["total_queries"] == n
+            # invariant sweep, scoped: exactly one converged leader...
+            leaders = {
+                sn.node.leader_unique for sn in c.nodes.values()
+            }
+            assert len(leaders) == 1 and None not in leaders
+            new_leader = next(
+                sn for sn in c.nodes.values() if sn.node.is_leader
+            )
+            # ...every query counted exactly once on the new leader...
+            sched = new_leader.jobs.scheduler
+            assert sched.query_counts.get(chaos.STUB_MODEL, 0) >= n
+            assert sched.job_state(job_id).done
+            # ...and the promoted coordinator's controller is live
+            # (fresh state; it probes its own future jobs)
+            assert new_leader.jobs.depth_ctl is not None
+            assert new_leader.jobs.depth_controller_stats()["mode"] == (
+                "adaptive"
+            )
+
+    asyncio.run(run())
+
+
+# ----------------------------------------------------------------------
+# claim_check: the round-6 bench fields (link weather, adaptive
+# verdict, steady-state LM) + compact-summary / provenance plumbing
+# ----------------------------------------------------------------------
+
+
+GOOD_CS = {
+    "qps_end_to_end": 100.0,
+    "qps_unpipelined": 80.0,
+    "qps_pipelined_static": 90.0,
+    "pipelining_speedup": 1.11,
+    "pipelining_speedup_static": 1.13,
+    "adaptive": {"state": "settled", "depth": 2,
+                 "last_probe": {"winner": 2}},
+    "link_weather_at_section": {
+        "upload_mb_per_s": 900.0, "readback_128kb_ms": 12.0,
+    },
+}
+
+GOOD_CLM = {
+    "gen_tok_per_s_end_to_end": 1800.0,
+    "link_weather_at_section": {
+        "upload_mb_per_s": 900.0, "readback_128kb_ms": 12.0,
+    },
+    "steady_state": {
+        "measured_steady_s": 16.2,
+        "gen_tok_per_s_steady": 2400.0,
+        "curve_tok_per_s": [[i + 1.0, 2400.0] for i in range(18)],
+    },
+}
+
+
+def _artifact(tmp_path, name, matrix):
+    p = str(tmp_path / f"{name}.json")
+    with open(p, "w") as f:
+        json.dump({"matrix": matrix}, f)
+    return p
+
+
+def test_claim_check_serving_fields(tmp_path):
+    from dml_tpu.tools import claim_check as cc
+
+    ok = _artifact(tmp_path, "ok", {
+        "cluster_serving": GOOD_CS, "cluster_lm_serving": GOOD_CLM,
+    })
+    assert cc.check_serving_block(ok) == []
+    # sections skipped by the wall budget are honestly exempt
+    assert cc.check_serving_block(_artifact(tmp_path, "skip", {
+        "_skipped": {"cluster_serving": "budget",
+                     "cluster_lm_serving": "budget"},
+    })) == []
+    # pre-round-6 artifacts are exempt
+    assert cc.check_serving_block(_artifact(
+        tmp_path, "BENCH_r05x", {"cluster_serving": {}}
+    )) == []
+    # missing link weather on either cluster section fails
+    cs = dict(GOOD_CS)
+    cs.pop("link_weather_at_section")
+    bad = cc.check_serving_block(
+        _artifact(tmp_path, "nolw", {"cluster_serving": cs})
+    )
+    assert any("link_weather_at_section" in p for p in bad)
+    # a committed depth that LOSES to a forced static beyond probe
+    # noise fails the artifact (the r5 0.91x failure mode)
+    bad = cc.check_serving_block(_artifact(tmp_path, "lost", {
+        "cluster_serving": dict(GOOD_CS, pipelining_speedup=0.85),
+    }))
+    assert any("probe noise" in p for p in bad)
+    # a missing adaptive verdict fails
+    cs = dict(GOOD_CS)
+    cs.pop("adaptive")
+    bad = cc.check_serving_block(
+        _artifact(tmp_path, "noad", {"cluster_serving": cs})
+    )
+    assert any("adaptive" in p for p in bad)
+    # an LM section without the steady-state phase fails; so does a
+    # too-short window or a missing curve
+    clm = dict(GOOD_CLM)
+    clm.pop("steady_state")
+    bad = cc.check_serving_block(
+        _artifact(tmp_path, "noss", {"cluster_lm_serving": clm})
+    )
+    assert any("steady_state missing" in p for p in bad)
+    bad = cc.check_serving_block(_artifact(tmp_path, "short", {
+        "cluster_lm_serving": dict(GOOD_CLM, steady_state=dict(
+            GOOD_CLM["steady_state"], measured_steady_s=3.0)),
+    }))
+    assert any("still a transient" in p for p in bad)
+    bad = cc.check_serving_block(_artifact(tmp_path, "flat", {
+        "cluster_lm_serving": dict(GOOD_CLM, steady_state=dict(
+            GOOD_CLM["steady_state"], curve_tok_per_s=[[1.0, 5.0]])),
+    }))
+    assert any("curve" in p for p in bad)
+
+
+def test_compact_summary_line_fits_and_parses():
+    """The driver keeps a 2,000-char stdout tail; the final standalone
+    summary line must fit it with headroom, parse alone, and keep its
+    most essential keys under trimming."""
+    from bench import COMPACT_SUMMARY_BUDGET, compact_summary_line
+
+    summary = {
+        "headline_qps": 14388.3, "headline_mfu": 0.5462,
+        "cluster_qps": 74.6, "cluster_pipelining": 1.02,
+        "cluster_lm_steady_tok_s": 2400.0,
+        "section_errors": [], "sections_skipped": [],
+        # a fat key that trimming should drop first (wide enough to
+        # push the line past the budget on its own)
+        "section_wall_s": {
+            f"a_very_long_section_name_{i}": 123.456 for i in range(60)
+        },
+        "kv_heads_tok_s": {"mha": 1051.8, "gqa4": 2165.2, "mqa": 2006.6},
+    }
+    line = compact_summary_line(
+        {"qps": 14388.3}, "TPU_v5e(...)", 4.0, summary)
+    assert len(line) <= COMPACT_SUMMARY_BUDGET
+    doc = json.loads(line)
+    assert doc["bench_summary_v1"] is True
+    assert doc["summary"]["cluster_qps"] == 74.6
+    assert "section_wall_s" not in doc["summary"]  # trimmed
+    # the original dict is not mutated by trimming
+    assert "section_wall_s" in summary
+
+
+def test_load_bench_recovers_driver_wrapper_forms(tmp_path):
+    from dml_tpu.tools.parity_table import load_bench
+
+    big = json.dumps({"metric": "x", "matrix": {"a": 1},
+                      "summary": {"headline_qps": 14000.0,
+                                  "cluster_qps": 75.0}})
+    compact = json.dumps({"bench_summary_v1": True,
+                          "summary": {"headline_qps": 14000.0}},
+                         separators=(",", ":"))
+
+    def wrapper(name, tail):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            json.dump({"cmd": "bench", "rc": 0, "tail": tail,
+                       "parsed": None}, f)
+        return p
+
+    # intact artifact line: parsed whole
+    d = load_bench(wrapper("whole.json", big + "\n"))
+    assert d["matrix"] == {"a": 1} and "_summary_only" not in d
+    # intact artifact line FOLLOWED by the compact line (the exact
+    # round-6+ stdout shape): the FULL artifact must win — trailing
+    # data must not downgrade it to summary-only
+    d = load_bench(wrapper("both.json", big + "\n" + compact + "\n"))
+    assert d["matrix"] == {"a": 1} and "_summary_only" not in d
+    # truncated artifact line + compact summary line: compact wins
+    d = load_bench(wrapper(
+        "compact.json", big[big.index('"matrix"'):] + "\n" + compact))
+    assert d["_summary_only"] and d["summary"]["headline_qps"] == 14000.0
+    # truncated artifact line only: trailing summary salvaged (cut
+    # mid-object, with the summary key + object intact at the end —
+    # the shape the driver's 2,000-char tail produced in r3..r5)
+    d = load_bench(wrapper("salvage.json", big[big.index('"matrix"'):]))
+    assert d["_summary_only"] and d["summary"]["cluster_qps"] == 75.0
+    # nothing recoverable
+    d = load_bench(wrapper("junk.json", "no json here"))
+    assert d.get("_unparseable_wrapper")
+
+
+def test_parity_source_check(tmp_path):
+    """A PARITY table stamped from a preview while the same-round
+    driver capture parses is flagged; the repo itself must be clean."""
+    from dml_tpu.tools import claim_check as cc
+
+    def parity(src):
+        p = tmp_path / "PARITY.md"
+        p.write_text(
+            f"<!-- BENCH-TABLE:BEGIN source={src} sha1=abc -->\n"
+            "<!-- BENCH-TABLE:END -->\n"
+        )
+        return str(p)
+
+    # preview source, no driver capture: fine (driver hasn't run yet)
+    assert cc.check_parity_source(parity("BENCH_r09_preview.json")) == []
+    # driver capture exists and parses: violation
+    compact = json.dumps({"bench_summary_v1": True, "summary": {}},
+                         separators=(",", ":"))
+    with open(tmp_path / "BENCH_r09.json", "w") as f:
+        json.dump({"cmd": "b", "rc": 0, "tail": compact}, f)
+    bad = cc.check_parity_source(parity("BENCH_r09_preview.json"))
+    assert bad and "BENCH_r09.json" in bad[0]
+    # unparseable driver capture: preview stands
+    with open(tmp_path / "BENCH_r09.json", "w") as f:
+        json.dump({"cmd": "b", "rc": 0, "tail": "garbage"}, f)
+    assert cc.check_parity_source(parity("BENCH_r09_preview.json")) == []
+    # driver source: always fine
+    assert cc.check_parity_source(parity("BENCH_r09.json")) == []
+    # THE REPO: the committed PARITY.md must not be preview-stamped
+    # while a parseable same-round driver capture sits next to it
+    assert cc.check_parity_source() == []
+
+
+def test_overlap_headroom_bound():
+    from dml_tpu.jobs.cost_model import overlap_headroom
+
+    # prep ≈ infer: overlap can near-halve the wall
+    assert overlap_headroom(0.05, 0.05, 0.1, 0.0) == 2.0
+    # infer-dominated (the r5 fast-link regime): nothing to hide
+    assert overlap_headroom(0.001, 0.0, 0.1, 0.0) < 1.02
+    assert overlap_headroom(0.0, 0.0, 0.0, 0.0) == 1.0

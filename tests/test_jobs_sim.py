@@ -709,6 +709,11 @@ async def test_node_joining_midjob_takes_work(tmp_path):
     H3..H10 slice, worker.py:52 — ours is the live membership)."""
     async with cluster(4, tmp_path, 23100) as sim:
         await sim.wait_converged()
+        # staging machinery under test: pin static depth 2 (the
+        # adaptive default commits depth on measurement and, un-
+        # probed, runs the reference-faithful depth 1 — no stages)
+        for j in sim.jobs.values():
+            j.set_pipeline_depth(2)
         client_u = sim.by_name("H3")
         late_u = sim.by_name("H4")
         await sim.seed_images(client_u, 3)
@@ -968,6 +973,11 @@ async def test_pipeline_stage_prepares_while_primary_infers(tmp_path):
     path wall ~ max(stage), not sum."""
     async with cluster(4, tmp_path, 23100) as sim:
         await sim.wait_converged()
+        # staging machinery under test: pin static depth 2 (the
+        # adaptive default commits depth on measurement and, un-
+        # probed, runs the reference-faithful depth 1 — no stages)
+        for j in sim.jobs.values():
+            j.set_pipeline_depth(2)
         client_u = sim.by_name("H4")
         await sim.seed_images(client_u, 2)
         coord = sim.coordinator_jobs()
@@ -1006,6 +1016,11 @@ async def test_pipeline_stage_cancel_on_second_model(tmp_path):
     workers' stages; both jobs then complete."""
     async with cluster(4, tmp_path, 23200) as sim:
         await sim.wait_converged()
+        # staging machinery under test: pin static depth 2 (the
+        # adaptive default commits depth on measurement and, un-
+        # probed, runs the reference-faithful depth 1 — no stages)
+        for j in sim.jobs.values():
+            j.set_pipeline_depth(2)
         client_u = sim.by_name("H4")
         await sim.seed_images(client_u, 2)
         coord = sim.coordinator_jobs()
@@ -1050,6 +1065,11 @@ async def test_pipeline_worker_death_with_stage_completes(tmp_path):
     requeue both; the job still completes 100%."""
     async with cluster(4, tmp_path, 23300) as sim:
         await sim.wait_converged()
+        # staging machinery under test: pin static depth 2 (the
+        # adaptive default commits depth on measurement and, un-
+        # probed, runs the reference-faithful depth 1 — no stages)
+        for j in sim.jobs.values():
+            j.set_pipeline_depth(2)
         client_u = sim.by_name("H4")
         await sim.seed_images(client_u, 2)
         coord = sim.coordinator_jobs()
