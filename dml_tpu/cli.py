@@ -75,7 +75,12 @@ job commands (ML inference):
                                     (incl. staged pipeline batches)
   breakdown                         coordinator per-batch wall-time split +
                                     adaptive pipeline-depth verdict (chosen
-                                    depth + why) + decode-cache stats
+                                    depth + why) + decode-cache stats +
+                                    worker-group topology (formed/degraded
+                                    sharded serving groups)
+  parity-store                      imagenet parity report consuming weights
+                                    (.npz/.h5 + class index) from the
+                                    replicated store (operator `put`s them)
 observability:
   profile metrics [prom|json]       this node's metrics registry — summary
                                     roll-up (default), Prometheus exposition
@@ -101,7 +106,15 @@ class NodeApp:
         self.spec = spec
         self.node = Node(spec, me)
         self.store = StoreService(self.node)
-        self.jobs = JobService(self.node, self.store)
+        # group PRIMARIES get the lazy multi-model sharded engine
+        # (jobs/groups.py) — without it a spec-configured group would
+        # collapse the scheduler pool while serving single-chip
+        from .jobs.groups import wire_group_backend
+
+        self.jobs = JobService(
+            self.node, self.store,
+            group_backend=wire_group_backend(self.node),
+        )
         self._lm_specs = list(lm_specs)
 
     async def start(self) -> None:
@@ -244,6 +257,11 @@ class NodeApp:
                 print("(no models resident)")
         elif cmd == "unload-model" and len(a) == 1:
             print("ok evicted" if j.unload_model(a[0]) else "not resident")
+        elif cmd == "parity-store":
+            from .tools.imagenet_parity import run_parity_from_store
+
+            rep = await run_parity_from_store(s)
+            print(json.dumps(rep, indent=2, default=str))
         elif cmd == "checkpoint-jobs":
             r = await j.checkpoint_jobs()
             print(f"ok version={r['version']} replicas={r['replicas']}")
@@ -311,6 +329,10 @@ class NodeApp:
                 # rates, trigger, drift signature) — or the static pin
                 "depth_controller": j.depth_controller_stats(),
                 "decode_cache": j.decode_cache_stats(),
+                # worker-group topology: configured groups, formed
+                # state, capacity in force, degradations/reforms
+                # (jobs/groups.py; empty dict = no groups configured)
+                "groups": j.group_stats(),
             }, indent=2))
         else:
             print(f"unknown command {cmd!r} (try 'help')")

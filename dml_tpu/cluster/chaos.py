@@ -624,7 +624,14 @@ class LocalCluster:
         timing: Timing = FAST_TIMING,
         batch_size: int = 8,
         make_jobs: Optional[Callable[[Node, StoreService], Any]] = None,
+        worker_groups: Optional[List[Any]] = None,
     ):
+        """`worker_groups` (config.WorkerGroupSpec list) pools nodes
+        into tensor-parallel serving groups (jobs/groups.py); the
+        default job factory then gives each group primary a stub
+        GROUP backend whose throughput scales with group capacity and
+        which degrades (GroupDegraded) when a member dies mid-batch —
+        the control-plane shape of sharded serving, jax-free."""
         self.root = root
         self.seed = seed
         self.batch_size = batch_size
@@ -637,6 +644,7 @@ class LocalCluster:
                 root=os.path.join(root, "roots"),
                 download_dir=os.path.join(root, "dl"),
             ),
+            worker_groups=list(worker_groups or []),
         )
         self._make_jobs = make_jobs or self._default_jobs
         self.dns = IntroducerService(self.spec)
@@ -663,9 +671,27 @@ class LocalCluster:
         self._restart_counter = 0
 
     def _default_jobs(self, node: Node, store: StoreService):
+        from ..jobs.groups import stub_group_backend
         from ..jobs.service import JobService
 
-        js = JobService(node, store, infer_backend=stub_backend())
+        uname = node.me.unique_name
+        gb = None
+        g = node.spec.group_of_unique(uname)
+        if g is not None:
+            members = node.spec.group_members_unique(g.name)
+            if members and uname == members[0]:
+                # group primary: stub group engine — capacity-scaled
+                # latency, degrades when a member dies mid-batch
+                gb = stub_group_backend(
+                    g.name, members,
+                    lambda: {
+                        n.unique_name
+                        for n in node.membership.alive_nodes()
+                    },
+                )
+        js = JobService(
+            node, store, infer_backend=stub_backend(), group_backend=gb
+        )
         js.scheduler.set_batch_size(STUB_MODEL, self.batch_size)
         return js
 

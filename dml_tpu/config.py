@@ -119,6 +119,27 @@ class MeshSpec:
         return sizes
 
 
+@dataclass(frozen=True)
+class WorkerGroupSpec:
+    """A tensor-parallel serving group: nodes that pool their chips
+    into ONE dp×tp worker (Kumar et al.'s pod-slice serving unit; the
+    reference has no notion of this — every VM is its own whole-model
+    replica, models.py:26,51).
+
+    `members` are node names (e.g. "H4") or unique names; they must
+    exist in the node table and belong to at most one group. `mesh` is
+    the group's device layout over the pooled chips: `dp` shards
+    batches, `tp` shards weight storage. The group serves as a single
+    scheduler-visible worker (the deterministic first member by
+    unique name is its primary) only while EVERY member is alive and
+    schedulable; losing any member degrades it back to the surviving
+    single-chip engines (jobs/groups.py)."""
+
+    name: str
+    members: Tuple[str, ...] = ()
+    mesh: MeshSpec = field(default_factory=lambda: MeshSpec(dp=-1, tp=1))
+
+
 @dataclass
 class ClusterSpec:
     """The whole-cluster config: node table + ring + timing + store.
@@ -131,6 +152,9 @@ class ClusterSpec:
 
     nodes: List[NodeId] = field(default_factory=list)
     introducer: Optional[NodeId] = None
+    #: tensor-parallel serving groups (jobs/groups.py); empty = every
+    #: node serves alone (the reference's one-replica-per-VM shape)
+    worker_groups: List[WorkerGroupSpec] = field(default_factory=list)
     ring_k: int = 3  # number of ping successors (reference M=3, config.py:4)
     timing: Timing = field(default_factory=Timing)
     store: StoreConfig = field(default_factory=StoreConfig)
@@ -149,6 +173,40 @@ class ClusterSpec:
     def __post_init__(self):
         self._by_unique = {n.unique_name: n for n in self.nodes}
         self._ring = sorted(self.nodes, key=lambda n: (n.rank, n.host, n.port))
+        # resolve group members (names or unique names) to unique
+        # names once; membership must be known and disjoint — a chip
+        # lent to two groups would double-count capacity
+        self._group_members: Dict[str, Tuple[str, ...]] = {}
+        self._group_by_member: Dict[str, WorkerGroupSpec] = {}
+        for g in self.worker_groups:
+            resolved = []
+            for m in g.members:
+                nid = self._by_unique.get(m) or self.node_by_name(m)
+                if nid is None:
+                    raise ValueError(
+                        f"worker group {g.name!r}: unknown member {m!r}"
+                    )
+                resolved.append(nid.unique_name)
+            if len(set(resolved)) != len(resolved):
+                raise ValueError(
+                    f"worker group {g.name!r}: duplicate members"
+                )
+            for u in resolved:
+                if u in self._group_by_member:
+                    raise ValueError(
+                        f"node {u} belongs to two worker groups "
+                        f"({self._group_by_member[u].name!r}, {g.name!r})"
+                    )
+                self._group_by_member[u] = g
+            self._group_members[g.name] = tuple(sorted(resolved))
+
+    def group_members_unique(self, name: str) -> Tuple[str, ...]:
+        """A group's members as sorted unique names (the first is the
+        group's deterministic primary)."""
+        return self._group_members.get(name, ())
+
+    def group_of_unique(self, unique_name: str) -> Optional[WorkerGroupSpec]:
+        return self._group_by_member.get(unique_name)
 
     def node_by_unique_name(self, unique_name: str) -> Optional[NodeId]:
         return self._by_unique.get(unique_name)
@@ -202,6 +260,14 @@ class ClusterSpec:
             raw["store"] = StoreConfig(**raw["store"])
         if raw.get("mesh"):
             raw["mesh"] = MeshSpec(**raw["mesh"])
+        raw["worker_groups"] = [
+            WorkerGroupSpec(
+                name=g["name"],
+                members=tuple(g.get("members", ())),
+                mesh=MeshSpec(**g["mesh"]) if g.get("mesh") else MeshSpec(),
+            )
+            for g in raw.get("worker_groups", [])
+        ]
         return cls(**raw)
 
     @classmethod

@@ -28,7 +28,7 @@ all (i, j) with i+j == n_workers, i,j >= 1, pick the argmin of
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 
 @dataclass(frozen=True)
@@ -80,9 +80,16 @@ def batch_exec_time(cost: ModelCost, batch: Optional[int] = None) -> float:
     return cost.download_time * b + cost.load_time + cost.first_query + cost.per_query * (b - 1)
 
 
-def query_rate(cost: ModelCost, n_workers: int, batch: Optional[int] = None) -> float:
+def query_rate(
+    cost: ModelCost, n_workers: float, batch: Optional[int] = None
+) -> float:
     """Predicted queries/sec with `n_workers` VMs running this model
-    (reference: rate = vms * batch_size / exec_time, worker.py:303-324)."""
+    (reference: rate = vms * batch_size / exec_time, worker.py:303-324).
+
+    `n_workers` may be a float: a tensor-parallel worker GROUP
+    (jobs/groups.py) counts as one pool slot with capacity = its
+    measured/estimated throughput multiple of a single chip, so the
+    fair split sees aggregate rate, not slot count."""
     b = batch if batch is not None else cost.batch_size
     t = batch_exec_time(cost, b)
     if t <= 0 or n_workers <= 0:
@@ -124,21 +131,79 @@ def fair_split(
     dual-model case, worker.py:303-324: enumerate every split, argmin
     |r_a - r_b| / max). Each model gets at least one worker when
     n_workers >= 2."""
-    if n_workers <= 0:
-        return (0, 0)
-    if n_workers == 1:
-        # single worker: give it to the slower model (higher per-query
+    return fair_split_weighted([1.0] * max(0, n_workers), cost_a, cost_b)
+
+
+def fair_split_weighted(
+    weights: Sequence[float], cost_a: ModelCost, cost_b: ModelCost
+) -> Tuple[int, int]:
+    """`fair_split` over a pool whose slots have unequal capacity.
+
+    A tensor-parallel worker group (jobs/groups.py) occupies ONE pool
+    slot but serves with the aggregate throughput of its members, so
+    each slot carries a weight (single chip = 1.0, a formed group =
+    its capacity). The enumeration is the reference's exact shape —
+    every contiguous split of the pool, argmin of the relative rate
+    difference — run over the pool sorted by weight DESCENDING and
+    scored with weighted rates, with both assignment directions tried
+    (the heavy group going to model A or to model B are different
+    splits). Uniform weights reduce this to the reference's
+    `fair_split` bit-for-bit.
+
+    Returns (count_for_a, count_for_b); with heterogeneous weights the
+    counts mean "model a takes that many of the heaviest slots" when
+    the directed form says so — schedulers that place work should use
+    `fair_split_weighted_directed`, which also returns WHICH model the
+    heavy prefix belongs to, and grow that model heaviest-slot-first.
+    """
+    i, j, _ = fair_split_weighted_directed(weights, cost_a, cost_b)
+    return (i, j)
+
+
+def fair_split_weighted_directed(
+    weights: Sequence[float], cost_a: ModelCost, cost_b: ModelCost
+) -> Tuple[int, int, bool]:
+    """`fair_split_weighted` plus the placement direction: returns
+    ``(count_for_a, count_for_b, a_heavy)`` where ``a_heavy`` means
+    model a's count refers to the HEAVIEST slots of the pool (else
+    model b's does). Counts alone can't carry that — (1, 3) over
+    weights [2,1,1,1] is balanced only if the 1 IS the weight-2 slot —
+    so the caller must assign the heavy-side model its workers in
+    descending-weight order."""
+    n = len(weights)
+    if n <= 0:
+        return (0, 0, True)
+    if n == 1:
+        # single slot: give it to the slower model (higher per-query
         # time) so the worst-case rate is maximized
-        return (1, 0) if batch_exec_time(cost_a) >= batch_exec_time(cost_b) else (0, 1)
-    best = (1, n_workers - 1)
+        return (
+            (1, 0, True)
+            if batch_exec_time(cost_a) >= batch_exec_time(cost_b)
+            else (0, 1, False)
+        )
+    w = sorted((float(x) for x in weights), reverse=True)
+    prefix = [0.0]
+    for x in w:
+        prefix.append(prefix[-1] + x)
+    best = (1, n - 1, True)
     best_score = float("inf")
-    for i in range(1, n_workers):
-        j = n_workers - i
-        ra = query_rate(cost_a, i)
-        rb = query_rate(cost_b, j)
-        hi = max(ra, rb)
-        score = abs(ra - rb) / hi if hi > 0 else 0.0
-        if score < best_score:
-            best_score = score
-            best = (i, j)
+    # two passes, reference order first: with uniform weights every
+    # pass-2 candidate duplicates a pass-1 capacity pair, so the
+    # strict-< replacement keeps pass 1's (= the reference's) winner
+    # including its tie-breaking order
+    for a_heavy in (True, False):
+        for i in range(1, n):
+            j = n - i
+            heavy, light = prefix[i], prefix[n] - prefix[i]
+            cap_a, cap_b, split = (
+                (heavy, light, (i, j, True)) if a_heavy
+                else (light, heavy, (j, i, False))
+            )
+            ra = query_rate(cost_a, cap_a)
+            rb = query_rate(cost_b, cap_b)
+            hi = max(ra, rb)
+            score = abs(ra - rb) / hi if hi > 0 else 0.0
+            if score < best_score:
+                best_score = score
+                best = split
     return best

@@ -41,7 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..observability import METRICS
-from .cost_model import ModelCost, fair_split, query_rate
+from .cost_model import ModelCost, fair_split_weighted_directed, query_rate
 
 # Coordinator metrics: the registry form of the reference's C1/C2
 # console (see observability.py's C1-C5 map). The exact-sample
@@ -475,6 +475,10 @@ class Scheduler:
         # a staged batch would instantly widen the preempting model's
         # footprint beyond its computed share).
         self.pipeline_depth = 1
+        # per-slot capacity from the last schedule() call (worker ->
+        # weight; absent = 1.0). Group primaries carry their group's
+        # aggregate capacity here (jobs/groups.py).
+        self.worker_weights: Dict[str, float] = {}
         self.prefetch: Dict[str, Batch] = {}  # worker -> staged batch
         self._revoked_stages: List[Tuple[str, Tuple[int, int]]] = []
         self.jobs: Dict[int, JobState] = {}  # in-flight only
@@ -626,14 +630,28 @@ class Scheduler:
         """Models with queued work, in deterministic order."""
         return sorted(m for m, q in self.queues.items() if q)
 
-    def schedule(self, workers: Sequence[str]) -> List[Assignment]:
+    def schedule(
+        self,
+        workers: Sequence[str],
+        weights: Optional[Dict[str, float]] = None,
+    ) -> List[Assignment]:
         """Compute assignments for this round.
 
         `workers` is the current live worker pool (coordinator and
         standby excluded by the caller, mirroring the reference's
         H3..H10 set, worker.py:52). Returns the assignments to send;
         in-progress state is updated as if they were delivered.
+
+        `weights` carries per-slot capacity for pool entries that are
+        not single chips — a formed tensor-parallel worker group
+        (jobs/groups.py) occupies one slot under its primary's name
+        with weight = aggregate capacity. Omitted entries weigh 1.0.
+        The fair split and the predicted-rate samples use the weights;
+        assignment mechanics (one outstanding batch per slot, staging,
+        preemption, requeue) are unchanged — a group is exactly one
+        worker to them.
         """
+        self.worker_weights = dict(weights or {})
         # staged (pipeline) batches drain their model's queue ahead of
         # execution; if a SECOND model's work shows up, un-stage them
         # so the fair split sees the full picture — otherwise the new
@@ -701,15 +719,35 @@ class Scheduler:
         the other model's workers when the split demands it."""
         cost_a = self.costs.get(model_a, ModelCost(0, 0, 0.001))
         cost_b = self.costs.get(model_b, ModelCost(0, 0, 0.001))
-        want_a, want_b = fair_split(len(workers), cost_a, cost_b)
+        weights = [self.worker_weights.get(w, 1.0) for w in workers]
+        want_a, want_b, a_heavy = fair_split_weighted_directed(
+            weights, cost_a, cost_b
+        )
+        # honor the split's placement direction: the model whose count
+        # refers to the HEAVIEST slots must grow heaviest-first, the
+        # other lightest-first, or a count like "1 = the weight-2
+        # group" lands on an arbitrary single chip and the realized
+        # split is worse than the unweighted reference's. With a
+        # uniform pool the order stays untouched (reference behavior,
+        # including which worker takes which batch).
+        if any(x != 1.0 for x in weights):
+            desc = sorted(
+                workers,
+                key=lambda w: (-self.worker_weights.get(w, 1.0), w),
+            )
+            asc = list(reversed(desc))
+            workers_a = desc if a_heavy else asc
+            workers_b = asc if a_heavy else desc
+        else:
+            workers_a = workers_b = list(workers)
         # cap wants by actual queue depth + what's already running
         running_a = [w for w, b in self.in_progress.items() if b.model == model_a and w in workers]
         running_b = [w for w, b in self.in_progress.items() if b.model == model_b and w in workers]
         want_a = min(want_a, len(self._queue(model_a)) + len(running_a))
         want_b = min(want_b, len(self._queue(model_b)) + len(running_b))
         out: List[Assignment] = []
-        out += self._grow_to(model_a, want_a, model_b, workers)
-        out += self._grow_to(model_b, want_b, model_a, workers)
+        out += self._grow_to(model_a, want_a, model_b, workers_a)
+        out += self._grow_to(model_b, want_b, model_a, workers_b)
         return out
 
     def _grow_to(
@@ -763,7 +801,9 @@ class Scheduler:
             if cost is None:
                 continue
             n = sum(
-                1 for w, b in self.in_progress.items() if b.model == model and w in workers
+                self.worker_weights.get(w, 1.0)
+                for w, b in self.in_progress.items()
+                if b.model == model and w in workers
             )
             self.rate_samples.setdefault(
                 model, deque(maxlen=self.max_samples)

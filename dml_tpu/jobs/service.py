@@ -45,6 +45,7 @@ from ..cluster.wire import Message, MsgType
 from ..models.registry import MODEL_REGISTRY, get_model
 from ..observability import METRICS
 from .cost_model import ModelCost, overlap_headroom
+from .groups import GroupDirectory, note_group_requeue
 from .scheduler import Assignment, Batch, DepthController, Scheduler
 
 log = logging.getLogger(__name__)
@@ -89,6 +90,7 @@ class JobService:
         image_patterns: Tuple[str, ...] = ("*.jpeg", "*.jpg"),
         engine=None,
         pipeline_depth: Optional[int] = None,
+        group_backend: Optional[InferBackend] = None,
     ):
         """`engine` shares one InferenceEngine across co-located
         services (one weights copy + one compile per model per chip).
@@ -106,12 +108,27 @@ class JobService:
         per-batch round-trip is the cluster-serving bottleneck and
         overlap wins; on a fast link the overlap state machine can
         LOSE (r5 measured 0.91×/0.85×) — which is why measured, not
-        assumed, is the default."""
+        assumed, is the default.
+
+        `group_backend` is this node's tensor-parallel GROUP engine
+        (jobs/groups.py `sharded_backend` over the group mesh): used
+        for a batch only while this node is the PRIMARY of a formed
+        worker group; every other situation (lender, degraded group,
+        no group) serves on the ordinary single-chip backend. The
+        directory view driving that choice is derived from spec +
+        SWIM liveness, so it needs no relay protocol to survive
+        failover."""
         self.node = node
         self.store = store
         self.image_patterns = image_patterns
         self._backend = infer_backend or self._engine_backend
         self._backend_is_engine = infer_backend is None
+        # worker-group subsystem: the directory every role derives
+        # from spec + liveness, this node's group engine (primaries
+        # only), and the per-round pool weights handed the scheduler
+        self.groups = GroupDirectory(node.spec)
+        self._group_backend = group_backend
+        self._pool_weights: Dict[str, float] = {}
         # LM (or other non-CNN) serving models registered on this node:
         # per-model worker backend + per-model input-file patterns
         # (image jobs sample *.jpeg; LM jobs sample prompt-token files)
@@ -288,18 +305,72 @@ class JobService:
     def _me(self) -> str:
         return self.node.me.unique_name
 
-    def worker_pool(self) -> List[str]:
-        """Live workers = alive nodes minus coordinator and standby
-        (reference hardcodes H3..H10, worker.py:52). A cluster too
-        small to spare dedicated coordinators uses every live node —
-        this is also the single-node "leader = self" mode (SURVEY §7
-        minimum slice)."""
+    def _eligible_workers(self) -> List[str]:
+        """Live schedulable nodes = alive minus coordinator and
+        standby (reference hardcodes H3..H10, worker.py:52). A cluster
+        too small to spare dedicated coordinators uses every live
+        node — this is also the single-node "leader = self" mode
+        (SURVEY §7 minimum slice)."""
         alive = [n.unique_name for n in self.node.membership.alive_nodes()]
         leader = self.node.leader_unique
         sb = self.store.standby_node()
         standby = sb.unique_name if sb else None
         pool = [u for u in alive if u != leader and u != standby]
         return pool if pool else alive
+
+    def worker_pool(self) -> List[str]:
+        """The scheduler-visible pool: eligible nodes with every
+        FORMED worker group collapsed to its primary (one slot, group
+        capacity as its fair-share weight — jobs/groups.py). Members
+        of a degraded group stay as ordinary single-chip slots. The
+        weights of the returned pool are in `self._pool_weights`.
+
+        Collapse is ROUND-aware: it applies only while every active
+        model is group-servable (CNN engine models — LM models
+        registered via register_lm serve on per-node continuous-
+        batching backends the group engine cannot run). A round with
+        LM work keeps the full individual pool, otherwise the lender
+        withdrawal + capacity weight would model throughput the
+        primary never delivers — strictly worse than no groups. The
+        bitwise-equality contract makes the per-batch engine choice
+        (`_group_serves`) safe either way; THIS guard is about
+        capacity accounting."""
+        eligible = self._eligible_workers()
+        active = self.scheduler.active_models()
+        if any(m in self.model_patterns for m in active):
+            self.groups.collapse(eligible)  # keep edges/gauges live
+            self._pool_weights = {}
+            return eligible
+        pool, self._pool_weights = self.groups.collapse(eligible)
+        return pool
+
+    def group_role(self) -> Optional[str]:
+        """This node's serving role right now: "primary" (serves on
+        the group engine), "lender", "degraded", or None."""
+        return self.groups.role_in(self._eligible_workers(), self._me)
+
+    def _group_serves(self, model: str) -> bool:
+        """True when a batch of `model` executing NOW would run on
+        this node's group engine: a group backend is wired, it serves
+        this model (gb.model pins a single compiled engine; None =
+        any CNN), and this node is the primary of a formed group."""
+        gb = self._group_backend
+        if gb is None or model in self._extra_backends:
+            return False
+        if getattr(gb, "model", None) not in (None, model):
+            return False
+        return self.group_role() == "primary"
+
+    def group_stats(self) -> Dict[str, Any]:
+        """CLI `breakdown` topology line: configured groups, formed
+        state, capacity in force, degradation/reform history. The
+        directory's formed-state is normally refreshed by the
+        scheduling loop — which runs the collapse only on the
+        coordinator — so refresh it here first: `breakdown` must show
+        LIVE topology on any node, not whatever this node last saw
+        while it happened to be leader."""
+        self.groups.collapse(self._eligible_workers())
+        return self.groups.stats()
 
     # ------------------------------------------------------------------
     # client verbs (reference CLI submit-job / get-output /
@@ -620,7 +691,13 @@ class JobService:
         if self.depth_ctl is not None:
             queued = sum(len(q) for q in self.scheduler.queues.values())
             self.scheduler.pipeline_depth = self.depth_ctl.tick(queued)
-        assigns = self.scheduler.schedule(self.worker_pool())
+        # worker_pool() collapses formed groups and refreshes
+        # _pool_weights; the DepthController above operates at the
+        # same granularity — a group is one slot, its probe ACKs all
+        # arrive under the primary's name
+        assigns = self.scheduler.schedule(
+            self.worker_pool(), weights=self._pool_weights
+        )
         for w, key in self.scheduler.pop_revoked_stages():
             sat = self._staged_at.get(w)
             if sat is not None and sat[0] == key:
@@ -809,6 +886,14 @@ class JobService:
             st_pre is not None
             and batch_id not in st_pre.completed_batches
         )
+        if fresh_ack:
+            # group-served ACKs advertise membership + capacity: this
+            # is how any coordinator — including one promoted mid-job
+            # — learns measured group capacity for the fair-share
+            # weights. FRESH acks only: a duplicate/stale delivery
+            # must not revert the capacity any more than it may feed
+            # the scheduler counts or the DepthController below.
+            self.groups.observe_ack(msg.sender, d)
         done = self.scheduler.on_batch_done(
             msg.sender, job_id, batch_id,
             float(d.get("exec_time", 0.0)), int(d.get("n_images", 0)),
@@ -1005,13 +1090,48 @@ class JobService:
 
     def _on_node_failed(self, uname: str) -> None:
         """Requeue the dead worker's batch and reschedule (reference
-        handle_failures_if_pending_status, worker.py:1279-1306)."""
+        handle_failures_if_pending_status, worker.py:1279-1306).
+
+        Group degradation is handled here too. The directory edge is
+        acted on by the COORDINATOR (worker-side serving decisions —
+        group_role, member liveness checks around the device call —
+        are computed live, not from the edge), and the requeue of the
+        group primary's in-flight batches is its job: those batches were
+        executing on an ICI domain that no longer exists, so they go
+        back to the queue front like a dead worker's, even though the
+        primary node itself is alive. If the primary does manage to
+        ACK the old batch (the sim's stub mesh has no real ICI to
+        lose), completion dedup counts it exactly once and the
+        requeued copy's late ACK is dropped the same way."""
+        degraded = self.groups.on_node_failed(uname)
         if not self.node.is_leader:
             return
         self._assigned_at.pop(uname, None)
         self._staged_at.pop(uname, None)
         if self.scheduler.on_worker_failed(uname) is not None:
             log.info("%s: requeued batch from dead worker %s", self._me, uname)
+        if degraded is not None:
+            gname, primary = degraded
+            if primary != uname:
+                self._assigned_at.pop(primary, None)
+                self._staged_at.pop(primary, None)
+                # had_work BEFORE the call: on_worker_failed requeues
+                # the staged (prefetch) batch too but only RETURNS the
+                # in-progress one, and a staged-only requeue must
+                # still be counted and logged
+                had_work = (
+                    primary in self.scheduler.in_progress
+                    or primary in self.scheduler.prefetch
+                )
+                self.scheduler.on_worker_failed(primary)
+                if had_work:
+                    note_group_requeue(gname)
+                    log.info(
+                        "%s: group %s degraded by %s death; requeued "
+                        "primary %s's in-flight work onto the "
+                        "reformed single-chip pool",
+                        self._me, gname, uname, primary,
+                    )
         self._run_schedule()
 
     def _on_became_leader(self) -> None:
@@ -1403,7 +1523,16 @@ class JobService:
         t_fetch = time.monotonic() - t0
         imgs = None
         t_decode = 0.0
-        if self._backend_is_engine and batch.model not in self._extra_backends:
+        # the engine path pre-decodes; skip it when the batch will run
+        # on the GROUP engine (which decodes at its own mesh shapes) —
+        # otherwise every group batch pays the host JPEG decode twice.
+        # If the role flips between prepare and execute, the generic
+        # engine fallback decodes internally, so skipping stays safe.
+        if (
+            self._backend_is_engine
+            and batch.model not in self._extra_backends
+            and not self._group_serves(batch.model)
+        ):
             try:
                 spec = get_model(batch.model)
             except KeyError:
@@ -1492,9 +1621,35 @@ class JobService:
             # promotion (waiting out the previous batch's inference) —
             # a real, named stage of exec, not "other"
             stage_wait = max(0.0, t1 - t_prep_end)
+            group_fields: Dict[str, Any] = {}
             with span("worker.inference"):
                 be = self._extra_backends.get(batch.model, self._backend)
-                if imgs is not None and self._backend_is_engine:
+                gb = self._group_backend
+                # _group_serves: a sharded group engine serves exactly
+                # ONE model (gb.model; None = any, the lazy/stub
+                # forms); any other model's batch falls through to the
+                # single-chip backend — running the wrong forward
+                # would ack wrong predictions silently
+                if gb is not None and self._group_serves(batch.model):
+                    # formed-group PRIMARY: serve on the group's
+                    # sharded engine (jobs/groups.py). The ACK
+                    # advertises membership + capacity so the
+                    # coordinator's fair-share weights track what the
+                    # group actually is. A member dying mid-batch
+                    # raises GroupDegraded out of the backend, riding
+                    # the ordinary TASK_FAIL -> requeue path below.
+                    results, infer_time, cost = await gb(batch.model, paths)
+                    g = self.groups.group_of(self._me)
+                    members = self.groups.members(g.name) if g else ()
+                    group_fields = {
+                        "group": g.name if g else None,
+                        "group_size": len(members),
+                        "group_capacity": getattr(
+                            gb, "capacity", float(len(members) or 1)
+                        ),
+                    }
+                    self._promote_staged()
+                elif imgs is not None and self._backend_is_engine:
                     results, infer_time, cost = await self._engine_infer_prepared(
                         batch.model, paths, imgs
                     )
@@ -1581,6 +1736,7 @@ class JobService:
                     "stage_wait_time": stage_wait,
                     "put_time": t_put,
                     "cost": cost,
+                    **group_fields,
                 },
             )
             # a staged batch that arrived while we were draining (the
@@ -1700,6 +1856,12 @@ class JobService:
         # engine.load_model keeps the serving batch size across a
         # reload (a C3 set_batch_size survives a weight rollout)
         await asyncio.to_thread(eng.load_model, name, variables)
+        # the GROUP engine must serve the same weights: group-served
+        # and single-chip answers for one model may never differ by
+        # formation state (jobs/groups.py group_engine_backend)
+        setv = getattr(self._group_backend, "set_variables", None)
+        if setv is not None:
+            setv(name, variables)
         self._served_weight_version[name] = version
 
     JOBS_CKPT_NAME = "coordinator_jobs.ckpt"

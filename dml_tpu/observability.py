@@ -52,6 +52,14 @@ slot occupancy, compile events, readback stalls), ``worker_*``
 ``jobs_pipeline_depth`` / ``jobs_depth_*`` (the probe-adaptive
 worker-pipelining controller: depth in force, per-phase probe-rate
 histogram by depth, probe-cycle counters by trigger and aborts),
+``jobs_group_*`` (tensor-parallel worker groups, jobs/groups.py:
+``jobs_group_formed`` gauge — 1 while every member is alive and
+schedulable, ``jobs_group_members_alive`` gauge,
+``jobs_group_degradations_total`` / ``jobs_group_reforms_total``
+edge counters, ``jobs_group_batches_total`` batches served on a
+group's sharded engine, ``jobs_group_requeues_total`` primary
+in-flight batches requeued by a degradation — all labeled
+``group=``),
 ``cluster_*`` (SWIM suspicion/failure/false-positive events,
 alive-node gauge), ``transport_*`` (datagram + byte counters by
 message type), and ``store_*`` (put/get/replication timing and

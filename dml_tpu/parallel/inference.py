@@ -26,7 +26,24 @@ from .sharding import partition_params
 
 class ShardedInference:
     """A model compiled for a mesh. Batch size must be a multiple of
-    the dp axis (static shapes: one compilation serves every call)."""
+    the dp axis (static shapes: one compilation serves every call).
+
+    Two tensor-parallel execution forms:
+
+    - ``param_gather=False`` (Megatron form): compute stays channel-
+      sharded end to end; XLA partitions the contractions, so psum
+      reduction order differs from a single chip and outputs agree
+      only to float tolerance.
+    - ``param_gather=True`` (serving-group form, jobs/groups.py):
+      weights STAY tp-sharded in HBM (the memory win that lets a
+      group hold models no single chip can) but are all-gathered over
+      ICI at forward entry, so every dp shard runs the bit-identical
+      single-chip program on its batch slice. Outputs are BITWISE
+      EQUAL to the single-chip path — the property the worker-group
+      pipeline asserts end-to-end (``__graft_entry__.dryrun_multichip``
+      part 5) so a degradation/reformation mid-job can never change
+      what a query returns.
+    """
 
     def __init__(
         self,
@@ -36,9 +53,11 @@ class ShardedInference:
         variables: Any = None,
         dtype=jnp.bfloat16,
         seed: int = 0,
+        param_gather: bool = False,
     ):
         self.spec = get_model(model_name)
         self.mesh = mesh
+        self.param_gather = bool(param_gather)
         dp = mesh.shape.get("dp", 1)
         if batch_size % dp != 0:
             raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
@@ -54,8 +73,16 @@ class ShardedInference:
         model = self.spec.build(dtype=dtype)
         batch_sharding = NamedSharding(mesh, P("dp"))
         out_sharding = NamedSharding(mesh, P("dp"))
+        replicated = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), variables
+        )
 
         def fwd(vs, batch_u8):
+            if self.param_gather:
+                # all-gather the tp-sharded weights, then run the
+                # replicated (single-chip-identical) program per dp
+                # shard — reduction orders match a single chip exactly
+                vs = jax.lax.with_sharding_constraint(vs, replicated)
             x = normalize_sharded(
                 batch_u8, self.spec.preprocess, dtype, mesh
             )

@@ -589,6 +589,91 @@ def run_serving_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# sharded worker-group serving (bench _bench_cluster_sharded /
+# jobs/groups.py; ISSUE 5 tentpole)
+# ----------------------------------------------------------------------
+
+#: first round whose bench carries the tensor-parallel worker-group
+#: serving section; earlier artifacts predate the subsystem
+SHARDED_REQUIRED_FROM_ROUND = 7
+
+
+def check_sharded_block(path: str) -> List[str]:
+    """Validate the ``cluster_sharded_serving`` section WHEN IT RAN
+    (neither wall-budget-skipped, nor errored, nor honestly recorded
+    as skipped-with-reason inside the block):
+
+    - ``equal_outputs`` is True — the param_gather contract: a job
+      served by a tp-sharded worker group returns bit-identical
+      results to the single-chip path. A False here means sharded
+      serving CHANGES ANSWERS and must not ship;
+    - ``qps_sharded`` (and the single-chip comparison rate) are
+      finite and positive — the serve actually measured something;
+    - the group topology is echoed: at least one group with its
+      members, primary, and dp/tp mesh, so the artifact records WHAT
+      was serving, not just how fast.
+
+    Artifacts before round 7 are exempt; summary-only driver captures
+    are gated on the compact line's ``sharded_equal`` flag."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < SHARDED_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        if s.get("sharded_qps") is not None and s.get("sharded_equal") is False:
+            return [
+                f"{name}: summary sharded_equal is false — group-served "
+                "outputs diverged from the single-chip path"
+            ]
+        return []
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "cluster_sharded_serving" in not_run:
+        return []
+    block = matrix.get("cluster_sharded_serving")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `cluster_sharded_serving` section and not "
+                "recorded as skipped (bench lost the worker-group serve?)"]
+    if block.get("skipped"):
+        return []  # honest in-block skip (e.g. single-device env)
+    problems: List[str] = []
+    if block.get("equal_outputs") is not True:
+        problems.append(
+            f"{name}: cluster_sharded_serving.equal_outputs = "
+            f"{block.get('equal_outputs')!r} — tp-sharded group outputs "
+            "must be bitwise-equal to the single-chip path"
+        )
+    for key in ("qps_sharded", "qps_single_chip"):
+        v = block.get(key)
+        if not isinstance(v, (int, float)) or not math.isfinite(v) or v <= 0:
+            problems.append(
+                f"{name}: cluster_sharded_serving.{key} = {v!r} "
+                "(missing, nonfinite, or zero — the serve never ran?)"
+            )
+    groups = block.get("groups")
+    ok_topology = isinstance(groups, dict) and any(
+        isinstance(g, dict) and g.get("members") and g.get("mesh")
+        for g in groups.values()
+    )
+    if not ok_topology:
+        problems.append(
+            f"{name}: cluster_sharded_serving.groups does not echo the "
+            "group topology (members + dp/tp mesh per group)"
+        )
+    return problems
+
+
+def run_sharded_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_sharded_block(artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
 # exists and parses (ISSUE 4 satellite; VERDICT r5 item 1)
@@ -648,6 +733,9 @@ def main() -> None:
     for problem in run_serving_check(art_path):
         total += 1
         print(f"serving block: {problem}")
+    for problem in run_sharded_check(art_path):
+        total += 1
+        print(f"sharded block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")

@@ -1,7 +1,7 @@
 """conv_roofline analysis tool (CPU-safe jaxpr tracing; the on-chip
 --microbench mode is exercised by the bench/PARITY evidence runs)."""
 
-from dml_tpu.tools.conv_roofline import analyze, eff_bw
+from dml_tpu.tools.conv_roofline import analyze, concat_analysis, eff_bw
 
 
 def test_b4_measured_bw_bound_below_spec_bw_bound():
@@ -24,6 +24,26 @@ def test_resnet_bounds_ordering():
         <= 1.0
     )
     assert r["tile_util_flop_weighted"] > 0.85  # power-of-two widths
+
+
+def test_inception_concat_bound_below_concat_blind_bound():
+    """ISSUE 5 satellite (VERDICT r5 weak #5): Inception's branch
+    concats are pure HBM copies the conv roofline ignores. The
+    concat-corrected serial bound must sit strictly below the
+    concat-blind one, with all 11 mixed blocks' concat sites counted
+    (plus the 4 in-block branch concats of mixed9/10)."""
+    r = concat_analysis("InceptionV3", 32)
+    assert r["concat_sites"] == 15  # 11 block joins + 4 branch joins
+    assert r["concat_gbytes"] > 0
+    assert (
+        0 < r["mfu_bound_serial_with_concat"] < r["mfu_bound_serial"]
+    )
+    # ResNet has no concats: the corrected bound degenerates to the
+    # plain serial bound (the correction is Inception-specific fact,
+    # not a constant tax)
+    rn = concat_analysis("ResNet50", 32)
+    assert rn["concat_sites"] == 0
+    assert rn["mfu_bound_serial_with_concat"] == rn["mfu_bound_serial"]
 
 
 def test_eff_bw_classes():

@@ -338,6 +338,95 @@ def test_leader_kill_mid_probe_recovers(tmp_path):
     asyncio.run(run())
 
 
+@pytest.mark.adaptive
+@pytest.mark.sharded
+def test_group_ack_duplicates_freshness_gated(tmp_path):
+    """ISSUE 5 satellite: duplicate/stale WORKER_TASK_REQUEST_ACKs
+    from a worker-GROUP primary are freshness-gated out of both the
+    scheduler counts and the DepthController exactly like single
+    workers — a re-delivered group ACK (LinkShaper dup, resent task)
+    must not inflate query totals, feed the drift trail, or re-arm a
+    probe."""
+    from dml_tpu.cluster import chaos
+    from dml_tpu.cluster.wire import Message, MsgType
+    from dml_tpu.config import MeshSpec, WorkerGroupSpec
+
+    async def run():
+        from dml_tpu.cluster.chaos import LocalCluster
+
+        root = str(tmp_path / "grp_ack")
+        os.makedirs(root)
+        c = LocalCluster(
+            5, root, 23440,
+            worker_groups=[
+                WorkerGroupSpec("tp0", ("H4", "H5"), MeshSpec(dp=1, tp=2))
+            ],
+        )
+        try:
+            await c.start()
+            await c.wait_for(c.converged, 15.0, "initial convergence")
+            for sn in c.nodes.values():
+                sn.jobs.depth_ctl.probe_batches = 2
+                sn.jobs.depth_ctl.min_probe_backlog = 4
+            spec = c.spec
+            h4 = spec.node_by_name("H4").unique_name
+            client = c.nodes[spec.node_by_name("H3").unique_name]
+            await client.store.put_bytes("img.jpeg", b"stub-bytes",
+                                         timeout=20.0)
+            job_id = await client.jobs.submit_job(
+                chaos.STUB_MODEL, 64, timeout=15.0, retries=5
+            )
+            await client.jobs.wait_job(job_id, timeout=30.0)
+            leader = c.nodes[c.leader_uname()]
+            jobs = leader.jobs
+            ctl = jobs.depth_ctl
+            assert ctl.state == "settled", ctl.explain()
+            before_counts = dict(jobs.scheduler.query_counts)
+            before_trail = len(ctl._trail)
+            before_probes = (ctl.probes, ctl.reprobes)
+            before_cap = jobs.groups.capacity("tp0")
+            # replay a completed batch's ACK from the group primary —
+            # a duplicate delivery in every field that matters,
+            # including a BOGUS capacity the directory must not ingest
+            dup = Message(
+                sender=h4, type=MsgType.WORKER_TASK_REQUEST_ACK,
+                data={
+                    "job": job_id, "batch": 0,
+                    "model": chaos.STUB_MODEL, "n_images": 8,
+                    "exec_time": 0.01, "fetch_time": 5.0,
+                    "infer_time": 5.0, "put_time": 5.0,
+                    "group": "tp0", "group_size": 2,
+                    "group_capacity": 99.0,
+                },
+            )
+            for _ in range(3):
+                await jobs._h_task_ack(dup, None)
+            # scheduler: no double-counted queries
+            assert jobs.scheduler.query_counts == before_counts
+            # directory: the stale advert did not revert the capacity
+            assert jobs.groups.capacity("tp0") == before_cap
+            # controller: the dup never reached the drift trail or
+            # re-armed a probe
+            assert len(ctl._trail) == before_trail
+            assert (ctl.probes, ctl.reprobes) == before_probes
+            assert ctl.state == "settled"
+            # a STALE ack for a long-retired job is equally inert
+            stale = Message(
+                sender=h4, type=MsgType.WORKER_TASK_REQUEST_ACK,
+                data={"job": 999, "batch": 0,
+                      "model": chaos.STUB_MODEL, "n_images": 8,
+                      "exec_time": 0.01, "fetch_time": 5.0,
+                      "infer_time": 5.0, "put_time": 5.0},
+            )
+            await jobs._h_task_ack(stale, None)
+            assert jobs.scheduler.query_counts == before_counts
+            assert len(ctl._trail) == before_trail
+        finally:
+            await c.stop()
+
+    asyncio.run(run())
+
+
 # ----------------------------------------------------------------------
 # claim_check: the round-6 bench fields (link weather, adaptive
 # verdict, steady-state LM) + compact-summary / provenance plumbing
