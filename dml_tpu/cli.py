@@ -126,13 +126,29 @@ class NodeApp:
         # getattr: tests construct NodeApp via __new__ without __init__
         for lm_spec in getattr(self, "_lm_specs", []):
             from .inference.lm_backend import LMBackend
+            from .inference.lm_sharded import wire_lm_group
 
             be = await asyncio.to_thread(LMBackend.from_spec, lm_spec)
             name = str(lm_spec.get("name", "LM"))
-            self.jobs.register_lm(name, backend=be.backend, cost=be.cost())
+            # sharded LM serving role (inference/lm_sharded.py): a
+            # group primary whose group declares this model gets the
+            # weight-resident (or disaggregated-decode) group engine,
+            # prefill-role members get the slab prefill backend
+            gb, prefill = await asyncio.to_thread(
+                wire_lm_group, self.node, self.store, lm_spec
+            )
+            self.jobs.register_lm(
+                name, backend=be.backend, cost=be.cost(),
+                group_backend=gb, prefill=prefill,
+            )
+            role = (
+                "group decode primary" if gb is not None
+                else "prefill role" if prefill is not None
+                else "single-chip"
+            )
             print(f"registered LM serving model {name!r} "
                   f"({be.cfg.n_layers}L {be.cfg.d_model}d, "
-                  f"max_new_tokens={be.max_new_tokens})")
+                  f"max_new_tokens={be.max_new_tokens}, {role})")
         await self.node.start()
         await self.store.start()
         await self.jobs.start()

@@ -94,6 +94,12 @@ class MembershipList:
         self.indirect_failures = 0
         self.cleaned_since_replication: List[str] = []
         self._ping_targets: List[NodeId] = []
+        #: monotonic SWIM-view epoch: bumps whenever the alive set (or
+        #: any member's status) changes. Derivations that are pure
+        #: functions of the view — e.g. the worker-group pool collapse
+        #: (jobs/groups.py) — memoize on this instead of re-deriving
+        #: O(groups×members) every scheduling tick.
+        self.view_epoch = 0
         self.recompute_ping_targets()
 
     def _now(self) -> float:
@@ -282,7 +288,11 @@ class MembershipList:
         """Ping the next k *live* ring successors, walking past
         suspects and not-yet-joined nodes — the reference does this
         with a recursive replacement search (_find_replacement_node);
-        computing from the canonical ring is equivalent and simpler."""
+        computing from the canonical ring is equivalent and simpler.
+
+        Every caller reaches here exactly when the membership view
+        changed, so this is also where the view epoch advances."""
+        self.view_epoch += 1
         _M_ALIVE.set(
             sum(1 for _, st in self._members.values() if st == ALIVE)
         )

@@ -242,6 +242,27 @@ class DataPlane:
             got.append(int(v))
         return got
 
+    async def fetch_token_bytes(
+        self,
+        addr: Tuple[str, int],
+        token: str,
+        timeout: float = 60.0,
+    ) -> bytes:
+        """Pull an exposed file's raw bytes without landing them in
+        the store — the KV-cache slab handoff of disaggregated LM
+        serving (inference/lm_sharded.py): the slab is transient
+        per-batch state, not a replicated object, so it rides the
+        same token protocol as PUT sources but stays out of the
+        metadata/replication machinery. TunnelFault applies like any
+        other client pull."""
+        await self._maybe_fault()
+        header, payload = await self._rpc(
+            addr, {"op": "fetch_token", "token": token}, timeout
+        )
+        if not header.get("ok"):
+            raise FileNotFoundError(f"token at {addr}: {header.get('error')}")
+        return payload
+
     async def fetch_token_to_store(
         self,
         addr: Tuple[str, int],

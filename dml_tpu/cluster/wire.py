@@ -103,6 +103,15 @@ class MsgType(enum.IntEnum):
     # pulled back into the queue when a second model's work arrived —
     # the fair split must see it as schedulable, not pinned to a worker
     WORKER_STAGE_CANCEL = 77
+    # disaggregated LM serving (inference/lm_sharded.py): the decode-
+    # role group primary asks a prefill-role member to run the chunked
+    # prompt prefill for a batch. The ACK carries a data-plane token
+    # for the serialized KV-cache slab, which the decode node pulls
+    # over the TCP data plane (bulk bytes never ride UDP). The ACK is
+    # deliberately unregistered: the dispatcher's rid fallback resolves
+    # the awaiting request future, like SET_BATCH_SIZE_ACK.
+    LM_PREFILL_REQUEST = 78
+    LM_PREFILL_ACK = 79
     # observability (L8): any node (in practice the leader's console)
     # pulls a peer's metrics-registry snapshot; the ACK carries the
     # JSON snapshot (sparse histogram buckets), degrading tier by tier

@@ -133,11 +133,33 @@ class WorkerGroupSpec:
     scheduler-visible worker (the deterministic first member by
     unique name is its primary) only while EVERY member is alive and
     schedulable; losing any member degrades it back to the surviving
-    single-chip engines (jobs/groups.py)."""
+    single-chip engines (jobs/groups.py).
+
+    `lm_models` names LM serving models (register_lm names) this
+    group's engine serves with weights RESIDENT tp-sharded in HBM
+    (inference/lm_sharded.py). Like the member list it is static
+    config: the coordinator reads it to decide whether an LM round
+    may keep the group collapsed to one weighted slot — a group that
+    does not declare the round's LM model falls back to single-chip
+    slots for that round (the PR-5 behavior), because collapsing
+    would model throughput the primary's engine cannot deliver.
+
+    `roles` optionally splits the group into PREFILL and DECODE
+    serving roles for disaggregated LM serving (member name ->
+    "prefill" | "decode"). Prefill-role members run the chunked
+    prompt prefill and hand the serialized KV-cache slab to the
+    decode-role primary over the TCP store data plane; decode streams
+    tokens through the normal job completion path. Empty = no
+    disaggregation (every chip does both). Role assignment living
+    HERE (not in a runtime protocol) means degradation/reform and
+    failover derive the same view from spec + liveness, exactly like
+    membership itself."""
 
     name: str
     members: Tuple[str, ...] = ()
     mesh: MeshSpec = field(default_factory=lambda: MeshSpec(dp=-1, tp=1))
+    lm_models: Tuple[str, ...] = ()
+    roles: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -178,8 +200,10 @@ class ClusterSpec:
         # lent to two groups would double-count capacity
         self._group_members: Dict[str, Tuple[str, ...]] = {}
         self._group_by_member: Dict[str, WorkerGroupSpec] = {}
+        self._group_roles: Dict[str, Dict[str, str]] = {}
         for g in self.worker_groups:
             resolved = []
+            by_alias: Dict[str, str] = {}  # member-as-written -> unique
             for m in g.members:
                 nid = self._by_unique.get(m) or self.node_by_name(m)
                 if nid is None:
@@ -187,6 +211,7 @@ class ClusterSpec:
                         f"worker group {g.name!r}: unknown member {m!r}"
                     )
                 resolved.append(nid.unique_name)
+                by_alias[m] = nid.unique_name
             if len(set(resolved)) != len(resolved):
                 raise ValueError(
                     f"worker group {g.name!r}: duplicate members"
@@ -199,6 +224,28 @@ class ClusterSpec:
                     )
                 self._group_by_member[u] = g
             self._group_members[g.name] = tuple(sorted(resolved))
+            # disaggregation roles resolve to unique names too; a role
+            # for a non-member (or an unknown role word) is a config
+            # error, caught HERE like an unknown member — not at the
+            # first mid-job prefill handoff
+            roles: Dict[str, str] = {}
+            for m, role in (g.roles or {}).items():
+                u = by_alias.get(m)
+                if u is None:
+                    nid = self._by_unique.get(m) or self.node_by_name(m)
+                    u = nid.unique_name if nid else None
+                if u is None or u not in resolved:
+                    raise ValueError(
+                        f"worker group {g.name!r}: role for non-member "
+                        f"{m!r}"
+                    )
+                if role not in ("prefill", "decode"):
+                    raise ValueError(
+                        f"worker group {g.name!r}: unknown role "
+                        f"{role!r} for {m!r} (prefill|decode)"
+                    )
+                roles[u] = role
+            self._group_roles[g.name] = roles
 
     def group_members_unique(self, name: str) -> Tuple[str, ...]:
         """A group's members as sorted unique names (the first is the
@@ -207,6 +254,11 @@ class ClusterSpec:
 
     def group_of_unique(self, unique_name: str) -> Optional[WorkerGroupSpec]:
         return self._group_by_member.get(unique_name)
+
+    def group_roles_unique(self, name: str) -> Dict[str, str]:
+        """A group's disaggregation roles keyed by unique name (empty
+        when the group is not role-split)."""
+        return dict(self._group_roles.get(name, {}))
 
     def node_by_unique_name(self, unique_name: str) -> Optional[NodeId]:
         return self._by_unique.get(unique_name)
@@ -265,6 +317,8 @@ class ClusterSpec:
                 name=g["name"],
                 members=tuple(g.get("members", ())),
                 mesh=MeshSpec(**g["mesh"]) if g.get("mesh") else MeshSpec(),
+                lm_models=tuple(g.get("lm_models", ())),
+                roles=dict(g.get("roles", {}) or {}),
             )
             for g in raw.get("worker_groups", [])
         ]

@@ -94,6 +94,55 @@ def parse_prompt_file(
     return ids, budget
 
 
+def lm_spec_parts(spec: Dict[str, Any]):
+    """(params, LMConfig) from a JSON-able LM spec — the construction
+    half of `LMBackend.from_spec`, shared with the tp-sharded serving
+    forms (inference/lm_sharded.py) which place the SAME deterministic
+    tree with mesh shardings instead of single-device. Weights init
+    from `seed` (identical tree on every node that loads the spec)
+    unless `weights` names a flax-msgpack file."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerLM
+
+    dtype = {
+        "bfloat16": jnp.bfloat16, "float32": jnp.float32,
+    }[spec.get("dtype", "bfloat16")]
+    d_model = int(spec["d_model"])
+    cfg = LMConfig(
+        vocab_size=int(spec["vocab_size"]),
+        d_model=d_model,
+        n_heads=int(spec.get("n_heads", 8)),
+        n_layers=int(spec.get("n_layers", 2)),
+        d_ff=int(spec.get("d_ff", 4 * d_model)),
+        dtype=dtype,
+        n_kv_heads=(
+            int(spec["n_kv_heads"])
+            if spec.get("n_kv_heads") is not None else None
+        ),
+        kv_quant=bool(spec.get("kv_quant", False)),
+    )
+    model = TransformerLM(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_heads=cfg.n_heads, n_layers=cfg.n_layers, d_ff=cfg.d_ff,
+        dtype=cfg.dtype, n_kv_heads=cfg.n_kv_heads,
+    )
+    params = model.init(
+        jax.random.PRNGKey(int(spec.get("seed", 0))),
+        jnp.zeros((1, 8), jnp.int32),
+    )["params"]
+    if spec.get("weights"):
+        from ..models.params_io import variables_from_bytes
+
+        with open(spec["weights"], "rb") as f:
+            data = f.read()
+        params = variables_from_bytes(
+            data, {"params": params}
+        )["params"]
+    return params, cfg
+
+
 class LMBackend:
     """A worker-side serving backend compatible with
     `JobService(infer_backend=...)`'s contract:
@@ -120,12 +169,14 @@ class LMBackend:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         seed: int = 0,
+        gather_shardings: Any = None,
     ):
         self.cfg = cfg
         self.max_new_tokens = max_new_tokens
         self.server = LMServer(
             params, cfg, max_slots=max_slots, max_len=max_len,
             chunk=chunk, temperature=temperature, top_k=top_k, seed=seed,
+            gather_shardings=gather_shardings,
         )
         # measured serving constants for the scheduler's cost model
         # (folded from real ACKs after the first batch either way)
@@ -263,6 +314,63 @@ class LMBackend:
             batch_size=self.server.max_slots,
         )
 
+    def serve_prefilled(
+        self,
+        prompts: Sequence[np.ndarray],
+        budgets: Sequence[int],
+        slabs: Sequence[Dict[str, Any]],
+    ) -> Tuple[List[np.ndarray], float]:
+        """Decode a batch whose prefill happened ELSEWHERE: each slab
+        ({"rows": per-layer KV cache for positions < len(prompt),
+        "first_token": the token prefill sampled}) adopts a slot via
+        `LMServer.submit_prefilled` and decodes to its budget. Returns
+        (per-prompt generated tokens in order, decode seconds).
+
+        Drives the raw server serially under the serve lock (the
+        disaggregated group primary is ONE scheduler slot, so batches
+        arrive one at a time; sharing the overlap driver would add a
+        thread hop for nothing). Adoption is paced by free slots —
+        a slab waits host-side until a slot retires, exactly like a
+        queued local submit."""
+        if len(prompts) != len(slabs) or len(prompts) != len(budgets):
+            raise ValueError("prompts/budgets/slabs length mismatch")
+        if self.server.temperature != 0.0:
+            # sampled streams are keyed by THIS server's rids, which
+            # the prefill node cannot know — disaggregation is a
+            # greedy-serving form (see LMServer.submit_prefilled)
+            raise ValueError(
+                "disaggregated decode requires temperature == 0"
+            )
+        srv = self.server
+        with self._serve_lock:
+            t0 = time.monotonic()
+            pending = list(zip(prompts, budgets, slabs))
+            rids: List[int] = []
+            done: Dict[int, np.ndarray] = {}
+            try:
+                while pending or any(rid not in done for rid in rids):
+                    while pending and srv.free_slot_count() > 0:
+                        p, b, slab = pending.pop(0)
+                        rids.append(srv.submit_prefilled(
+                            p, b, slab["rows"], slab["first_token"]
+                        ))
+                    if any(rid not in done for rid in rids):
+                        srv.step()  # slots retire mid-batch; refill
+                    done.update(srv.take_done())
+            except Exception:
+                # a mid-batch adoption failure (slab k of n mismatched
+                # this server's shapes) must not leave requests < k
+                # occupying the grid: drain them to completion and
+                # discard, so the caller's fallback serve starts clean
+                live = [r for r in rids if r not in done]
+                if live:
+                    srv.run(live)
+                raise
+            infer_time = time.monotonic() - t0
+        if prompts:
+            self._per_query = infer_time / len(prompts)
+        return [done[rid] for rid in rids], infer_time
+
     @classmethod
     def from_spec(cls, spec: Dict[str, Any]) -> "LMBackend":
         """Build from a JSON-able spec — the CLI's `--lm-spec` file,
@@ -280,45 +388,7 @@ class LMBackend:
         produced by `params_io.variables_to_bytes({"params": ...})`
         (e.g. fetched from the replicated store with `get`).
         """
-        import jax
-        import jax.numpy as jnp
-
-        from ..models.transformer import TransformerLM
-
-        dtype = {
-            "bfloat16": jnp.bfloat16, "float32": jnp.float32,
-        }[spec.get("dtype", "bfloat16")]
-        d_model = int(spec["d_model"])
-        cfg = LMConfig(
-            vocab_size=int(spec["vocab_size"]),
-            d_model=d_model,
-            n_heads=int(spec.get("n_heads", 8)),
-            n_layers=int(spec.get("n_layers", 2)),
-            d_ff=int(spec.get("d_ff", 4 * d_model)),
-            dtype=dtype,
-            n_kv_heads=(
-                int(spec["n_kv_heads"])
-                if spec.get("n_kv_heads") is not None else None
-            ),
-            kv_quant=bool(spec.get("kv_quant", False)),
-        )
-        model = TransformerLM(
-            vocab_size=cfg.vocab_size, d_model=cfg.d_model,
-            n_heads=cfg.n_heads, n_layers=cfg.n_layers, d_ff=cfg.d_ff,
-            dtype=cfg.dtype, n_kv_heads=cfg.n_kv_heads,
-        )
-        params = model.init(
-            jax.random.PRNGKey(int(spec.get("seed", 0))),
-            jnp.zeros((1, 8), jnp.int32),
-        )["params"]
-        if spec.get("weights"):
-            from ..models.params_io import variables_from_bytes
-
-            with open(spec["weights"], "rb") as f:
-                data = f.read()
-            params = variables_from_bytes(
-                data, {"params": params}
-            )["params"]
+        params, cfg = lm_spec_parts(spec)
         max_new = int(spec.get("max_new_tokens", 32))
         # default chunk ≈ the per-request budget (capped): every step's
         # packed readback costs a link round-trip, so a 32-token budget

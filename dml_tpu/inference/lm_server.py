@@ -165,12 +165,27 @@ class LMServer:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         seed: int = 0,
+        gather_shardings: Any = None,
     ):
+        """`gather_shardings` (a pytree of NamedShardings matching
+        `params`, normally all-replicated over a mesh whose HBM holds
+        `params` tp-sharded) switches the server into the per-forward
+        PARAM-GATHER serving form: every prefill/chunk dispatch
+        constrains the weights to those shardings at entry, so XLA
+        all-gathers the tp-sharded tree over ICI each dispatch and
+        then runs the replicated program. This is the pessimized form
+        the `cluster_lm_sharded` bench scores against weight-RESIDENT
+        serving (params sharded, no constraint — GSPMD partitions the
+        contractions in place; `dryrun_multichip` part 4 asserts that
+        form token-exact vs a single device). None = leave params as
+        they are placed (the default, and the resident form when the
+        caller device_put the tree with tp shardings)."""
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.params = params
+        self._gather_shardings = gather_shardings
         self.cfg = cfg
         self.max_slots = max_slots
         self.max_len = max_len
@@ -216,7 +231,8 @@ class LMServer:
         # compilation per distinct prompt bucket.
         self._prefill = jax.jit(
             lambda p, pr, li: prefill(
-                p, self.cfg, pr, self.max_len, logits_index=li
+                self._maybe_gather(p), self.cfg, pr, self.max_len,
+                logits_index=li,
             )
         )
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
@@ -245,6 +261,16 @@ class LMServer:
         # a jit/persistent cache already holds it)
         self._seen_shapes: set = set()
         _M_SLOTS_TOTAL.set(max_slots)
+
+    def _maybe_gather(self, params):
+        """Trace-time hook: under the param-gather serving form the
+        weight tree is constrained to `gather_shardings` at dispatch
+        entry (XLA inserts the ICI all-gather); otherwise identity."""
+        if self._gather_shardings is None:
+            return params
+        return jax.lax.with_sharding_constraint(
+            params, self._gather_shardings
+        )
 
     def _insert_impl(self, cache, pcache, slot, row):
         """Copy row `row` of a (possibly group-batched) prefilled
@@ -302,6 +328,7 @@ class LMServer:
         at max_len instead of growing by `chunk` every step for the
         life of the server."""
         last = self.max_len - 1
+        params = self._maybe_gather(params)
 
         def body(carry, _):
             cache, cur, pos = carry
@@ -372,6 +399,97 @@ class LMServer:
         self._queue.extend(reqs)
         self._place_waiting()
         return [r.rid for r in reqs]
+
+    def free_slot_count(self) -> int:
+        """Currently-unoccupied decode slots (the disaggregated
+        backend paces slab adoption with this)."""
+        return sum(1 for r in self._slot_req if r is None)
+
+    def submit_prefilled(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        rows: Dict[str, Dict[str, np.ndarray]],
+        first_token: int,
+    ) -> int:
+        """Adopt an EXTERNALLY-prefilled request: place a KV-cache
+        slab computed elsewhere (a prefill-role worker, transported as
+        bytes over the data plane — inference/lm_sharded.py) straight
+        into a free slot and decode from it. `rows` is the per-layer
+        cache slab for positions < len(prompt), batch axis stripped:
+        {block_i: {k/v: [KV, Tp, D]}} (bf16 layout) or the kv_quant
+        leaves with scales as [KV, 1, Tp]. `first_token` is the token
+        the prefill sampled at the last prompt position; it seeds the
+        decode exactly like a local placement's deferred first token,
+        except its VALUE is already host-side (it rode the slab), so
+        it lands in the output directly with no pending readback.
+
+        Requires a free slot — the caller paces adoption against
+        `free_slot_count()` (a queue here would hold the transferred
+        slab bytes hostage on the host for unbounded time).
+
+        Exactness: the slab's bits are the prefill node's prefill
+        output; padding the T axis back to max_len is the same
+        full-row write `_insert_impl` always does, with the stale tail
+        behind the per-slot validity mask. With greedy sampling the
+        continued decode is token-identical to a local submit() — the
+        chunk sampler's argmax has no rid dependence. (Temperature
+        sampling streams are keyed by THIS server's rid, which the
+        prefill node cannot know; the disaggregated backend therefore
+        requires temperature == 0.)"""
+        prompt = self._validate(prompt, max_new_tokens)
+        slot = next(
+            (s for s in range(self.max_slots)
+             if self._slot_req[s] is None), None
+        )
+        if slot is None:
+            raise RuntimeError("no free slot for prefilled request")
+        tp = prompt.size
+        # rebuild the [1, KV, max_len, ...] insert-shaped tree: values
+        # pad the T axis (2), kv_quant scales carry T on lanes (3)
+        pcache = {}
+        for name, kv in rows.items():
+            pcache[name] = {}
+            for key, arr in kv.items():
+                a = np.asarray(arr)
+                t_axis = 2 if key.endswith("_s") else 1
+                if a.shape[t_axis] != tp:
+                    raise ValueError(
+                        f"slab {name}/{key}: T={a.shape[t_axis]} != "
+                        f"prompt {tp}"
+                    )
+                pad = [(0, 0)] * a.ndim
+                pad[t_axis] = (0, self.max_len - tp)
+                pcache[name][key] = jnp.asarray(np.pad(a, pad))[None]
+        self._rid += 1
+        req = _Request(
+            self._rid, prompt, int(max_new_tokens),
+            t_submit=time.monotonic(),
+        )
+        _M_REQS.inc()
+        self.cache = self._insert(
+            self.cache, pcache, jnp.int32(slot), jnp.int32(0)
+        )
+        slot_map = np.full(self.max_slots, -1, np.int32)
+        slot_map[slot] = 0
+        sm = jnp.asarray(slot_map)
+        self._cur_dev = self._merge_vec(
+            self._cur_dev, jnp.asarray([int(first_token)], jnp.int32), sm
+        )
+        self._pos_dev = self._merge_vec(
+            self._pos_dev, jnp.asarray([tp], jnp.int32), sm
+        )
+        req.out.append(int(first_token))
+        req.emitted = 1
+        req.slot = slot
+        self._slot_req[slot] = req
+        self.rid_vec[slot] = req.rid
+        self.tokens_delivered += 1
+        _M_TOKENS.inc()
+        if req.done:  # max_new_tokens == 1: the slab's token was all
+            self._retire(slot)
+        _M_SLOTS.set(sum(1 for r in self._slot_req if r is not None))
+        return req.rid
 
     def _place_waiting(self) -> None:
         # Placement is FULLY ASYNC and GROUP-BATCHED: free slots take

@@ -109,6 +109,16 @@ class GroupDirectory:
         }
         self.degradations: Dict[str, int] = {}
         self.reforms: Dict[str, int] = {}
+        # collapse memo: the collapse is a pure function of (pool,
+        # active LM models, enabled-flag, ACK-observed capacities) —
+        # all captured by the caller-provided cache key (the service
+        # keys on the SWIM view epoch + election roles). Without it a
+        # large cluster pays the O(groups×members) re-derivation every
+        # scheduling tick even when nothing changed.
+        self._collapse_key: Optional[Tuple] = None
+        self._collapse_cached: Optional[
+            Tuple[List[str], Dict[str, float]]
+        ] = None
 
     # -- static topology ----------------------------------------------
 
@@ -136,10 +146,27 @@ class GroupDirectory:
             return float(obs)
         return float(max(len(self.members(name)), 1))
 
+    def lm_serves(self, name: str, model: str) -> bool:
+        """True when group `name` declares `model` in its
+        ``lm_models`` — its engine serves that LM weight-resident
+        tp-sharded, so LM rounds may keep it collapsed."""
+        g = next(
+            (g for g in self.spec.worker_groups if g.name == name), None
+        )
+        return g is not None and model in g.lm_models
+
+    def roles_of(self, name: str) -> Dict[str, str]:
+        """Disaggregation role per member (unique name ->
+        "prefill"|"decode"); empty when the group is not role-split."""
+        return self.spec.group_roles_unique(name)
+
     # -- scheduler-facing view ----------------------------------------
 
     def collapse(
-        self, pool: Iterable[str]
+        self,
+        pool: Iterable[str],
+        lm_active: Iterable[str] = (),
+        cache_key: Optional[Tuple] = None,
     ) -> Tuple[List[str], Dict[str, float]]:
         """Collapse formed groups inside an eligible worker pool.
 
@@ -147,10 +174,42 @@ class GroupDirectory:
         members present in `pool`) are replaced by their primary alone,
         weighted by the group capacity; members of a degraded group
         stay as individual weight-1 workers. Order of survivors is
-        preserved. Also drives the formed/degraded edge metrics."""
+        preserved. Also drives the formed/degraded edge metrics.
+
+        `lm_active` names the round's active LM serving models (the
+        register_lm set). A group collapses for the round only if it
+        declares EVERY one of them in ``WorkerGroupSpec.lm_models`` —
+        its engine serves them weight-resident tp-sharded
+        (inference/lm_sharded.py). A group that does not withholds
+        its members as single-chip slots for the round (PR 5's
+        behavior): collapsing would withdraw the lender and weight
+        the primary at a capacity its engine never delivers for that
+        model. Formed-state tracking (edges, gauges) is unaffected —
+        LM-servability is a routing decision, not a liveness one.
+
+        `cache_key` memoizes the derivation: when provided and equal
+        to the previous call's key, the cached result returns without
+        re-deriving (the service keys on the SWIM view epoch +
+        election roles + the active-LM set, so large clusters stop
+        paying O(groups×members) per scheduling tick). ACK-observed
+        capacity changes invalidate the memo internally."""
+        if cache_key is not None:
+            full_key = (cache_key, self.enabled, tuple(sorted(lm_active)))
+            if (
+                self._collapse_key == full_key
+                and self._collapse_cached is not None
+            ):
+                cached_pool, cached_w = self._collapse_cached
+                return list(cached_pool), dict(cached_w)
+        else:
+            full_key = None
         pool = list(pool)
         if not self.has_groups():
+            if full_key is not None:
+                self._collapse_key = full_key
+                self._collapse_cached = (list(pool), {})
             return pool, {}
+        lm_set = set(lm_active)
         pool_set = set(pool)
         # formed-state of EVERY configured group, not just those with
         # a member in the pool: a group whose members are all alive
@@ -158,10 +217,14 @@ class GroupDirectory:
         # count — a degradation edge, or breakdown/gauges report a
         # serving group that nothing can serve on
         formed_now: Dict[str, bool] = {}
+        collapses: Dict[str, bool] = {}
         for g in self.spec.worker_groups:
             mem = self.members(g.name)
             formed_now[g.name] = bool(mem) and all(
                 m in pool_set for m in mem
+            )
+            collapses[g.name] = formed_now[g.name] and (
+                not lm_set or lm_set <= set(g.lm_models)
             )
             _M_ALIVE.set(
                 sum(1 for m in mem if m in pool_set), group=g.name
@@ -170,14 +233,20 @@ class GroupDirectory:
         weights: Dict[str, float] = {}
         for w in pool:
             g = self.spec.group_of_unique(w)
-            if g is None or not formed_now[g.name]:
-                out.append(w)  # ungrouped, or degraded single chip
+            if g is None or not collapses[g.name]:
+                out.append(w)  # ungrouped, degraded, or LM-withheld
             elif w == self.members(g.name)[0]:
                 out.append(w)  # the group's one pool slot
                 weights[w] = self.capacity(g.name)
             # formed lenders are pooled under the primary: no slot
         for name, formed in formed_now.items():
             self._note_edge(name, formed)
+        if full_key is not None:
+            # un-keyed calls (group_stats' live refresh) must not
+            # clobber the scheduling tick's memo — they would force a
+            # full re-derivation every tick whenever breakdown polls
+            self._collapse_key = full_key
+            self._collapse_cached = (list(out), dict(weights))
         return out, weights
 
     def role_in(self, pool: Iterable[str], uname: str) -> Optional[str]:
@@ -239,12 +308,18 @@ class GroupDirectory:
             cap = float(data.get("group_capacity") or 0.0)
         except (TypeError, ValueError):
             cap = 0.0
+        prev = self._observed.get(name, {}).get("capacity")
         self._observed[name] = {
             "capacity": cap if cap > 0 else None,
             "size": data.get("group_size"),
             "sender": sender,
             "at": time.time(),
         }
+        if self._observed[name]["capacity"] != prev:
+            # capacity feeds the collapse weights: a changed advert
+            # must invalidate the memoized collapse, whose cache key
+            # (SWIM epoch + roles) cannot see it
+            self._collapse_key = None
 
     # -- operator surface ---------------------------------------------
 
@@ -259,6 +334,8 @@ class GroupDirectory:
                 "members": list(mem),
                 "primary": mem[0] if mem else None,
                 "mesh": {"dp": g.mesh.dp, "tp": g.mesh.tp},
+                "lm_models": list(g.lm_models),
+                "roles": self.spec.group_roles_unique(g.name),
                 "formed": bool(self._formed_last.get(g.name)),
                 "capacity": self.capacity(g.name),
                 "capacity_source": (

@@ -133,6 +133,15 @@ class JobService:
         # per-model worker backend + per-model input-file patterns
         # (image jobs sample *.jpeg; LM jobs sample prompt-token files)
         self._extra_backends: Dict[str, InferBackend] = {}
+        # per-model LM GROUP backends (weight-resident tp-sharded or
+        # disaggregated decode — inference/lm_sharded.py): used for a
+        # batch only while this node is the primary of a formed group
+        # that declares the model in WorkerGroupSpec.lm_models
+        self._lm_group_backends: Dict[str, InferBackend] = {}
+        # per-model prefill-role backends (LMPrefillBackend): serve
+        # LM_PREFILL_REQUEST from a disaggregated group's decode
+        # primary by building + exposing the KV slab
+        self._lm_prefill: Dict[str, Any] = {}
         # models whose backend declares `on_dispatch` (see register_lm)
         self._backend_dispatch_aware: Dict[str, bool] = {}
         self.model_patterns: Dict[str, Tuple[str, ...]] = {}
@@ -325,23 +334,36 @@ class JobService:
         of a degraded group stay as ordinary single-chip slots. The
         weights of the returned pool are in `self._pool_weights`.
 
-        Collapse is ROUND-aware: it applies only while every active
-        model is group-servable (CNN engine models — LM models
-        registered via register_lm serve on per-node continuous-
-        batching backends the group engine cannot run). A round with
-        LM work keeps the full individual pool, otherwise the lender
-        withdrawal + capacity weight would model throughput the
-        primary never delivers — strictly worse than no groups. The
-        bitwise-equality contract makes the per-batch engine choice
-        (`_group_serves`) safe either way; THIS guard is about
-        capacity accounting."""
+        Collapse is ROUND-aware per group: a round's active LM models
+        (register_lm names) keep a group collapsed only if the group
+        declares them ALL in ``WorkerGroupSpec.lm_models`` — its
+        engine serves them weight-resident tp-sharded
+        (inference/lm_sharded.py); any other group withholds its
+        members as single-chip slots for the round (the PR-5
+        fallback), because collapsing would withdraw the lender and
+        weight the primary at a capacity its engine never delivers
+        for that model. The token/bitwise-equality contracts make the
+        per-batch engine choice (`_group_serves`) safe either way;
+        THIS guard is about capacity accounting.
+
+        The derivation memoizes on (SWIM view epoch, leader, standby,
+        active-LM set): unchanged membership and roles return the
+        cached pool instead of re-deriving O(groups×members) every
+        scheduling tick."""
         eligible = self._eligible_workers()
         active = self.scheduler.active_models()
-        if any(m in self.model_patterns for m in active):
-            self.groups.collapse(eligible)  # keep edges/gauges live
-            self._pool_weights = {}
-            return eligible
-        pool, self._pool_weights = self.groups.collapse(eligible)
+        lm_active = frozenset(
+            m for m in active if m in self.model_patterns
+        )
+        sb = self.store.standby_node()
+        cache_key = (
+            self.node.membership.view_epoch,
+            self.node.leader_unique,
+            sb.unique_name if sb else None,
+        )
+        pool, self._pool_weights = self.groups.collapse(
+            eligible, lm_active=lm_active, cache_key=cache_key
+        )
         return pool
 
     def group_role(self) -> Optional[str]:
@@ -349,13 +371,30 @@ class JobService:
         the group engine), "lender", "degraded", or None."""
         return self.groups.role_in(self._eligible_workers(), self._me)
 
+    def _group_backend_for(self, model: str) -> Optional[InferBackend]:
+        """The group engine that would serve a batch of `model` on
+        this node, if any: LM models route to their per-model sharded
+        group backend (register_lm's `group_backend`, gated on the
+        group declaring the model in lm_models); everything else to
+        the CNN group engine."""
+        if model in self._extra_backends:
+            gb = self._lm_group_backends.get(model)
+            if gb is None:
+                return None
+            g = self.groups.group_of(self._me)
+            if g is None or model not in g.lm_models:
+                return None
+            return gb
+        return self._group_backend
+
     def _group_serves(self, model: str) -> bool:
         """True when a batch of `model` executing NOW would run on
-        this node's group engine: a group backend is wired, it serves
-        this model (gb.model pins a single compiled engine; None =
-        any CNN), and this node is the primary of a formed group."""
-        gb = self._group_backend
-        if gb is None or model in self._extra_backends:
+        this node's group engine: a group backend is wired for it, it
+        serves this model (gb.model pins a single compiled engine;
+        None = any CNN), and this node is the primary of a formed
+        group."""
+        gb = self._group_backend_for(model)
+        if gb is None:
             return False
         if getattr(gb, "model", None) not in (None, model):
             return False
@@ -401,6 +440,8 @@ class JobService:
         backend: Optional[InferBackend] = None,
         cost: Optional[Any] = None,
         patterns: Tuple[str, ...] = ("*.tokens.txt", "*.prompt.txt"),
+        group_backend: Optional[InferBackend] = None,
+        prefill: Optional[Any] = None,
     ) -> None:
         """Register an LM serving model as a first-class job type.
 
@@ -414,7 +455,22 @@ class JobService:
         `submit-job <name> <N>` flows through the identical pipeline
         as image jobs — same batching, fair-share split, preemption,
         requeue-on-failure, standby relays, and get-output merge.
-        """
+
+        `group_backend` (group PRIMARIES only) is this node's sharded
+        LM group engine for the model — weight-resident tp-sharded
+        decode or the disaggregated decode form
+        (inference/lm_sharded.py). It serves a batch only while this
+        node is the primary of a FORMED group declaring the model in
+        ``WorkerGroupSpec.lm_models``; otherwise batches fall through
+        to `backend` (single-chip), so degradation changes throughput,
+        never answers. `prefill` (prefill-role members) is an
+        `LMPrefillBackend` serving LM_PREFILL_REQUEST: it builds each
+        batch's KV-cache slab and this service exposes the bytes on
+        the data plane for the decode primary to pull."""
+        if group_backend is not None:
+            self._lm_group_backends[name] = group_backend
+        if prefill is not None:
+            self._lm_prefill[name] = prefill
         if backend is not None:
             self._extra_backends[name] = backend
             # Backends that declare an `on_dispatch` parameter (the
@@ -665,6 +721,7 @@ class JobService:
         n.register(MsgType.WORKER_TASK_REQUEST_ACK, self._h_task_ack)
         n.register(MsgType.WORKER_TASK_FAIL, self._h_task_fail)
         n.register(MsgType.WORKER_TASK_ACK_RELAY, self._h_ack_relay)
+        n.register(MsgType.LM_PREFILL_REQUEST, self._h_lm_prefill)
         n.register(MsgType.SET_BATCH_SIZE, self._h_set_batch_size)
         n.register(MsgType.GET_C2_COMMAND, self._h_get_c2)
         n.register(MsgType.JOB_STATUS_REQUEST, self._h_job_status)
@@ -956,6 +1013,75 @@ class JobService:
             first_query=cost.get("first_query"),
             per_query=cost.get("per_query"),
         )
+
+    async def _h_lm_prefill(self, msg: Message, addr) -> None:
+        """Prefill-role worker side of disaggregated LM serving: a
+        decode primary sent a batch's prompt token ids; run the
+        chunked prefill (LMPrefillBackend), expose the serialized
+        KV-cache slab on the data plane, and ACK with the pull token.
+        The prefill runs as a background task — blocking the receive
+        loop on a device forward would stall SWIM heartbeats into
+        false suspicion (same discipline as the shadow-restore
+        fetch)."""
+        d = msg.data
+        rid = d.get("rid")
+        model = str(d.get("model", ""))
+        pf = self._lm_prefill.get(model)
+        if pf is None:
+            self.node.send_unique(
+                msg.sender, MsgType.LM_PREFILL_ACK,
+                {"rid": rid, "ok": False,
+                 "error": f"no prefill backend for {model!r} on "
+                          f"{self._me}"},
+            )
+            return
+        prompts = d.get("prompts") or []
+        budgets = d.get("budgets") or []
+        self._spawn_bg(
+            self._serve_prefill(pf, prompts, budgets, msg.sender, rid),
+            f"lm prefill {model} x{len(prompts)}",
+        )
+
+    async def _serve_prefill(
+        self, pf, prompts, budgets, reply_to: str, rid
+    ) -> None:
+        import tempfile
+
+        try:
+            data = await asyncio.to_thread(
+                pf.slabs_bytes, prompts, budgets
+            )
+            tmpdir = self.store.cfg.download_path()
+            os.makedirs(tmpdir, exist_ok=True)
+            fd, path = tempfile.mkstemp(prefix="kvslab_", dir=tmpdir)
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            token = self.store.data_plane.expose(path)
+
+            async def cleanup() -> None:
+                # the decode side pulls exactly once, promptly; the
+                # TTL bounds leakage when it died mid-handoff
+                await asyncio.sleep(120.0)
+                self.store.data_plane.unexpose(token)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+            self._spawn_bg(cleanup(), f"kv-slab ttl {token[:8]}")
+            self.node.send_unique(
+                reply_to, MsgType.LM_PREFILL_ACK,
+                {"rid": rid, "ok": True, "token": token,
+                 "size": len(data), "n": len(prompts)},
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.exception("%s: prefill slab build failed", self._me)
+            self.node.send_unique(
+                reply_to, MsgType.LM_PREFILL_ACK,
+                {"rid": rid, "ok": False, "error": str(e)},
+            )
 
     async def _h_set_batch_size(self, msg: Message, addr) -> None:
         """C3: leader updates the scheduler and fans out to every live
@@ -1624,12 +1750,14 @@ class JobService:
             group_fields: Dict[str, Any] = {}
             with span("worker.inference"):
                 be = self._extra_backends.get(batch.model, self._backend)
-                gb = self._group_backend
+                gb = self._group_backend_for(batch.model)
                 # _group_serves: a sharded group engine serves exactly
                 # ONE model (gb.model; None = any, the lazy/stub
                 # forms); any other model's batch falls through to the
                 # single-chip backend — running the wrong forward
-                # would ack wrong predictions silently
+                # would ack wrong predictions silently. LM models
+                # route to their own per-model sharded group backend
+                # (weight-resident or disaggregated decode).
                 if gb is not None and self._group_serves(batch.model):
                     # formed-group PRIMARY: serve on the group's
                     # sharded engine (jobs/groups.py). The ACK

@@ -83,13 +83,69 @@ def _correct_pad(
     return ((correct - adj_h, correct), (correct - adj_w, correct))
 
 
+class _S2DStemConv(nn.Module):
+    """The stem's 3×3/2 conv re-expressed as space-to-depth + a
+    stride-1 2×2 conv over 12 input channels. The parameter is the
+    SAME ``(3, 3, 3, features)`` kernel under the SAME
+    ``stem_conv/kernel`` tree path as the stock `nn.Conv` (weight
+    import and checkpoints are interchangeable); the fold to
+    ``(2, 2, 12, features)`` happens at apply time:
+    ``k2[dy', dx', (dy*2+dx)*3 + c, f] = pad4(k)[2dy'+dy, 2dx'+dx, c, f]``
+    which makes ``conv(s2d(x), k2, stride 1) == conv(x, pad4(k),
+    stride 2)`` exactly (the padded 4th kernel row/col is zero)."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, Hp, Wp, C], Hp/Wp even
+        import jax
+
+        c = x.shape[-1]
+        k = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (3, 3, c, self.features), jnp.float32,
+        )
+        k4 = jnp.pad(k, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        k2 = (
+            k4.reshape(2, 2, 2, 2, c, self.features)
+            .transpose(0, 2, 1, 3, 4, 5)
+            .reshape(2, 2, 4 * c, self.features)
+        )
+        b, h, w, _ = x.shape
+        xs = (
+            x.reshape(b, h // 2, 2, w // 2, 2, c)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(b, h // 2, w // 2, 4 * c)
+        )
+        return jax.lax.conv_general_dilated(
+            xs.astype(self.dtype), k2.astype(self.dtype),
+            window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
 class EfficientNet(nn.Module):
-    """EfficientNet-B{n}; flat Keras-named layers for weight import."""
+    """EfficientNet-B{n}; flat Keras-named layers for weight import.
+
+    `s2d_stem=True` re-expresses the stride-2 stem conv as
+    space-to-depth + a stride-1 conv (the MLPerf TPU ResNet trick):
+    the input's 2×2 pixel blocks fold into channels
+    ([H, W, 3] -> [H/2, W/2, 12]) and the 3×3/2 kernel zero-pads to
+    4×4 and folds the same way to (2, 2, 12, C) — mathematically the
+    SAME function on the SAME ``stem_conv`` parameter (weight-import
+    compatible; outputs agree to float reduction order), but the
+    first conv now runs at 12 input channels instead of 3, which on
+    TPU lifts the stem from ~23% MXU utilization (C_in=3 against a
+    128-lane contraction) toward 4× that. VERDICT r5 carry-over #7:
+    the ``b4_s2d_stem`` bench section measures the b128 MFU delta
+    either way each round."""
 
     width: float = 1.0
     depth: float = 1.0
     num_classes: int = 1000
     dtype: Any = jnp.float32
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -109,10 +165,24 @@ class EfficientNet(nn.Module):
         std = jnp.asarray(_STD, self.dtype)
         x = (x - mean) / std
 
-        # stem: ZeroPadding(correct_pad(3)) + valid 3x3/2
-        x = jnp.pad(x, ((0, 0), *_correct_pad(3, x.shape[1:3]), (0, 0)))
-        x = conv(_round_filters(32, self.width), (3, 3), strides=2,
-                 padding="VALID", name="stem_conv")(x)
+        # stem: ZeroPadding(correct_pad(3)) + valid 3x3/2 — or its
+        # space-to-depth re-expression (same function, same param)
+        (pt, pb), (pl, pr) = _correct_pad(3, x.shape[1:3])
+        if self.s2d_stem:
+            # one extra zero row/col when the padded extent is odd:
+            # the folded input needs even H/W, and the 4th kernel
+            # row/col that reads it is zero, so outputs are unchanged
+            pb += (x.shape[1] + pt + pb) % 2
+            pr += (x.shape[2] + pl + pr) % 2
+            x = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+            x = _S2DStemConv(
+                _round_filters(32, self.width), dtype=self.dtype,
+                name="stem_conv",
+            )(x)
+        else:
+            x = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+            x = conv(_round_filters(32, self.width), (3, 3), strides=2,
+                     padding="VALID", name="stem_conv")(x)
         x = bn(name="stem_bn")(x)
         x = swish(x)
 
@@ -186,6 +256,11 @@ class EfficientNet(nn.Module):
         return x
 
 
-def build_variant(variant: str, num_classes: int = 1000, dtype=jnp.float32) -> EfficientNet:
+def build_variant(
+    variant: str, num_classes: int = 1000, dtype=jnp.float32,
+    s2d_stem: bool = False,
+) -> EfficientNet:
     width, depth, _ = VARIANTS[variant]
-    return EfficientNet(width=width, depth=depth, num_classes=num_classes, dtype=dtype)
+    return EfficientNet(width=width, depth=depth,
+                        num_classes=num_classes, dtype=dtype,
+                        s2d_stem=s2d_stem)

@@ -60,6 +60,19 @@ edge counters, ``jobs_group_batches_total`` batches served on a
 group's sharded engine, ``jobs_group_requeues_total`` primary
 in-flight batches requeued by a degradation — all labeled
 ``group=``),
+``lm_sharded_*`` (sharded LM serving, inference/lm_sharded.py:
+``lm_sharded_batches_total`` LM batches served on a group engine
+labeled ``group=``/``mode=`` (resident|gather|disagg),
+``lm_sharded_tokens_total`` generated tokens delivered by
+group-sharded serving, ``lm_sharded_prefill_slabs_total`` KV-cache
+slabs built by prefill-role workers),
+``jobs_kv_handoff_*`` (the disaggregated prefill->decode handoff:
+``jobs_kv_handoff_total`` labeled ``result=`` ok|fallback — a
+fallback means the decode primary prefilled locally after a failed
+handoff, a throughput event never a correctness one —
+``jobs_kv_handoff_bytes_total`` serialized slab bytes pulled over
+the data plane, ``jobs_kv_handoff_seconds`` per-batch prefill RPC +
+slab pull wall),
 ``cluster_*`` (SWIM suspicion/failure/false-positive events,
 alive-node gauge), ``transport_*`` (datagram + byte counters by
 message type), and ``store_*`` (put/get/replication timing and

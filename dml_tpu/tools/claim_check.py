@@ -673,6 +673,108 @@ def run_sharded_check(artifact_path: Optional[str] = None) -> List[str]:
     return check_sharded_block(artifact_path or canonical_artifact_path())
 
 
+#: first round whose bench carries the sharded-LM serving section
+#: (weight-resident / param_gather / disaggregated on one group)
+LM_SHARDED_REQUIRED_FROM_ROUND = 8
+
+
+def check_lm_sharded_block(path: str) -> List[str]:
+    """Validate the ``cluster_lm_sharded`` section WHEN IT RAN:
+
+    - ``tokens_equal_single_chip`` is True — every serving form's
+      merged job outputs must equal isolated generate() per prompt
+      (the dryrun tp-decode exactness contract carried end-to-end
+      through the cluster). False means sharded LM serving CHANGES
+      ANSWERS and must not ship;
+    - ``tok_s_param_gather`` / ``tok_s_resident`` / ``tok_s_disagg``
+      are finite and positive — all three forms actually served;
+    - ``kv_handoff_bytes`` > 0 when the disaggregated form ran with
+      any successful handoff — the slab really moved over the data
+      plane (a zero here with handoffs recorded means the bench
+      measured the fallback path and labeled it disaggregation).
+
+    Artifacts before round 8 are exempt; summary-only driver captures
+    gate on the compact line's ``lm_sharded_equal`` flag."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < LM_SHARDED_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        if (
+            s.get("lm_sharded_toks") is not None
+            and s.get("lm_sharded_equal") is False
+        ):
+            return [
+                f"{name}: summary lm_sharded_equal is false — group-"
+                "sharded LM outputs diverged from isolated generate()"
+            ]
+        return []
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "cluster_lm_sharded" in not_run:
+        return []
+    block = matrix.get("cluster_lm_sharded")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `cluster_lm_sharded` section and not "
+                "recorded as skipped (bench lost the sharded-LM serve?)"]
+    if block.get("skipped"):
+        return []  # honest in-block skip (e.g. single-device env)
+    problems: List[str] = []
+    if block.get("tokens_equal_single_chip") is not True:
+        problems.append(
+            f"{name}: cluster_lm_sharded.tokens_equal_single_chip = "
+            f"{block.get('tokens_equal_single_chip')!r} — sharded/"
+            "disaggregated LM outputs must be token-identical to the "
+            "single-chip path"
+        )
+    for key in ("tok_s_param_gather", "tok_s_resident", "tok_s_disagg"):
+        v = block.get(key)
+        if not isinstance(v, (int, float)) or not math.isfinite(v) or v <= 0:
+            problems.append(
+                f"{name}: cluster_lm_sharded.{key} = {v!r} (missing, "
+                "nonfinite, or zero — the serving form never ran?)"
+            )
+    disagg = (block.get("modes") or {}).get("disagg") or {}
+    handoffs = disagg.get("handoffs", 0)
+    if handoffs and not block.get("kv_handoff_bytes"):
+        problems.append(
+            f"{name}: cluster_lm_sharded recorded {handoffs} handoffs "
+            "but kv_handoff_bytes is 0/absent — no slab bytes actually "
+            "moved over the data plane"
+        )
+    if block.get("tok_s_disagg") and not handoffs and not disagg.get(
+        "fallbacks"
+    ):
+        problems.append(
+            f"{name}: cluster_lm_sharded disagg served with neither "
+            "handoffs nor fallbacks recorded — the mode accounting "
+            "is broken"
+        )
+    groups = block.get("groups")
+    ok_topology = isinstance(groups, dict) and any(
+        isinstance(g, dict) and g.get("members") and g.get("mesh")
+        for g in groups.values()
+    )
+    if not ok_topology:
+        problems.append(
+            f"{name}: cluster_lm_sharded.groups does not echo the "
+            "group topology (members + dp/tp mesh per group)"
+        )
+    return problems
+
+
+def run_lm_sharded_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_lm_sharded_block(
+        artifact_path or canonical_artifact_path()
+    )
+
+
 # ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
@@ -736,6 +838,9 @@ def main() -> None:
     for problem in run_sharded_check(art_path):
         total += 1
         print(f"sharded block: {problem}")
+    for problem in run_lm_sharded_check(art_path):
+        total += 1
+        print(f"lm-sharded block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")

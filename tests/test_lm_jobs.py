@@ -308,6 +308,37 @@ def test_canon_lm_names_case_insensitive(params, tmp_path):
     aio.run(run())
 
 
+@pytest.mark.sharded
+def test_sharded_decode_token_identical_to_single_chip(params, tmp_path):
+    """Weight-resident tp-sharded decode (the group-engine serving
+    form, inference/lm_sharded.py) produces TOKEN-IDENTICAL results
+    to the single-chip LMBackend on the same prompt files — the
+    contract that lets an LM round keep a worker group's chips
+    pooled without changing any answer. Same params tree, two
+    placements."""
+    from dml_tpu.config import MeshSpec
+    from dml_tpu.inference.lm_sharded import shard_lm_params
+    from dml_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.RandomState(3)
+    paths = []
+    for i, tp in enumerate((4, 9, 14)):
+        p = str(tmp_path / f"p{i}.tokens.txt")
+        write_prompt_file(p, rng.randint(0, CFG.vocab_size, tp))
+        paths.append(p)
+    single = LMBackend(params, CFG, max_new_tokens=NEW_TOKENS,
+                       max_slots=2, max_len=64, chunk=4)
+    mesh = make_mesh(MeshSpec(dp=1, tp=2), devices=jax.devices()[:2])
+    sharded = LMBackend(
+        shard_lm_params(params, mesh), CFG,
+        max_new_tokens=NEW_TOKENS, max_slots=2, max_len=64, chunk=4,
+    )
+    sharded.overlap = False
+    res_single, _, _ = single.serve_files(paths)
+    res_sharded, _, _ = sharded.serve_files(paths)
+    assert res_sharded == res_single
+
+
 def test_budget_directive_near_miss_is_loud(tmp_path):
     """A malformed budget directive must raise, not silently serve the
     default budget; and write_prompt_file rejects bad budgets at the

@@ -1,0 +1,670 @@
+"""Weight-resident tp-sharded LM decode + prefill/decode
+disaggregation (inference/lm_sharded.py).
+
+Exactness is the spine of every test here: the KV slab must
+round-trip BIT-exact in both cache layouts, an adopted (externally
+prefilled) request must decode token-identical to a local submit,
+and the sharded/disaggregated cluster paths must return exactly what
+isolated `generate()` produces per prompt — disaggregation and
+sharding are throughput decisions, never semantics changes."""
+
+import asyncio
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dml_tpu.config import ClusterSpec, MeshSpec, Timing, WorkerGroupSpec
+from dml_tpu.inference.generate import LMConfig, generate
+from dml_tpu.inference.lm_backend import (
+    LMBackend,
+    lm_spec_parts,
+    write_prompt_file,
+)
+from dml_tpu.inference.lm_sharded import (
+    DisaggLMBackend,
+    LMPrefillBackend,
+    kv_slab_from_bytes,
+    kv_slab_to_bytes,
+    sharded_lm_backend,
+    sharded_lm_group_backend,
+)
+from dml_tpu.parallel.mesh import make_mesh
+
+SPEC = {
+    "name": "ShardLM", "vocab_size": 64, "d_model": 32, "n_heads": 4,
+    "n_kv_heads": 2, "n_layers": 2, "d_ff": 64, "dtype": "float32",
+    "max_new_tokens": 8, "max_slots": 2, "max_len": 64, "chunk": 4,
+    "seed": 0,
+}
+NEW_TOKENS = 8
+
+
+@pytest.fixture(scope="module")
+def parts():
+    return lm_spec_parts(SPEC)
+
+
+def _prompts(n=3, lens=(5, 11, 16)):
+    rng = np.random.RandomState(0)
+    return [
+        rng.randint(0, SPEC["vocab_size"], tp).astype(np.int32)
+        for tp in lens[:n]
+    ]
+
+
+def _expect(params, cfg, prompt, budget):
+    return np.asarray(generate(
+        params, cfg, jnp.asarray(np.asarray(prompt, np.int32)[None]),
+        budget,
+    ))[0]
+
+
+# ----------------------------------------------------------------------
+# KV slab serialization
+# ----------------------------------------------------------------------
+
+
+def _roundtrip(params, cfg, max_len=64):
+    pf = LMPrefillBackend(params, cfg, max_len=max_len)
+    entries = [pf.prefill_one(p, NEW_TOKENS) for p in _prompts()]
+    blob = kv_slab_to_bytes(entries)
+    back = kv_slab_from_bytes(blob)
+    assert len(back) == len(entries)
+    for a, b in zip(entries, back):
+        assert a["prompt_len"] == b["prompt_len"]
+        assert a["first_token"] == b["first_token"]
+        assert a["budget"] == b["budget"]
+        for name in a["rows"]:
+            for key, arr in a["rows"][name].items():
+                got = b["rows"][name][key]
+                assert got.dtype == np.asarray(arr).dtype
+                np.testing.assert_array_equal(np.asarray(arr), got)
+    return blob
+
+
+def test_kv_slab_roundtrip_bf16():
+    """bf16 cache layout ({k, v}) survives serialize/deserialize
+    bit-for-bit — bfloat16 rides as raw ml_dtypes bytes, not a f32
+    widening."""
+    spec = {**SPEC, "dtype": "bfloat16"}
+    params, cfg = lm_spec_parts(spec)
+    blob = _roundtrip(params, cfg)
+    assert blob[:4] == b"KVS1"
+
+
+def test_kv_slab_roundtrip_kv_quant():
+    """kv_quant layout (int8 values + f32 scales with T on lanes)
+    round-trips bit-exact through the same generic walker."""
+    spec = {**SPEC, "kv_quant": True}
+    params, cfg = lm_spec_parts(spec)
+    pf = LMPrefillBackend(params, cfg, max_len=64)
+    e = pf.prefill_one(_prompts()[0], NEW_TOKENS)
+    # the layout really is the quantized one
+    assert set(e["rows"]["block_0"]) == {"k_q", "k_s", "v_q", "v_s"}
+    assert e["rows"]["block_0"]["k_q"].dtype == np.int8
+    _roundtrip(params, cfg)
+
+
+def test_kv_slab_rejects_garbage():
+    with pytest.raises(ValueError, match="magic"):
+        kv_slab_from_bytes(b"nope" + b"\0" * 32)
+    params, cfg = lm_spec_parts(SPEC)
+    pf = LMPrefillBackend(params, cfg, max_len=64)
+    blob = kv_slab_to_bytes([pf.prefill_one(_prompts()[0], 4)])
+    with pytest.raises(ValueError):
+        kv_slab_from_bytes(blob[: len(blob) - 7])  # truncated tail
+
+
+# ----------------------------------------------------------------------
+# adopted decode exactness
+# ----------------------------------------------------------------------
+
+
+def test_serve_prefilled_token_identical(parts):
+    """An adopted slab decodes to EXACTLY the isolated generate()
+    output — the handoff moves bits, not approximations. Mixed
+    budgets exercise slot-paced adoption (more slabs than slots)."""
+    params, cfg = parts
+    prompts = _prompts()
+    budgets = [NEW_TOKENS, 3, 5]
+    pf = LMPrefillBackend(params, cfg, max_len=64)
+    slabs = kv_slab_from_bytes(kv_slab_to_bytes([
+        pf.prefill_one(p, b) for p, b in zip(prompts, budgets)
+    ]))
+    be = LMBackend(params, cfg, max_new_tokens=NEW_TOKENS,
+                   max_slots=2, max_len=64, chunk=4)
+    toks, infer_time = be.serve_prefilled(prompts, budgets, slabs)
+    assert infer_time > 0
+    for p, b, ts in zip(prompts, budgets, toks):
+        np.testing.assert_array_equal(ts, _expect(params, cfg, p, b))
+
+
+def test_serve_prefilled_budget_one(parts):
+    """A budget-1 adoption retires at placement: the slab's first
+    token is the whole output and no decode step runs for it."""
+    params, cfg = parts
+    p = _prompts()[0]
+    pf = LMPrefillBackend(params, cfg, max_len=64)
+    slabs = [pf.prefill_one(p, 1)]
+    be = LMBackend(params, cfg, max_new_tokens=NEW_TOKENS,
+                   max_slots=2, max_len=64, chunk=4)
+    toks, _ = be.serve_prefilled([p], [1], slabs)
+    np.testing.assert_array_equal(toks[0], _expect(params, cfg, p, 1))
+
+
+def test_serve_prefilled_requires_greedy(parts):
+    params, cfg = parts
+    be = LMBackend(params, cfg, max_new_tokens=4, max_slots=2,
+                   max_len=64, chunk=4, temperature=0.7)
+    with pytest.raises(ValueError, match="temperature"):
+        be.serve_prefilled([], [], [])
+
+
+# ----------------------------------------------------------------------
+# sharded serving forms (virtual tp=2 mesh)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.sharded
+def test_sharded_forms_token_identical(tmp_path, parts):
+    """Weight-resident AND param-gather serving over a tp=2 mesh both
+    produce token-identical outputs to single-chip generate() — the
+    dryrun tp-decode contract through the backend adapter."""
+    params, cfg = parts
+    mesh = make_mesh(MeshSpec(dp=1, tp=2), devices=jax.devices()[:2])
+    prompts = _prompts()
+    paths = []
+    for i, p in enumerate(prompts):
+        fp = str(tmp_path / f"p{i}.tokens.txt")
+        write_prompt_file(fp, p)
+        paths.append(fp)
+    for form in ("resident", "gather"):
+        be = sharded_lm_backend(SPEC, mesh, form=form)
+        assert be.overlap is False
+        results, infer_time, cost = be.serve_files(paths)
+        assert infer_time > 0 and cost["per_query"] > 0
+        for fp, p in zip(paths, prompts):
+            np.testing.assert_array_equal(
+                results[fp]["tokens"],
+                _expect(params, cfg, p, NEW_TOKENS),
+                err_msg=form,
+            )
+
+
+@pytest.mark.sharded
+def test_sharded_group_backend_degrades(tmp_path, parts):
+    """A member dying out from under the sharded LM engine raises
+    GroupDegraded (-> TASK_FAIL -> requeue), never a wrong answer."""
+    from dml_tpu.jobs.groups import GroupDegraded
+
+    params, cfg = parts
+    mesh = make_mesh(MeshSpec(dp=1, tp=2), devices=jax.devices()[:2])
+    be = sharded_lm_backend(SPEC, mesh, form="resident")
+    alive = {"a", "b"}
+    gb = sharded_lm_group_backend(
+        be, model_name="ShardLM", group_name="g0",
+        members=("a", "b"), alive_fn=lambda: set(alive), capacity=2.0,
+    )
+    assert gb.model == "ShardLM" and gb.capacity == 2.0
+    fp = str(tmp_path / "p.tokens.txt")
+    write_prompt_file(fp, _prompts()[0])
+    results, _, _ = asyncio.run(gb("ShardLM", [fp]))
+    np.testing.assert_array_equal(
+        results[fp]["tokens"],
+        _expect(params, cfg, _prompts()[0], NEW_TOKENS),
+    )
+    alive.discard("b")
+    with pytest.raises(GroupDegraded):
+        asyncio.run(gb("ShardLM", [fp]))
+
+
+# ----------------------------------------------------------------------
+# GroupDirectory: LM-aware collapse + memoization
+# ----------------------------------------------------------------------
+
+
+def _directory(lm_models=()):
+    from dml_tpu.jobs.groups import GroupDirectory
+
+    spec = ClusterSpec.localhost(5, base_port=9301, worker_groups=[
+        WorkerGroupSpec("tp0", ("H4", "H5"), MeshSpec(dp=1, tp=2),
+                        lm_models=tuple(lm_models)),
+    ])
+    pool = [spec.nodes[i].unique_name for i in (2, 3, 4)]  # H3..H5
+    return GroupDirectory(spec), spec, pool
+
+
+def test_collapse_lm_round_gating():
+    """An LM round keeps a group collapsed ONLY when the group
+    declares every active LM model in lm_models; otherwise the
+    members fall back to single-chip slots (the PR-5 behavior)."""
+    d, spec, pool = _directory(lm_models=("ShardLM",))
+    primary = spec.group_members_unique("tp0")[0]
+    # CNN round: collapsed
+    p, w = d.collapse(pool)
+    assert primary in p and len(p) == 2 and w[primary] == 2.0
+    # declared LM round: still collapsed
+    p, w = d.collapse(pool, lm_active={"ShardLM"})
+    assert len(p) == 2 and w[primary] == 2.0
+    # undeclared LM round: withheld — full single-chip pool
+    p, w = d.collapse(pool, lm_active={"OtherLM"})
+    assert sorted(p) == sorted(pool) and w == {}
+    # mixed round with an undeclared model: withheld too
+    p, w = d.collapse(pool, lm_active={"ShardLM", "OtherLM"})
+    assert sorted(p) == sorted(pool) and w == {}
+
+
+def test_collapse_memoizes_on_cache_key(monkeypatch):
+    """Same cache key -> the cached pool returns without re-deriving
+    (the SWIM-epoch memoization); key change or a capacity advert
+    invalidates. Returned containers are copies — mutating them must
+    not corrupt the memo."""
+    d, spec, pool = _directory(lm_models=("ShardLM",))
+    calls = {"n": 0}
+    orig = spec.group_of_unique
+
+    def counting(uname):
+        calls["n"] += 1
+        return orig(uname)
+
+    monkeypatch.setattr(spec, "group_of_unique", counting)
+    p1, w1 = d.collapse(pool, cache_key=(7, "L", "S"))
+    n_first = calls["n"]
+    assert n_first > 0
+    p1.append("junk")  # caller-side mutation must not leak back
+    w1["junk"] = 1.0
+    p2, w2 = d.collapse(pool, cache_key=(7, "L", "S"))
+    assert calls["n"] == n_first  # served from the memo
+    assert "junk" not in p2 and "junk" not in w2
+    d.collapse(pool, cache_key=(8, "L", "S"))  # epoch moved
+    assert calls["n"] > n_first
+    # a changed ACK-advertised capacity invalidates the memo even
+    # under an unchanged key
+    n_before = calls["n"]
+    d.collapse(pool, cache_key=(8, "L", "S"))
+    assert calls["n"] == n_before
+    d.observe_ack("x", {"group": "tp0", "group_capacity": 4.0})
+    p3, w3 = d.collapse(pool, cache_key=(8, "L", "S"))
+    assert calls["n"] > n_before
+    primary = spec.group_members_unique("tp0")[0]
+    assert w3[primary] == 4.0
+
+
+@pytest.mark.disagg
+def test_disagg_adoption_failure_falls_back(tmp_path, parts, monkeypatch):
+    """A slab that PULLS cleanly but cannot be adopted (a drifted-spec
+    peer shipping rows that don't fit this server) is still a failed
+    handoff: local-prefill fallback, fallback counter — never a batch
+    failure looping against the same bad peer, and never an
+    'ok'-handoff count. The decode grid must come out clean (the
+    fallback serve on the same server still yields exact outputs)."""
+    params, cfg = parts
+    prompts = _prompts()
+    paths = []
+    for i, p in enumerate(prompts):
+        fp = str(tmp_path / f"p{i}.tokens.txt")
+        write_prompt_file(fp, p)
+        paths.append(fp)
+    be = LMBackend(params, cfg, max_new_tokens=NEW_TOKENS,
+                   max_slots=2, max_len=64, chunk=4)
+    be.overlap = False
+    gb = DisaggLMBackend.__new__(DisaggLMBackend)
+    gb.be = be
+    gb.model = "ShardLM"
+    gb.group_name = "g0"
+    gb.members = ()
+    gb.alive_fn = None
+    gb.handoffs = gb.fallbacks = gb.handoff_bytes = 0
+
+    async def bad_slabs(model, ps, budgets):
+        # right count, wrong shapes: first slab's T axis lies
+        pf = LMPrefillBackend(params, cfg, max_len=64)
+        slabs = [pf.prefill_one(p, b) for p, b in zip(ps, budgets)]
+        import numpy as _np
+
+        slabs[0]["rows"]["block_0"]["k"] = _np.zeros(
+            (cfg.kv_heads, 1, cfg.head_dim),
+            slabs[0]["rows"]["block_0"]["k"].dtype,
+        )
+        return slabs
+
+    monkeypatch.setattr(gb, "_fetch_slabs", bad_slabs)
+    results, _, _ = asyncio.run(gb("ShardLM", paths))
+    assert gb.fallbacks == 1 and gb.handoffs == 0
+    for fp, p in zip(paths, prompts):
+        np.testing.assert_array_equal(
+            results[fp]["tokens"],
+            _expect(params, cfg, p, NEW_TOKENS),
+        )
+
+
+@pytest.mark.sharded
+def test_wire_lm_group_roles(tmp_path):
+    """Production NodeApp wiring: the decode primary of a role-split
+    group gets the disaggregated backend, prefill-role members get
+    the prefill backend, lenders/ungrouped nodes get neither, and a
+    group NOT declaring the model wires nothing."""
+    from dml_tpu.cluster.node import Node
+    from dml_tpu.cluster.store_service import StoreService
+    from dml_tpu.config import StoreConfig
+    from dml_tpu.inference.lm_sharded import wire_lm_group
+
+    async def run():
+        spec = ClusterSpec.localhost(
+            5, base_port=19401, introducer_port=19400,
+            store=StoreConfig(root=str(tmp_path / "roots"),
+                              download_dir=str(tmp_path / "dl")),
+            worker_groups=[WorkerGroupSpec(
+                "tp0", ("H4", "H5"), MeshSpec(dp=1, tp=2),
+                lm_models=("ShardLM",),
+                roles={"H4": "decode", "H5": "prefill"},
+            )],
+        )
+        out = {}
+        for name in ("H3", "H4", "H5"):
+            nid = spec.node_by_name(name)
+            node = Node(spec, nid)
+            store = StoreService(
+                node, root=str(tmp_path / f"st_{nid.port}")
+            )
+            out[name] = wire_lm_group(node, store, SPEC)
+        gb4, pf4 = out["H4"]
+        assert isinstance(gb4, DisaggLMBackend)
+        assert gb4.model == "ShardLM" and gb4.capacity == 2.0
+        assert pf4 is None
+        gb5, pf5 = out["H5"]
+        assert gb5 is None and isinstance(pf5, LMPrefillBackend)
+        assert out["H3"] == (None, None)
+        # a model the group does not declare wires nothing anywhere
+        nid = spec.node_by_name("H4")
+        node = Node(spec, nid)
+        store = StoreService(node, root=str(tmp_path / "st_x"))
+        assert wire_lm_group(
+            node, store, {**SPEC, "name": "OtherLM"}
+        ) == (None, None)
+
+    asyncio.run(run())
+
+
+# ----------------------------------------------------------------------
+# cluster: sharded job equality + disaggregated handoff (full stack)
+# ----------------------------------------------------------------------
+
+
+async def _disagg_cluster_run(tmp):
+    from dml_tpu.cluster.chaos import LocalCluster
+    from dml_tpu.cluster.store.data_plane import TunnelFault
+    from dml_tpu.jobs.service import JobService
+
+    params, cfg = lm_spec_parts(SPEC)
+    mesh = make_mesh(MeshSpec(dp=1, tp=2), devices=jax.devices()[:2])
+    be_dis = sharded_lm_backend(SPEC, mesh, form="resident")
+    be_single = LMBackend(params, cfg, max_new_tokens=NEW_TOKENS,
+                          max_slots=2, max_len=64, chunk=4)
+    prefill_be = LMPrefillBackend(params, cfg, max_len=64)
+    group = WorkerGroupSpec(
+        "tp0", ("H4", "H5"), MeshSpec(dp=1, tp=2),
+        lm_models=("ShardLM",),
+        roles={"H4": "decode", "H5": "prefill"},
+    )
+    holder = {}
+    services = {}
+
+    def make_jobs(node, store):
+        js = JobService(node, store)
+        uname = node.me.unique_name
+        alive = lambda: {  # noqa: E731
+            n.unique_name for n in node.membership.alive_nodes()
+        }
+        members = node.spec.group_members_unique(group.name)
+        gb = None
+        if members and uname == members[0]:
+            gb = DisaggLMBackend(
+                be_dis, model_name="ShardLM", group_name=group.name,
+                node=node, store=store, members=members,
+                alive_fn=alive, capacity=2.0,
+            )
+            holder["gb"] = gb
+            holder["store"] = store
+        js.register_lm(
+            "ShardLM", backend=be_single.backend,
+            cost=be_single.cost(), prefill=prefill_be,
+            group_backend=gb,
+        )
+        services[uname] = js
+        return js
+
+    cluster = LocalCluster(
+        5, tmp, 19221,
+        timing=Timing(ping_interval=0.2, ack_timeout=0.3,
+                      cleanup_time=1.0, leader_rpc_timeout=10.0),
+        worker_groups=[group],
+        make_jobs=make_jobs,
+    )
+    try:
+        await cluster.start()
+        await cluster.wait_for(
+            cluster.converged, 30.0, "disagg cluster convergence"
+        )
+        client = cluster.client()
+        rng = np.random.RandomState(1)
+        expected = {}
+        local_paths = []
+        for i in range(4):
+            prompt = rng.randint(0, SPEC["vocab_size"],
+                                 int(rng.randint(4, 20)))
+            fname = f"p{i}.tokens.txt"
+            p = os.path.join(tmp, fname)
+            write_prompt_file(p, prompt)
+            await client.store.put(p, fname)
+            local_paths.append(p)
+            expected[fname] = list(_expect(params, cfg, prompt,
+                                           NEW_TOKENS))
+
+        # 1) full-pipeline disaggregated job: store -> scheduler ->
+        # decode primary -> prefill-role handoff -> merged output,
+        # token-identical to isolated generate()
+        job_id = await client.jobs.submit_job("ShardLM", 8)
+        done = await client.jobs.wait_job(job_id, timeout=120.0)
+        assert done["total_queries"] == 8
+        merged = await client.jobs.get_output(
+            job_id, os.path.join(tmp, "out.json")
+        )
+        assert merged
+        for fname, out in merged.items():
+            assert out["tokens"] == expected[fname], fname
+        gb = holder["gb"]
+        assert gb.handoffs >= 1, "no prefill->decode handoff happened"
+        assert gb.handoff_bytes > 0
+        assert gb.fallbacks == 0
+
+        # the LM round kept the group collapsed: the leader's pool
+        # shows the primary as one weighted slot (the lifted PR-5
+        # restriction)
+        leader_js = services[cluster.leader_uname()]
+        pool = leader_js.worker_pool()
+        primary = cluster.spec.group_members_unique(group.name)[0]
+        lender = cluster.spec.group_members_unique(group.name)[1]
+        assert primary in pool and lender not in pool
+        assert leader_js._pool_weights[primary] == 2.0
+
+        # 2) FAILING tunnel on the decode side's slab pull: the
+        # backend falls back to local prefill, outputs unchanged
+        handoffs_before = gb.handoffs
+        holder["store"].data_plane.fault = TunnelFault(
+            seed=3, fail_pct=100.0
+        )
+        results, _, _ = await gb("ShardLM", local_paths)
+        assert gb.fallbacks >= 1
+        assert gb.handoffs == handoffs_before
+        for p in local_paths:
+            fname = os.path.basename(p)
+            assert results[p]["tokens"] == expected[fname]
+
+        # 3) SLOW tunnel: the handoff survives (just slower)
+        holder["store"].data_plane.fault = TunnelFault(
+            seed=4, delay_s=0.05
+        )
+        results, _, _ = await gb("ShardLM", local_paths)
+        assert gb.handoffs == handoffs_before + 1
+        for p in local_paths:
+            fname = os.path.basename(p)
+            assert results[p]["tokens"] == expected[fname]
+        holder["store"].data_plane.fault = None
+    finally:
+        await cluster.stop()
+        be_single.close()
+
+
+@pytest.mark.sharded
+@pytest.mark.disagg
+def test_disagg_cluster_handoff_and_fallback(tmp_path):
+    asyncio.run(_disagg_cluster_run(str(tmp_path)))
+
+
+# ----------------------------------------------------------------------
+# claim_check: the cluster_lm_sharded gate (round 8+) + compact line
+# ----------------------------------------------------------------------
+
+
+GOOD_LM_SHARDED = {
+    "nodes": 5,
+    "tok_s_param_gather": 210.0,
+    "tok_s_resident": 350.0,
+    "tok_s_disagg": 280.0,
+    "resident_vs_gather": 1.67,
+    "tokens_equal_single_chip": True,
+    "kv_handoff_bytes": 41872,
+    "modes": {"disagg": {"handoffs": 9, "fallbacks": 0,
+                         "handoff_bytes": 41872}},
+    "groups": {"tp0": {
+        "members": ["127.0.0.1:28964", "127.0.0.1:28965"],
+        "primary": "127.0.0.1:28964",
+        "mesh": {"dp": 1, "tp": 2},
+        "roles": {"127.0.0.1:28964": "decode",
+                  "127.0.0.1:28965": "prefill"},
+    }},
+}
+
+
+def _artifact(tmp_path, name, doc):
+    import json
+
+    p = str(tmp_path / f"{name}.json")
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    return p
+
+
+def test_claim_check_lm_sharded_block(tmp_path):
+    from dml_tpu.tools import claim_check as cc
+
+    ok = _artifact(tmp_path, "BENCH_r08a", {
+        "matrix": {"cluster_lm_sharded": GOOD_LM_SHARDED},
+    })
+    assert cc.check_lm_sharded_block(ok) == []
+    # pre-round-8 artifacts exempt
+    assert cc.check_lm_sharded_block(_artifact(
+        tmp_path, "BENCH_r07x", {"matrix": {}},
+    )) == []
+    # budget-skip and in-block skip are honest exemptions
+    assert cc.check_lm_sharded_block(_artifact(tmp_path, "BENCH_r08b", {
+        "matrix": {"_skipped": {"cluster_lm_sharded": "budget"}},
+    })) == []
+    assert cc.check_lm_sharded_block(_artifact(tmp_path, "BENCH_r08c", {
+        "matrix": {"cluster_lm_sharded": {
+            "skipped": True, "reason": "one device"}},
+    })) == []
+    # missing section from round 8 fails
+    bad = cc.check_lm_sharded_block(_artifact(tmp_path, "BENCH_r08d", {
+        "matrix": {"cluster_serving": {"qps_end_to_end": 1.0}},
+    }))
+    assert any("no `cluster_lm_sharded`" in p for p in bad)
+    # equality false = sharded LM serving changes answers
+    bad = cc.check_lm_sharded_block(_artifact(tmp_path, "BENCH_r08e", {
+        "matrix": {"cluster_lm_sharded": dict(
+            GOOD_LM_SHARDED, tokens_equal_single_chip=False)},
+    }))
+    assert any("token-identical" in p for p in bad)
+    # every mode must have measured a finite positive rate
+    for key in ("tok_s_param_gather", "tok_s_resident", "tok_s_disagg"):
+        bad = cc.check_lm_sharded_block(_artifact(
+            tmp_path, f"BENCH_r08f{key[-3:]}", {
+                "matrix": {"cluster_lm_sharded": dict(
+                    GOOD_LM_SHARDED, **{key: 0.0})},
+            },
+        ))
+        assert any(key in p for p in bad), key
+    # recorded handoffs with zero bytes = the slab never moved
+    bad = cc.check_lm_sharded_block(_artifact(tmp_path, "BENCH_r08g", {
+        "matrix": {"cluster_lm_sharded": dict(
+            GOOD_LM_SHARDED, kv_handoff_bytes=0)},
+    }))
+    assert any("kv_handoff_bytes" in p for p in bad)
+    # disagg served with neither handoffs nor fallbacks = broken
+    # accounting
+    bad = cc.check_lm_sharded_block(_artifact(tmp_path, "BENCH_r08h", {
+        "matrix": {"cluster_lm_sharded": dict(
+            GOOD_LM_SHARDED,
+            modes={"disagg": {"handoffs": 0, "fallbacks": 0}})},
+    }))
+    assert any("accounting" in p for p in bad)
+    # topology echo required
+    bad = cc.check_lm_sharded_block(_artifact(tmp_path, "BENCH_r08i", {
+        "matrix": {"cluster_lm_sharded": dict(GOOD_LM_SHARDED,
+                                              groups={})},
+    }))
+    assert any("topology" in p for p in bad)
+    # summary-only captures gate on the compact lm_sharded_equal flag
+    import json
+
+    def wrapper(name, equal):
+        line = json.dumps({
+            "bench_summary_v1": True,
+            "summary": {"lm_sharded_toks": 350.0,
+                        "lm_sharded_equal": equal},
+        })
+        return _artifact(tmp_path, name, {
+            "cmd": "bench", "rc": 0,
+            "tail": '{"metric": "truncated...\n' + line + "\n",
+        })
+
+    assert cc.check_lm_sharded_block(wrapper("BENCH_r08j", True)) == []
+    bad = cc.check_lm_sharded_block(wrapper("BENCH_r08k", False))
+    assert any("diverged" in p for p in bad)
+
+
+def test_compact_summary_keeps_lm_sharded_keys():
+    """The last-resort trim keeps lm_sharded_toks / lm_disagg_toks /
+    lm_sharded_equal (the round-8 summary gate keys) inside the
+    1,500-char budget."""
+    import json
+
+    from bench import COMPACT_SUMMARY_BUDGET, compact_summary_line
+
+    summary = {
+        "headline_qps": 14388.3,
+        "cluster_qps": 74.6,
+        "lm_sharded_toks": 350.0,
+        "lm_disagg_toks": 280.0,
+        "lm_sharded_equal": True,
+        "lm_sharded_vs_gather": 1.67,
+        "lm_kv_handoff_bytes": 41872,
+        "section_errors": [], "sections_skipped": [],
+        # fat filler to force the last-resort path
+        "section_wall_s": {
+            f"a_very_long_section_name_{i}": 123.456 for i in range(90)
+        },
+        "kv_heads_tok_s": {f"form_{i}": 1000.0 + i for i in range(40)},
+        "chaos_scenarios_ok": {f"fam_{i}": True for i in range(40)},
+        "lm_tok_s": {f"cfg_{i}": 100.0 for i in range(40)},
+    }
+    line = compact_summary_line({"qps": 14388.3}, "dev", 4.0, summary)
+    assert len(line) <= COMPACT_SUMMARY_BUDGET
+    doc = json.loads(line)
+    assert doc["summary"]["lm_sharded_toks"] == 350.0
+    assert doc["summary"]["lm_disagg_toks"] == 280.0
+    assert doc["summary"]["lm_sharded_equal"] is True

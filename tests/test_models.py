@@ -83,3 +83,36 @@ def test_decode_predictions_format():
     table = class_index()
     assert len(table) == 1000
     assert top[0][0][1] == table[42][1]
+
+
+def test_efficientnet_s2d_stem_equivalent():
+    """The space-to-depth stem (b4_s2d_stem bench experiment) is the
+    SAME function on the SAME `stem_conv` parameter as the stock
+    stride-2 stem: identical param tree, outputs equal on even AND
+    odd spatial inputs (the odd case exercises the extra zero row/col
+    the folded 4th kernel row reads). A regression here would turn
+    the bench's A/B into a timing comparison of two different
+    networks."""
+    from dml_tpu.models.efficientnet import build_variant
+
+    rng = np.random.RandomState(0)
+    m0 = build_variant("b0", dtype=jnp.float32)
+    m1 = build_variant("b0", dtype=jnp.float32, s2d_stem=True)
+    vs = m0.init(jax.random.PRNGKey(0), jnp.zeros((1, 96, 96, 3), jnp.uint8))
+    shapes = jax.tree_util.tree_map(lambda a: a.shape, vs["params"])
+    shapes_s2d = jax.tree_util.tree_map(
+        lambda a: a.shape,
+        m1.init(jax.random.PRNGKey(0),
+                jnp.zeros((1, 96, 96, 3), jnp.uint8))["params"],
+    )
+    assert shapes == shapes_s2d  # weight-import compatible
+    for hw in (96, 97):  # even + odd inputs
+        x = jnp.asarray(
+            rng.randint(0, 255, (2, hw, hw, 3)).astype(np.uint8)
+        )
+        y0 = m0.apply(vs, x, train=False)
+        y1 = m1.apply(vs, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(y0), np.asarray(y1), atol=2e-5,
+            err_msg=f"hw={hw}",
+        )
