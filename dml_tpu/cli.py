@@ -81,6 +81,19 @@ job commands (ML inference):
   parity-store                      imagenet parity report consuming weights
                                     (.npz/.h5 + class index) from the
                                     replicated store (operator `put`s them)
+request commands (SLO-aware per-request front door, dml_tpu/ingress/):
+  request <model> [slo] [text...]   submit ONE request (interactive|batch
+                                    class; optional inline text payload,
+                                    else a store input is sampled) and
+                                    wait for its terminal — a shed
+                                    request gets a typed rejection
+                                    immediately, never a timeout
+  request-load <seed> <qps> <dur_s> [model] [slo_mix e.g. interactive:0.8,batch:0.2]
+                                    seeded OPEN-LOOP load run from this
+                                    node: deterministic Poisson arrivals,
+                                    p50/p95/p99 + goodput + shed scorecard
+  ingress                           front-door state: classes, forming
+                                    batches, in-flight counts, shed totals
 observability:
   profile metrics [prom|json]       this node's metrics registry — summary
                                     roll-up (default), Prometheus exposition
@@ -115,6 +128,11 @@ class NodeApp:
             self.node, self.store,
             group_backend=wire_group_backend(self.node),
         )
+        # request front door (dml_tpu/ingress/): router role activates
+        # with leadership, the client verbs work from any node
+        from .ingress.router import RequestRouter
+
+        self.ingress = RequestRouter(self.jobs)
         self._lm_specs = list(lm_specs)
 
     async def start(self) -> None:
@@ -152,8 +170,12 @@ class NodeApp:
         await self.node.start()
         await self.store.start()
         await self.jobs.start()
+        if getattr(self, "ingress", None) is not None:
+            await self.ingress.start()
 
     async def stop(self) -> None:
+        if getattr(self, "ingress", None) is not None:
+            await self.ingress.stop()
         await self.jobs.stop()
         await self.store.stop()
         await self.node.stop()
@@ -337,6 +359,55 @@ class NodeApp:
             print("ok")
         elif cmd == "C5":
             print(json.dumps(j.c5_assignments(), indent=2))
+        elif cmd == "request" and a:
+            from .ingress.router import RequestRejected
+
+            slo, rest = "interactive", a[1:]
+            if rest and rest[0] in self.ingress.classes:
+                slo, rest = rest[0], rest[1:]
+            payload = " ".join(rest) or None
+            try:
+                term = await self.ingress.request(
+                    a[0], slo=slo, payload=payload, timeout=60.0
+                )
+                print(json.dumps(term, indent=2, default=str))
+                print(f"({time.monotonic() - t0:.2f}s)")
+            except RequestRejected as e:
+                kind = "SHED" if e.shed else "REJECTED"
+                print(f"!! {kind}: {e.reason} "
+                      f"({time.monotonic() - t0:.3f}s — typed rejection, "
+                      "not a timeout)")
+        elif cmd == "request-load" and len(a) >= 3:
+            from .ingress import loadgen
+
+            model = a[3] if len(a) > 3 else "ResNet50"
+            mix = {"interactive": 1.0}
+            if len(a) > 4:
+                mix = {
+                    part.split(":")[0]: float(part.split(":")[1])
+                    for part in a[4].split(",")
+                }
+            trace = loadgen.open_loop_trace(
+                int(a[0]), duration_s=float(a[2]), rate_qps=float(a[1]),
+                model=model, slo_mix=mix,
+            )
+
+            async def one(arr):
+                # the shared driver the bench's phases use — LOST,
+                # shed, and rejected classify identically everywhere
+                return await loadgen.drive_one(
+                    self.ingress, arr, submit_timeout=8.0,
+                    wait_timeout=60.0,
+                )
+
+            print(f"open-loop: {len(trace.arrivals)} arrivals over "
+                  f"{trace.duration_s:g}s (seed {trace.seed})")
+            outcomes, wall = await loadgen.run_open_loop(one, trace)
+            print(json.dumps(
+                loadgen.summarize(outcomes, wall), indent=2
+            ))
+        elif cmd == "ingress":
+            print(json.dumps(self.ingress.stats(), indent=2))
         elif cmd == "breakdown":
             print(json.dumps({
                 "per_batch_ms": j.breakdown_stats(),

@@ -607,6 +607,9 @@ class SimNode:
     node: Node
     store: StoreService
     jobs: Any  # JobService (imported lazily to keep jax out)
+    #: RequestRouter when the cluster runs with_ingress=True (the
+    #: request front door, dml_tpu/ingress/); None otherwise
+    ingress: Any = None
 
 
 class LocalCluster:
@@ -625,13 +628,26 @@ class LocalCluster:
         batch_size: int = 8,
         make_jobs: Optional[Callable[[Node, StoreService], Any]] = None,
         worker_groups: Optional[List[Any]] = None,
+        with_ingress: bool = False,
+        ingress_formation: str = "continuous",
+        ingress_classes: Optional[Dict[str, Any]] = None,
     ):
         """`worker_groups` (config.WorkerGroupSpec list) pools nodes
         into tensor-parallel serving groups (jobs/groups.py); the
         default job factory then gives each group primary a stub
         GROUP backend whose throughput scales with group capacity and
         which degrades (GroupDegraded) when a member dies mid-batch —
-        the control-plane shape of sharded serving, jax-free."""
+        the control-plane shape of sharded serving, jax-free.
+
+        `with_ingress=True` attaches the request front door
+        (dml_tpu/ingress/) to every node — a RequestRouter (active
+        while that node leads; client verbs anywhere) plus the
+        streaming LM stub registered as a servable model, so ingress
+        tests and the `request_serving` bench drive per-request
+        traffic through the same invariant-checked chassis.
+        `ingress_formation` picks the batch-formation mode
+        ("continuous" product default | "fixed" naive baseline);
+        `ingress_classes` overrides the SLO class table."""
         self.root = root
         self.seed = seed
         self.batch_size = batch_size
@@ -647,6 +663,9 @@ class LocalCluster:
             worker_groups=list(worker_groups or []),
         )
         self._make_jobs = make_jobs or self._default_jobs
+        self.with_ingress = with_ingress
+        self.ingress_formation = ingress_formation
+        self.ingress_classes = ingress_classes
         self.dns = IntroducerService(self.spec)
         self.nodes: Dict[str, SimNode] = {}
         #: files the replication check must account for — guards the
@@ -693,6 +712,22 @@ class LocalCluster:
             node, store, infer_backend=stub_backend(), group_backend=gb
         )
         js.scheduler.set_batch_size(STUB_MODEL, self.batch_size)
+        if self.with_ingress:
+            # streaming LM stub as a servable per-request model: the
+            # front door's token-streaming path stays jax-free (the
+            # control plane + formation machinery is what's under test)
+            from ..ingress.streaming import STUB_LM_MODEL, streaming_lm_stub
+            from ..jobs.cost_model import ModelCost
+
+            js.register_lm(
+                STUB_LM_MODEL,
+                backend=streaming_lm_stub(),
+                cost=ModelCost(
+                    load_time=0.0, first_query=0.01, per_query=0.004,
+                    batch_size=self.batch_size,
+                ),
+                patterns=("*.prompt.txt", "ingress_*.req"),
+            )
         return js
 
     # ---- lifecycle ----
@@ -709,6 +744,15 @@ class LocalCluster:
             node, root=os.path.join(self.root, f"st_{nid.port}")
         )
         jobs = self._make_jobs(node, store)
+        ingress = None
+        if self.with_ingress:
+            from ..ingress.router import RequestRouter
+
+            ingress = RequestRouter(
+                jobs,
+                classes=self.ingress_classes,
+                formation=self.ingress_formation,
+            )
         started: List[Any] = []
         try:
             await node.start()
@@ -716,13 +760,16 @@ class LocalCluster:
             await store.start()
             started.append(store)
             await jobs.start()
+            started.append(jobs)
+            if ingress is not None:
+                await ingress.start()
         except Exception:
             # a partial bring-up (e.g. stale port) must not leak the
             # services that did come up
             for svc in reversed(started):
                 await svc.stop()
             raise
-        sn = SimNode(node=node, store=store, jobs=jobs)
+        sn = SimNode(node=node, store=store, jobs=jobs, ingress=ingress)
         self.nodes[nid.unique_name] = sn
         self._apply_faults_to(sn)
         return sn
@@ -733,6 +780,8 @@ class LocalCluster:
         disk (a crash does not wipe a disk), so a restart with the
         same identity reports its old inventory."""
         sn = self.nodes.pop(uname)
+        if sn.ingress is not None:
+            await sn.ingress.stop()
         await sn.jobs.stop()
         await sn.store.stop()
         await sn.node.stop()

@@ -16,6 +16,11 @@ Ops:
 - fetch_token: pull a client-exposed local file (PUT path). The client
   registers the path first and the token travels via the leader —
   unlike scp, arbitrary remote paths are not readable.
+- fetch_stream: pull an exposed LIVE byte stream (request front door,
+  dml_tpu/ingress/): the serving node registers a StreamFeed, pushes
+  chunks into it as an LM request decodes, and the client reads
+  length-prefixed chunks until the zero-length EOF frame — tokens
+  reach the client while the batch is still decoding.
 """
 
 from __future__ import annotations
@@ -70,6 +75,43 @@ class TunnelFault:
             raise ConnectionError("injected tunnel fault (TunnelFault)")
 
 
+class StreamFeed:
+    """One live outbound byte stream (token streaming, ingress/).
+
+    The producer ``push()``es chunks from any coroutine on the loop
+    (backends decoding on a thread hop via call_soon_threadsafe) and
+    ``close()``s at EOF; the data-plane server drains the queue to the
+    one puller. Bounded: a puller that never connects cannot grow the
+    queue past ``maxsize`` — overflow drops the OLDEST chunk (token
+    streaming is a latency optimization; the full result still arrives
+    via the request terminal)."""
+
+    def __init__(self, maxsize: int = 4096):
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._maxsize = maxsize
+        self.closed = False
+        self.dropped = 0
+
+    def push(self, data: bytes) -> None:
+        if self.closed or not data:
+            return
+        while self._q.qsize() >= self._maxsize:
+            try:
+                self._q.get_nowait()
+                self.dropped += 1
+            except asyncio.QueueEmpty:
+                break
+        self._q.put_nowait(data)
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._q.put_nowait(None)
+
+    async def get(self) -> Optional[bytes]:
+        return await self._q.get()
+
+
 class DataPlane:
     def __init__(self, store: LocalStore, host: str = "127.0.0.1", port: int = 0):
         self.store = store
@@ -77,6 +119,7 @@ class DataPlane:
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
         self._exposed: Dict[str, str] = {}  # token -> local path
+        self._streams: Dict[str, StreamFeed] = {}  # token -> live feed
         # fault-injection seam: slow/failing outbound pulls (chaos)
         self.fault: Optional[TunnelFault] = None
 
@@ -106,6 +149,21 @@ class DataPlane:
     def unexpose(self, token: str) -> None:
         self._exposed.pop(token, None)
 
+    def expose_stream(self) -> Tuple[str, StreamFeed]:
+        """Register a live outbound stream; returns (token, feed). The
+        serving side pushes chunks into the feed and close()s at EOF;
+        the token travels to the consumer over the control plane
+        (REQUEST_STREAM_READY)."""
+        token = secrets.token_hex(16)
+        feed = StreamFeed()
+        self._streams[token] = feed
+        return token, feed
+
+    def unexpose_stream(self, token: str) -> None:
+        feed = self._streams.pop(token, None)
+        if feed is not None:
+            feed.close()
+
     # ---- server ----
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -121,6 +179,8 @@ class DataPlane:
                 await self._serve_store(writer, req)
             elif op == "fetch_token":
                 await self._serve_token(writer, req)
+            elif op == "fetch_stream":
+                await self._serve_stream(writer, req)
             else:
                 await self._reply(writer, {"ok": False, "error": f"unknown op {op!r}"})
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -184,6 +244,40 @@ class DataPlane:
         with open(path, "rb") as f:
             data = f.read()
         await self._reply(writer, {"ok": True, "size": len(data)}, data)
+
+    #: inactivity bound per stream chunk: a producer that silently
+    #: died must not pin the connection (and its feed) forever
+    STREAM_IDLE_TIMEOUT = 60.0
+
+    async def _serve_stream(self, writer, req: dict) -> None:
+        """Drain a live StreamFeed to the puller: header line, then
+        length-prefixed chunks (4-byte big-endian) until a zero-length
+        EOF frame. One puller per token; the token retires after the
+        serve (streams are per-request transients, like KV slabs)."""
+        import struct as _struct
+
+        token = req.get("token", "")
+        feed = self._streams.get(token)
+        if feed is None:
+            await self._reply(writer, {"ok": False, "error": "unknown token"})
+            return
+        writer.write(json.dumps({"ok": True, "stream": True}).encode() + b"\n")
+        try:
+            while True:
+                chunk = await asyncio.wait_for(
+                    feed.get(), self.STREAM_IDLE_TIMEOUT
+                )
+                if chunk is None:
+                    writer.write(_struct.pack("!I", 0))
+                    await writer.drain()
+                    return
+                writer.write(_struct.pack("!I", len(chunk)) + chunk)
+                await writer.drain()
+        except asyncio.TimeoutError:
+            writer.write(_struct.pack("!I", 0))
+            await writer.drain()
+        finally:
+            self._streams.pop(token, None)
 
     # ---- client ----
 
@@ -262,6 +356,51 @@ class DataPlane:
         if not header.get("ok"):
             raise FileNotFoundError(f"token at {addr}: {header.get('error')}")
         return payload
+
+    async def fetch_stream(
+        self,
+        addr: Tuple[str, int],
+        token: str,
+        timeout: float = 60.0,
+    ):
+        """Async generator over a remote live stream's chunks (token
+        streaming for per-request LM serving, dml_tpu/ingress/).
+        Yields each chunk as it arrives; returns at the zero-length
+        EOF frame. ``timeout`` bounds the wait for EACH chunk, not the
+        whole stream. TunnelFault applies like any other client pull."""
+        import struct as _struct
+
+        await self._maybe_fault()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(*addr), timeout
+        )
+        try:
+            writer.write(
+                json.dumps({"op": "fetch_stream", "token": token}).encode()
+                + b"\n"
+            )
+            await writer.drain()
+            header = json.loads(
+                await asyncio.wait_for(reader.readline(), timeout)
+            )
+            if not header.get("ok"):
+                raise FileNotFoundError(
+                    f"stream at {addr}: {header.get('error')}"
+                )
+            while True:
+                raw = await asyncio.wait_for(reader.readexactly(4), timeout)
+                (size,) = _struct.unpack("!I", raw)
+                if size == 0:
+                    return
+                yield await asyncio.wait_for(
+                    reader.readexactly(size), timeout
+                )
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
 
     async def fetch_token_to_store(
         self,

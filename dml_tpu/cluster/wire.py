@@ -120,6 +120,32 @@ class MsgType(enum.IntEnum):
     # observability.merge_snapshots.
     METRICS_PULL = 80
     METRICS_PULL_ACK = 81
+    # request front door (L9, dml_tpu/ingress/): per-request ingress
+    # with SLO classes. SUBMIT carries one request (model, slo class,
+    # optional inline payload / store input / session id / stream
+    # flag); the ACK is the admission decision — accepted, or a TYPED
+    # rejection (shed) that the client gets immediately instead of a
+    # timeout. DONE is the router's terminal push to the client
+    # (result or typed failure); STATUS/STATUS_ACK is the client's
+    # re-poll fallback for a dropped DONE push (the wait_job
+    # discipline applied per request). STREAM_READY is pushed by the
+    # WORKER executing a streaming LM batch: it tells the client where
+    # on the worker's TCP data plane to pull the request's token
+    # stream as it decodes (bulk tokens never ride UDP). SUBMIT_ACK /
+    # STATUS_ACK are deliberately unregistered — the dispatcher's rid
+    # fallback resolves the awaiting request future, like
+    # SET_BATCH_SIZE_ACK.
+    REQUEST_SUBMIT = 90
+    REQUEST_SUBMIT_ACK = 91
+    REQUEST_DONE = 92
+    REQUEST_STATUS = 93
+    REQUEST_STATUS_ACK = 94
+    REQUEST_STREAM_READY = 95
+    # router -> standby: which request ids ride which dispatched job,
+    # so a promoted router can fan completions back out to clients —
+    # in-flight requests either complete or are explicitly rejected
+    # across a failover, never silently lost
+    INGRESS_RELAY = 96
 
 
 @dataclass(frozen=True)

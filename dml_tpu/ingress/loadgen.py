@@ -1,0 +1,315 @@
+"""Open-loop load generator for the request front door.
+
+Closed-loop drivers (submit, wait, submit again — every prior bench
+phase worked this way) hide overload: a slow server slows the *driver*
+down, so measured latency stays flat while real users would be piling
+up. The serving literature scores the **open-loop** regime instead
+(arxiv 2605.25645): arrivals follow a fixed trace regardless of how
+the server is doing, so queueing delay and shedding show up exactly
+as a user population would feel them.
+
+Three pieces, all deterministic:
+
+- ``ArrivalTrace`` / ``open_loop_trace(seed, ...)`` — a seeded
+  Poisson-process arrival schedule with per-request model / SLO-class
+  / session draws. Same seed => byte-identical trace (asserted by a
+  JSON round-trip test); traces serialize so a bench run's workload
+  can be re-issued verbatim.
+- ``run_open_loop(submit, trace)`` — fire each arrival at its trace
+  time (never gated on earlier completions), collect one terminal
+  ``Outcome`` per request.
+- ``summarize(outcomes, wall_s)`` — tail-latency scoring: p50/p95/p99
+  over requests that COMPLETED (shed requests are counted as
+  rejections and excluded from the latency distribution — a latency
+  percentile that averages in instant rejections would flatter the
+  tail), goodput (completions inside their deadline per second), and
+  the shed ratio.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import random
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One scheduled request: fire at trace start + ``t`` seconds."""
+
+    t: float
+    model: str
+    slo: str
+    session: Optional[str] = None
+    stream: bool = False
+
+
+@dataclass
+class ArrivalTrace:
+    seed: int
+    duration_s: float
+    rate_qps: float
+    arrivals: List[Arrival] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "seed": self.seed,
+                "duration_s": self.duration_s,
+                "rate_qps": self.rate_qps,
+                "arrivals": [asdict(a) for a in self.arrivals],
+            },
+            separators=(",", ":"),
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "ArrivalTrace":
+        d = json.loads(text)
+        return cls(
+            seed=int(d["seed"]),
+            duration_s=float(d["duration_s"]),
+            rate_qps=float(d["rate_qps"]),
+            arrivals=[Arrival(**a) for a in d["arrivals"]],
+        )
+
+
+def open_loop_trace(
+    seed: int,
+    duration_s: float,
+    rate_qps: float,
+    model: str = "stub",
+    slo_mix: Optional[Dict[str, float]] = None,
+    session_pct: float = 0.0,
+    n_sessions: int = 8,
+    stream_pct: float = 0.0,
+) -> ArrivalTrace:
+    """Seeded Poisson arrivals at ``rate_qps`` for ``duration_s``.
+
+    ``slo_mix`` maps class name -> weight (default all interactive);
+    ``session_pct`` percent of requests carrying a session id (drawn
+    from ``n_sessions`` stable ids — multi-turn affinity traffic);
+    ``stream_pct`` percent requesting token streaming. Every draw
+    comes from one ``random.Random(seed)`` in arrival order, so the
+    whole trace — times, classes, sessions — replays identically."""
+    rng = random.Random(seed)
+    mix = list((slo_mix or {"interactive": 1.0}).items())
+    total_w = sum(w for _, w in mix) or 1.0
+    arrivals: List[Arrival] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate_qps) if rate_qps > 0 else duration_s
+        if t >= duration_s:
+            break
+        x = rng.random() * total_w
+        slo = mix[-1][0]
+        for name, w in mix:
+            if x < w:
+                slo = name
+                break
+            x -= w
+        session = (
+            f"s{rng.randrange(n_sessions)}"
+            if rng.random() * 100.0 < session_pct else None
+        )
+        stream = rng.random() * 100.0 < stream_pct
+        arrivals.append(Arrival(
+            t=round(t, 6), model=model, slo=slo,
+            session=session, stream=stream,
+        ))
+    return ArrivalTrace(
+        seed=seed, duration_s=duration_s, rate_qps=rate_qps,
+        arrivals=arrivals,
+    )
+
+
+# ----------------------------------------------------------------------
+# outcomes + scoring
+# ----------------------------------------------------------------------
+
+#: terminal states (exactly one per request — the front-door contract)
+TERMINAL_COMPLETED = "completed"
+TERMINAL_SHED = "shed"          # typed rejection at the admission door
+TERMINAL_REJECTED = "rejected"  # typed rejection after admission
+TERMINAL_LOST = "lost"          # coordinator lost it (failover); the
+                                # client converted silence into a typed
+                                # terminal — still counted as rejection
+
+
+@dataclass
+class Outcome:
+    """One request's terminal record."""
+
+    slo: str
+    terminal: str
+    e2e_s: Optional[float] = None  # submit -> terminal (completions)
+    deadline_met: bool = False
+    reason: Optional[str] = None
+    model: str = ""
+    session: Optional[str] = None
+    worker: Optional[str] = None
+    #: completions only: the terminal carried actual result payload.
+    #: A completed outcome WITHOUT one is the silent-loss failure the
+    #: front door types as result_unavailable instead — the failover
+    #: bench asserts this never reads False on a completion.
+    has_result: bool = False
+
+
+def percentile(sorted_vals: Sequence[float], p: float) -> float:
+    """Linear-interpolation percentile over an already-sorted sample
+    (the NIST/numpy 'linear' definition): rank ``p/100 * (n-1)`` is
+    interpolated between its floor and ceiling neighbors. The test
+    fixture hand-computes these."""
+    if not sorted_vals:
+        return math.nan
+    if len(sorted_vals) == 1:
+        return float(sorted_vals[0])
+    rank = (p / 100.0) * (len(sorted_vals) - 1)
+    lo = math.floor(rank)
+    hi = math.ceil(rank)
+    frac = rank - lo
+    return float(sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac)
+
+
+def summarize(
+    outcomes: Sequence[Outcome], wall_s: float
+) -> Dict[str, Any]:
+    """Tail-latency + goodput scorecard over one open-loop run.
+
+    Latency percentiles are computed over COMPLETED requests only:
+    shed/rejected/lost requests are terminal *rejections* — they are
+    counted (``shed`` / ``rejected`` and the ``shed_ratio``) but
+    excluded from the latency distribution, because an immediate
+    rejection's near-zero "latency" would deflate the percentiles of
+    the requests the cluster actually served. Goodput counts only
+    completions that made their deadline."""
+    out: Dict[str, Any] = {"n": len(outcomes), "wall_s": round(wall_s, 3)}
+    by_class: Dict[str, List[Outcome]] = {}
+    for o in outcomes:
+        by_class.setdefault(o.slo, []).append(o)
+
+    def score(rows: Sequence[Outcome]) -> Dict[str, Any]:
+        lat = sorted(
+            o.e2e_s for o in rows
+            if o.terminal == TERMINAL_COMPLETED and o.e2e_s is not None
+        )
+        completed = sum(1 for o in rows if o.terminal == TERMINAL_COMPLETED)
+        shed = sum(1 for o in rows if o.terminal == TERMINAL_SHED)
+        rejected = sum(
+            1 for o in rows
+            if o.terminal in (TERMINAL_REJECTED, TERMINAL_LOST)
+        )
+        good = sum(
+            1 for o in rows
+            if o.terminal == TERMINAL_COMPLETED and o.deadline_met
+        )
+        return {
+            "n": len(rows),
+            "completed": completed,
+            "shed": shed,
+            "rejected": rejected,
+            "goodput_qps": round(good / wall_s, 2) if wall_s > 0 else 0.0,
+            "shed_ratio": (
+                round((shed + rejected) / len(rows), 4) if rows else 0.0
+            ),
+            "latency_ms": {
+                "p50": round(percentile(lat, 50) * 1e3, 1) if lat else None,
+                "p95": round(percentile(lat, 95) * 1e3, 1) if lat else None,
+                "p99": round(percentile(lat, 99) * 1e3, 1) if lat else None,
+            },
+        }
+
+    out.update(score(outcomes))
+    out["by_class"] = {c: score(rows) for c, rows in sorted(by_class.items())}
+    return out
+
+
+async def drive_one(
+    ingress,
+    a: Arrival,
+    *,
+    submit_timeout: float = 8.0,
+    wait_timeout: float = 45.0,
+    deadline_by_class: Optional[Dict[str, float]] = None,
+    now: Callable[[], float] = time.monotonic,
+) -> Outcome:
+    """Drive ONE arrival through a RequestRouter's client verbs to a
+    terminal Outcome — the shared submit/wait/classify mapping the
+    bench's open-loop phases and the CLI ``request-load`` verb both
+    use (one copy, so a LOST terminal is classified identically
+    everywhere). e2e is measured CLIENT-side (includes the submit
+    round trip); ``deadline_by_class`` overrides the router's
+    deadline_met with the client-side clock when provided."""
+    from .router import RequestRejected
+
+    t0 = now()
+    try:
+        rid = await ingress.submit(
+            a.model, slo=a.slo, session=a.session, stream=a.stream,
+            timeout=submit_timeout,
+        )
+    except RequestRejected as e:
+        return Outcome(
+            slo=a.slo,
+            terminal=TERMINAL_SHED if e.shed else TERMINAL_REJECTED,
+            reason=e.reason, model=a.model, session=a.session,
+        )
+    except Exception as e:
+        return Outcome(slo=a.slo, terminal=TERMINAL_LOST, reason=repr(e),
+                       model=a.model, session=a.session)
+    try:
+        term = await ingress.wait(rid, timeout=wait_timeout)
+    except Exception as e:
+        return Outcome(slo=a.slo, terminal=TERMINAL_LOST,
+                       reason=f"wait: {e!r}", model=a.model,
+                       session=a.session)
+    e2e = now() - t0
+    if term.get("ok"):
+        if deadline_by_class and a.slo in deadline_by_class:
+            met = e2e <= deadline_by_class[a.slo]
+        else:
+            met = bool(term.get("deadline_met"))
+        return Outcome(
+            slo=a.slo, terminal=TERMINAL_COMPLETED, e2e_s=e2e,
+            deadline_met=met, model=a.model, session=a.session,
+            worker=term.get("worker"),
+            has_result=term.get("result") is not None,
+        )
+    return Outcome(
+        slo=a.slo,
+        terminal=(TERMINAL_LOST if term.get("terminal") == "lost"
+                  else TERMINAL_REJECTED),
+        reason=term.get("reason"), model=a.model, session=a.session,
+    )
+
+
+async def run_open_loop(
+    submit: Callable[[Arrival], Awaitable[Outcome]],
+    trace: ArrivalTrace,
+    *,
+    now: Callable[[], float] = time.monotonic,
+) -> Tuple[List[Outcome], float]:
+    """Drive the trace open-loop: each arrival fires at its scheduled
+    offset from the run start whether or not earlier requests came
+    back (that is the whole point). ``submit`` handles one request
+    end-to-end and must ALWAYS return a terminal ``Outcome`` — the
+    front door's typed-rejection contract means it never has to guess.
+    Returns (outcomes in arrival order, wall seconds to last terminal).
+    """
+    t0 = now()
+    results: List[Optional[Outcome]] = [None] * len(trace.arrivals)
+
+    async def one(i: int, a: Arrival) -> None:
+        delay = a.t - (now() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        results[i] = await submit(a)
+
+    await asyncio.gather(
+        *(one(i, a) for i, a in enumerate(trace.arrivals))
+    )
+    wall = now() - t0
+    return [o for o in results if o is not None], wall

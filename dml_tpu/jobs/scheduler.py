@@ -413,6 +413,24 @@ class Batch:
     # times a live worker reported failure for this batch (deterministic
     # failures must eventually fail the JOB, not requeue forever)
     failures: int = 0
+    # session-affinity target (request front door, dml_tpu/ingress/):
+    # the worker that holds this batch's sessions' KV state from their
+    # previous turns. BEST-EFFORT — the single-model assignment pass
+    # gives the batch to this worker when it is free, and any free
+    # worker otherwise; a dead or busy target never strands the batch.
+    affinity: Optional[str] = None
+    # token-streaming routing for ingress LM batches: input file ->
+    # LIST of [client unique_name, request id] targets (several
+    # requests may share one input). The executing worker exposes one
+    # stream PER REQUEST on its data plane and notifies each client
+    # (REQUEST_STREAM_READY) before decode begins.
+    streams: Dict[str, List[Any]] = field(default_factory=dict)
+    # ingress batches carry results INLINE in the batch ACK (when they
+    # fit a datagram) instead of a replicated-store PUT + GET round
+    # trip per batch: per-request serving cannot afford 3x-replicated
+    # store objects per formed batch, and nothing ever get-output's an
+    # ingress job. Oversized results fall back to the store path.
+    inline_results: bool = False
 
     @property
     def key(self) -> Tuple[int, int]:
@@ -434,6 +452,10 @@ class JobState:
     # batch ids already counted done — guards double-decrement when a
     # falsely-suspected worker's ACK races the reassigned copy's ACK
     completed_batches: set = field(default_factory=set)
+    # ACK-carried results of inline-results (ingress) batches, merged
+    # across the job's batches; transient — NOT snapshotted (a
+    # restored job's batches re-execute and re-deliver)
+    inline_results: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -575,13 +597,17 @@ class Scheduler:
         requester: str,
         replicas: Optional[Dict[str, List[str]]] = None,
         batch_size: Optional[int] = None,
+        affinity: Optional[str] = None,
+        streams: Optional[Dict[str, List[Any]]] = None,
+        inline_results: bool = False,
     ) -> JobState:
         """Wrap-around sample `n_queries` inputs from `files`, slice
         into batches of the model's current batch size, queue them.
 
         `batch_size` pins the slicing explicitly — the standby replays
         the primary's relayed value so shadow batch ids always match
-        even if a C3 fanout datagram was lost."""
+        even if a C3 fanout datagram was lost. `affinity`/`streams`
+        are ingress metadata (see Batch) carried on every batch."""
         if not files:
             raise ValueError("no input files to sample from")
         if n_queries <= 0:
@@ -606,6 +632,12 @@ class Scheduler:
                     replicas={
                         f: (replicas or {}).get(f, []) for f in chunk
                     },
+                    affinity=affinity,
+                    streams={
+                        f: list(v) for f, v in (streams or {}).items()
+                        if f in chunk
+                    },
+                    inline_results=inline_results,
                 )
             )
         q = self._queue(model)
@@ -692,10 +724,32 @@ class Scheduler:
 
     def _assign_free(self, model: str, workers: Sequence[str]) -> List[Assignment]:
         """Single-model case (worker.py:257-300): pour the queue onto
-        every free worker."""
+        every free worker. Batches carrying a session-affinity target
+        (ingress) get a preference pass first: a batch whose affinity
+        worker is FREE this round lands there (the node holding its
+        sessions' KV state); everything else — including affinity
+        batches whose target is busy or gone — pours in reference
+        FIFO order. Affinity is a placement preference, never a
+        gate: no batch waits for its target."""
         q = self._queue(model)
         out: List[Assignment] = []
-        for w in self._free_workers(workers):
+        free = self._free_workers(workers)
+        if any(b.affinity for b in q):
+            free_set = set(free)
+            # membership tested INSIDE the loop: two queued batches
+            # sharing an affinity target must not both land on it —
+            # the second assignment would silently overwrite the
+            # first in in_progress and orphan that batch forever
+            for batch in list(q):
+                if batch.affinity and batch.affinity in free_set:
+                    q.remove(batch)
+                    self.in_progress[batch.affinity] = batch
+                    out.append(
+                        Assignment(worker=batch.affinity, batch=batch)
+                    )
+                    free_set.discard(batch.affinity)
+            free = [w for w in free if w in free_set]
+        for w in free:
             if not q:
                 break
             batch = q.popleft()
@@ -1082,6 +1136,8 @@ class Scheduler:
                 "replicas": {f: list(r) for f, r in b.replicas.items()},
                 "versions": dict(b.versions),
                 "failures": b.failures,
+                "affinity": b.affinity,
+                "streams": {f: list(v) for f, v in b.streams.items()},
             }
 
         queues: Dict[str, List[Dict[str, Any]]] = {

@@ -73,9 +73,99 @@ _M_CACHE_HITS = METRICS.counter(
     "worker_decode_cache_hits_total", "decoded-input cache hits")
 _M_CACHE_MISSES = METRICS.counter(
     "worker_decode_cache_misses_total", "decoded-input cache misses")
+_M_STREAM_TOKENS = METRICS.counter(
+    "request_stream_tokens_total",
+    "LM tokens pushed into per-request ingress token streams")
 
 # (files_dict, exec_time_s, cost_constants_or_None)
 InferBackend = Callable[[str, List[str]], Awaitable[Tuple[Dict[str, Any], float, Optional[Dict[str, float]]]]]
+
+
+def _accepts_on_token(fn) -> bool:
+    """Whether a serving callable declares the ``on_token`` streaming
+    parameter (ingress/streaming.py contract). Checked against the
+    callable that will actually run the batch — group engines and
+    single-chip backends opt in independently. Reflection is paid once
+    per callable: _execute memoizes through _group_token_aware."""
+    try:
+        import inspect
+
+        return "on_token" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+class _StreamFanout:
+    """Per-batch token-stream plumbing for ingress LM requests
+    (dml_tpu/ingress/streaming.py): one data-plane StreamFeed per
+    streaming request file, announced to the owning client
+    (REQUEST_STREAM_READY) BEFORE decode begins, fed from the
+    backend's ``on_token(local_path, text)`` callback — which may fire
+    on the backend's decode thread, so every feed touch hops back to
+    the loop. close() EOFs every feed (success or failure: the stream
+    must always terminate)."""
+
+    #: how long a closed stream's token stays pullable: covers a
+    #: client whose READY push raced the decode but must not let a
+    #: dead client pin the feed (and its buffered chunks) forever
+    STREAM_TTL_S = 60.0
+
+    def __init__(self, service: "JobService", batch, paths: List[str]):
+        self._loop = asyncio.get_running_loop()
+        self._service = service
+        #: file -> [feed, ...]: one feed PER REQUEST, not per input —
+        #: two streaming requests sharing a store input each get their
+        #: own feed and READY push, fed the same tokens
+        self.feeds: Dict[str, List[Any]] = {}
+        self.tokens: List[str] = []
+        self._path_to_file: Dict[str, str] = {}
+        self._closed = False
+        for p, f in zip(paths, batch.files):
+            self._path_to_file.setdefault(p, f)
+            self._path_to_file.setdefault(os.path.basename(p), f)
+        dp = service.store.data_plane
+        for f, targets in batch.streams.items():
+            for target in targets:
+                client, req_id = target[0], target[1]
+                token, feed = dp.expose_stream()
+                self.feeds.setdefault(f, []).append(feed)
+                self.tokens.append(token)
+                service.node.send_unique(
+                    client, MsgType.REQUEST_STREAM_READY,
+                    {"id": req_id, "host": service.node.me.host,
+                     "port": dp.port, "token": token},
+                )
+
+    def on_token(self, path: str, text: str) -> None:
+        feeds = self.feeds.get(self._path_to_file.get(path, path))
+        if feeds:
+            _M_STREAM_TOKENS.inc()
+            data = text.encode("utf-8")
+            for feed in feeds:
+                self._loop.call_soon_threadsafe(feed.push, data)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for feeds in self.feeds.values():
+            for feed in feeds:
+                self._loop.call_soon_threadsafe(feed.close)
+        # retire the tokens after a grace window: a connected puller
+        # already drains to EOF; one whose READY push was lost (single
+        # unacked datagram) or that died after submit would otherwise
+        # leak the feed + buffered chunks in DataPlane._streams forever
+        tokens = list(self.tokens)
+        service = self._service
+
+        async def reap() -> None:
+            await asyncio.sleep(_StreamFanout.STREAM_TTL_S)
+            for t in tokens:
+                service.store.data_plane.unexpose_stream(t)
+
+        self._loop.call_soon_threadsafe(
+            lambda: service._spawn_bg(reap(), "stream-token ttl")
+        )
 
 
 class JobService:
@@ -144,6 +234,13 @@ class JobService:
         self._lm_prefill: Dict[str, Any] = {}
         # models whose backend declares `on_dispatch` (see register_lm)
         self._backend_dispatch_aware: Dict[str, bool] = {}
+        # models whose backend declares `on_token` (per-token streaming
+        # for ingress requests; see register_lm + _execute)
+        self._backend_token_aware: Dict[str, bool] = {}
+        # group-backend callable -> on_token capability: signature
+        # reflection must not run per executed batch on the serving
+        # path (the single-chip case caches at register_lm time)
+        self._gb_token_aware: Dict[Any, bool] = {}
         self.model_patterns: Dict[str, Tuple[str, ...]] = {}
         self._engine = engine  # lazy InferenceEngine (imports jax on first use)
         # Decoded-input cache for the worker prepare stage, keyed by
@@ -198,6 +295,13 @@ class JobService:
         )
         # submit idempotency tokens -> job id
         self._submit_tokens: BoundedDict = BoundedDict(1000)
+        # job-terminal observers (request front door, dml_tpu/ingress/):
+        # called as cb(job_state, last_worker_or_None) on the
+        # coordinator whenever a job reaches a terminal state —
+        # completion (last_worker = the ACKing node, the session-
+        # affinity signal) or failure (None). Callbacks must not raise
+        # (guarded anyway) and must not block (spawn their own tasks).
+        self.on_job_done_cbs: List[Callable[[Any, Optional[str]], None]] = []
         # model -> pinned store version currently served (for recovery
         # after an eviction; "latest" is resolved at load time)
         self._served_weight_version: Dict[str, Optional[int]] = {}
@@ -387,6 +491,20 @@ class JobService:
             return gb
         return self._group_backend
 
+    def _group_token_aware(self, gb) -> bool:
+        """Memoized _accepts_on_token for group backends: _execute
+        asks per batch, signature reflection runs once per callable
+        (an unhashable callable just pays it each time)."""
+        try:
+            return self._gb_token_aware[gb]
+        except KeyError:
+            pass
+        except TypeError:
+            return _accepts_on_token(gb)
+        aware = _accepts_on_token(gb)
+        self._gb_token_aware[gb] = aware
+        return aware
+
     def _group_serves(self, model: str) -> bool:
         """True when a batch of `model` executing NOW would run on
         this node's group engine: a group backend is wired for it, it
@@ -483,11 +601,16 @@ class JobService:
             try:
                 import inspect
 
-                self._backend_dispatch_aware[name] = (
-                    "on_dispatch" in inspect.signature(backend).parameters
-                )
+                params = inspect.signature(backend).parameters
+                self._backend_dispatch_aware[name] = "on_dispatch" in params
+                # `on_token` (ingress/streaming.py contract): the
+                # backend calls on_token(local_path, text) per decoded
+                # token; the worker feeds each streaming request's
+                # data-plane stream from it
+                self._backend_token_aware[name] = "on_token" in params
             except (TypeError, ValueError):
                 self._backend_dispatch_aware[name] = False
+                self._backend_token_aware[name] = False
         self.model_patterns[name] = tuple(patterns)
         if cost is not None:
             self.scheduler.set_cost(name, cost)
@@ -818,6 +941,8 @@ class JobService:
                     "replicas": b.replicas,
                     "versions": versions,
                     "staged": staged,
+                    "streams": b.streams,
+                    "inline": b.inline_results,
                     "seq": next(self._task_seq),
                     "inc": self._incarnation,
                 },
@@ -899,22 +1024,75 @@ class JobService:
             MsgType.SUBMIT_JOB_REQUEST_ACK,
             {"rid": rid, "ok": True, "job_id": job_id},
         )
+        self._relay_submit(
+            job_id,
+            {"job": job_id, "model": model, "n": n, "files": files,
+             "batch_size": bs, "requester": msg.sender,
+             "gen": self._relay_gen},
+        )
+        self._run_schedule()
+
+    def _relay_submit(self, job_id: int, payload: Dict[str, Any]) -> None:
+        """One copy of the standby submit-relay discipline (operator
+        and ingress intake both use it): slim relay — file names + the
+        exact batch_size used for slicing (so shadow batch ids always
+        match); replicas are re-resolved from metadata at promotion
+        time. A relay failure is logged, never raised (the client ACK
+        must already be out)."""
         sb = self.store.standby_node()
         if sb is not None and sb.unique_name != self._me:
             try:
-                # slim relay: file names + the exact batch_size used for
-                # slicing (so shadow batch ids always match); replicas
-                # are re-resolved from metadata at send/promotion time
-                self.node.send(
-                    sb,
-                    MsgType.SUBMIT_JOB_RELAY,
-                    {"job": job_id, "model": model, "n": n, "files": files,
-                     "batch_size": bs, "requester": msg.sender,
-                     "gen": self._relay_gen},
-                )
+                self.node.send(sb, MsgType.SUBMIT_JOB_RELAY, payload)
             except Exception:
-                log.exception("%s: standby relay of job %d failed", self._me, job_id)
+                log.exception(
+                    "%s: standby relay of job %d failed", self._me, job_id
+                )
+
+    def ingress_submit(
+        self,
+        job_id: int,
+        model: str,
+        files: List[str],
+        requester: str,
+        affinity: Optional[str] = None,
+        streams: Optional[Dict[str, List[Any]]] = None,
+    ) -> Any:
+        """Leader-side direct intake for the request front door
+        (dml_tpu/ingress/router.py): a batch the router FORMED from
+        individual requests becomes one single-batch job — explicit
+        file list, n = len(files), batch_size pinned to the formed
+        size — and inherits the whole job pipeline: fair-share
+        scheduling against operator jobs, standby relays, exactly-once
+        completion dedup, requeue on worker death, failover.
+
+        `affinity` is the batch's session-affinity target (the worker
+        holding its sessions' KV state); `streams` maps input files of
+        streaming requests to a LIST of [client, request id] targets
+        (several requests may share one input) so the executing
+        worker can expose per-request token streams. Both relay to
+        the standby so a promoted coordinator re-sends identically."""
+        if not self.node.is_leader:
+            raise RuntimeError("ingress_submit runs on the coordinator")
+        if not files:
+            raise ValueError("empty ingress batch")
+        replicas = {
+            f: self.store.metadata.replicas_of(f) for f in set(files)
+        }
+        st = self.scheduler.submit_job(
+            job_id, model, list(files), len(files), requester, replicas,
+            batch_size=len(files), affinity=affinity, streams=streams,
+            inline_results=True,
+        )
+        self._relay_submit(
+            job_id,
+            {"job": job_id, "model": model, "n": len(files),
+             "files": list(files), "batch_size": len(files),
+             "requester": requester, "gen": self._relay_gen,
+             "affinity": affinity, "streams": streams or {},
+             "inline": True},
+        )
         self._run_schedule()
+        return st
 
     async def _h_task_ack(self, msg: Message, addr) -> None:
         """A worker finished a batch (reference WORKER_TASK_REQUEST_ACK
@@ -943,6 +1121,13 @@ class JobService:
             st_pre is not None
             and batch_id not in st_pre.completed_batches
         )
+        if fresh_ack and isinstance(d.get("results"), dict):
+            # inline-results (ingress) batch: the results rode the ACK
+            # instead of the store; merge across the job's batches so
+            # the completion observers can fan them out per request
+            st_pre.inline_results = {
+                **(st_pre.inline_results or {}), **d["results"],
+            }
         if fresh_ack:
             # group-served ACKs advertise membership + capacity: this
             # is how any coordinator — including one promoted mid-job
@@ -1000,6 +1185,7 @@ class JobService:
                 {"job_id": job_id, "model": done.model,
                  "total_queries": done.total_queries},
             )
+            self._fire_job_done(done, msg.sender)
         self._run_schedule()
 
     def _fold_cost(self, model: str, cost: Dict[str, Any]) -> None:
@@ -1212,7 +1398,17 @@ class JobService:
                     {"job": st.job_id, "error": st.error,
                      "gen": self._relay_gen},
                 )
+            self._fire_job_done(st, None)
         self._run_schedule()
+
+    def _fire_job_done(self, st, worker: Optional[str]) -> None:
+        """Notify job-terminal observers (ingress completion fan-out);
+        a broken observer must never break the ACK path."""
+        for cb in self.on_job_done_cbs:
+            try:
+                cb(st, worker)
+            except Exception:
+                log.exception("%s: on_job_done callback failed", self._me)
 
     def _on_node_failed(self, uname: str) -> None:
         """Requeue the dead worker's batch and reschedule (reference
@@ -1320,6 +1516,9 @@ class JobService:
         self.scheduler.submit_job(
             job_id, d["model"], d["files"], int(d["n"]), d["requester"],
             batch_size=int(d["batch_size"]) if d.get("batch_size") else None,
+            affinity=d.get("affinity"),
+            streams=d.get("streams") or None,
+            inline_results=bool(d.get("inline")),
         )
 
     async def _h_ack_relay(self, msg: Message, addr) -> None:
@@ -1501,6 +1700,10 @@ class JobService:
             files=list(d["files"]),
             replicas={f: list(r) for f, r in d.get("replicas", {}).items()},
             versions={f: int(v) for f, v in d.get("versions", {}).items()},
+            streams={
+                f: list(v) for f, v in (d.get("streams") or {}).items()
+            },
+            inline_results=bool(d.get("inline")),
         )
         if key in self._running:
             return  # duplicate/re-sent delivery of a running batch
@@ -1734,6 +1937,7 @@ class JobService:
     ) -> None:
         from ..observability import span
 
+        fanout: Optional[_StreamFanout] = None
         try:
             with span("worker.fetch_inputs"):
                 if prep is None:
@@ -1748,17 +1952,33 @@ class JobService:
             # a real, named stage of exec, not "other"
             stage_wait = max(0.0, t1 - t_prep_end)
             group_fields: Dict[str, Any] = {}
+            be = self._extra_backends.get(batch.model, self._backend)
+            gb = self._group_backend_for(batch.model)
+            # _group_serves: a sharded group engine serves exactly
+            # ONE model (gb.model; None = any, the lazy/stub
+            # forms); any other model's batch falls through to the
+            # single-chip backend — running the wrong forward
+            # would ack wrong predictions silently. LM models
+            # route to their own per-model sharded group backend
+            # (weight-resident or disaggregated decode).
+            group_serving = gb is not None and self._group_serves(batch.model)
+            # ingress token streaming: a batch carrying stream targets
+            # for a token-aware backend exposes per-request streams on
+            # the data plane and tells each client where to pull
+            # BEFORE decode starts (tokens flow while the batch runs).
+            # Gated on the callable that will ACTUALLY serve the batch:
+            # announcing streams a group engine never feeds would hand
+            # clients an empty stream + EOF instead of the documented
+            # degraded mode (tokens arrive with the final result).
+            token_aware = (
+                self._group_token_aware(gb) if group_serving
+                else self._backend_token_aware.get(batch.model)
+            )
+            if batch.streams and token_aware:
+                fanout = _StreamFanout(self, batch, paths)
+            stream_kw = {"on_token": fanout.on_token} if fanout else {}
             with span("worker.inference"):
-                be = self._extra_backends.get(batch.model, self._backend)
-                gb = self._group_backend_for(batch.model)
-                # _group_serves: a sharded group engine serves exactly
-                # ONE model (gb.model; None = any, the lazy/stub
-                # forms); any other model's batch falls through to the
-                # single-chip backend — running the wrong forward
-                # would ack wrong predictions silently. LM models
-                # route to their own per-model sharded group backend
-                # (weight-resident or disaggregated decode).
-                if gb is not None and self._group_serves(batch.model):
+                if group_serving:
                     # formed-group PRIMARY: serve on the group's
                     # sharded engine (jobs/groups.py). The ACK
                     # advertises membership + capacity so the
@@ -1766,7 +1986,9 @@ class JobService:
                     # group actually is. A member dying mid-batch
                     # raises GroupDegraded out of the backend, riding
                     # the ordinary TASK_FAIL -> requeue path below.
-                    results, infer_time, cost = await gb(batch.model, paths)
+                    results, infer_time, cost = await gb(
+                        batch.model, paths, **stream_kw
+                    )
                     g = self.groups.group_of(self._me)
                     members = self.groups.members(g.name) if g else ()
                     group_fields = {
@@ -1794,16 +2016,21 @@ class JobService:
                         on_dispatch=lambda: loop.call_soon_threadsafe(
                             self._promote_staged
                         ),
+                        **stream_kw,
                     )
                     # also promote now: covers backends whose serial
                     # mode never fires the callback, and a NEW stage
                     # that landed mid-drain (engine path does the same)
                     self._promote_staged()
                 else:
-                    results, infer_time, cost = await be(batch.model, paths)
+                    results, infer_time, cost = await be(
+                        batch.model, paths, **stream_kw
+                    )
                     # generic path: promote once inference finished
                     # (the engine path promoted at dispatch)
                     self._promote_staged()
+            if fanout is not None:
+                fanout.close()
             t_backend = (time.monotonic() - t1) + t_decode
             _M_INFER.observe(infer_time)
             # backends key results by the LOCAL path (the engine uses
@@ -1817,30 +2044,44 @@ class JobService:
                 to_sdfs[p] = f
                 to_sdfs[os.path.basename(p)] = f
             results = {to_sdfs.get(k, k): v for k, v in results.items()}
-            out_name = f"output_{batch.job_id}_{batch.batch_id}_{self.node.me.port}.json"
-            tmp = os.path.join(self.store.cfg.download_path(), out_name)
-            os.makedirs(os.path.dirname(tmp), exist_ok=True)
+            # inline-results (ingress) batches ride the ACK when they
+            # fit a datagram, skipping the 3x-replicated store PUT per
+            # batch — the per-request serving path cannot afford one
+            # replicated object per formed batch, and nothing ever
+            # get-output's an ingress job. Oversized results (or
+            # ordinary jobs) take the store path unchanged.
+            inline_payload: Optional[Dict[str, Any]] = None
+            if batch.inline_results:
+                blob = json.dumps(results)
+                if len(blob) <= 40_000:
+                    inline_payload = results
             t_put0 = time.monotonic()
-            with open(tmp, "w") as f:
-                json.dump(results, f)
-            try:
-                # timeout scales with the cluster's RPC envelope
-                # (capped at the old fixed 60 s): a worker wedged
-                # publishing output under churn holds its batch
-                # un-ACKed (and the job un-finishable) far past an
-                # aggressive-timing cluster's whole recovery window
-                await self.store.put(
-                    tmp, out_name,
-                    timeout=min(
-                        60.0,
-                        4 * self.node.spec.timing.leader_rpc_timeout,
-                    ),
-                )
-            except Exception as e:
-                # store unavailable (e.g. mid-failover): the ACK still
-                # carries the result timing; get-output will miss this
-                # shard, which the reference tolerates identically
-                log.warning("%s: PUT of %s failed: %s", self._me, out_name, e)
+            if inline_payload is None:
+                out_name = f"output_{batch.job_id}_{batch.batch_id}_{self.node.me.port}.json"
+                tmp = os.path.join(self.store.cfg.download_path(), out_name)
+                os.makedirs(os.path.dirname(tmp), exist_ok=True)
+                with open(tmp, "w") as f:
+                    json.dump(results, f)
+                try:
+                    # timeout scales with the cluster's RPC envelope
+                    # (capped at the old fixed 60 s): a worker wedged
+                    # publishing output under churn holds its batch
+                    # un-ACKed (and the job un-finishable) far past an
+                    # aggressive-timing cluster's whole recovery window
+                    await self.store.put(
+                        tmp, out_name,
+                        timeout=min(
+                            60.0,
+                            4 * self.node.spec.timing.leader_rpc_timeout,
+                        ),
+                    )
+                except Exception as e:
+                    # store unavailable (e.g. mid-failover): the ACK
+                    # still carries the result timing; get-output will
+                    # miss this shard, which the reference tolerates
+                    # identically
+                    log.warning("%s: PUT of %s failed: %s",
+                                self._me, out_name, e)
             t_put = time.monotonic() - t_put0
             _M_PUT.observe(t_put)
             _M_BATCHES.inc(model=batch.model)
@@ -1864,6 +2105,8 @@ class JobService:
                     "stage_wait_time": stage_wait,
                     "put_time": t_put,
                     "cost": cost,
+                    **({"results": inline_payload}
+                       if inline_payload is not None else {}),
                     **group_fields,
                 },
             )
@@ -1888,6 +2131,10 @@ class JobService:
             # coordinator's on_batch_failed does the same promotion)
             self._promote_staged()
         finally:
+            if fanout is not None:
+                # idempotent: normal completion already closed; this
+                # covers failure/preemption — a stream always EOFs
+                fanout.close()
             t = self._running.get(batch.key)
             if t is not None and t is asyncio.current_task():
                 del self._running[batch.key]

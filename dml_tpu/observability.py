@@ -73,6 +73,20 @@ handoff, a throughput event never a correctness one —
 ``jobs_kv_handoff_bytes_total`` serialized slab bytes pulled over
 the data plane, ``jobs_kv_handoff_seconds`` per-batch prefill RPC +
 slab pull wall),
+``request_*`` (the SLO-aware request front door, dml_tpu/ingress/:
+``request_admitted_total`` / ``request_completed_total`` per SLO
+class, ``request_shed_total`` admission sheds labeled
+``slo=``/``reason=`` (queue_full | deadline_unmeetable),
+``request_rejected_total`` post-admission typed rejections,
+``request_deadline_miss_total`` completions past their deadline,
+``request_queue_wait_seconds`` admission->dispatch wait and
+``request_e2e_latency_seconds`` admission->completion latency
+histograms per class — the p50/p95/p99 source of the
+``request_serving`` bench section — ``request_in_flight`` gauge,
+``request_batch_fill_fraction`` / ``request_batch_formation_seconds``
+continuous-batch-formation quality, and
+``request_stream_tokens_total`` LM tokens pushed into per-request
+data-plane token streams on workers),
 ``cluster_*`` (SWIM suspicion/failure/false-positive events,
 alive-node gauge), ``transport_*`` (datagram + byte counters by
 message type), and ``store_*`` (put/get/replication timing and

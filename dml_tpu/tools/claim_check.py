@@ -775,6 +775,140 @@ def run_lm_sharded_check(artifact_path: Optional[str] = None) -> List[str]:
     )
 
 
+#: first round whose bench carries the request front door section
+#: (per-request SLO serving under open-loop load, dml_tpu/ingress/)
+REQUEST_REQUIRED_FROM_ROUND = 9
+
+
+def check_request_block(path: str) -> List[str]:
+    """Validate the ``request_serving`` section WHEN IT RAN:
+
+    - the sustained-load percentiles (``p50_ms``/``p95_ms``/``p99_ms``)
+      are finite, positive, and ordered — the tail was actually
+      measured, not defaulted;
+    - ``goodput_qps`` is finite and positive, ``shed_ratio`` in
+      [0, 1) — a shed ratio of 1.0 means the door rejected everything
+      and the 'serving' numbers scored nothing;
+    - continuous batch formation beat the naive fixed-size-batch
+      baseline on light-load p99 (``continuous_vs_fixed_p99`` > 1)
+      while matching its throughput at saturation
+      (``saturation_goodput_ratio`` >= 0.8) — the tentpole claim;
+    - the leader-failover-mid-traffic case is green:
+      ``all_terminal_exactly_once`` True with completions after the
+      failover — in-flight requests either complete or are explicitly
+      rejected, never silently lost. The verdict is observational
+      (zero conflicting late terminals across routers, zero
+      completions missing their result payload, completions > 0),
+      not an accounting identity.
+
+    Artifacts before round 9 are exempt; summary-only driver captures
+    gate on the compact line's ``req_*`` keys."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < REQUEST_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        problems = []
+        if s.get("req_p99_ms") is not None:
+            v = s["req_p99_ms"]
+            if not isinstance(v, (int, float)) or not math.isfinite(v) \
+                    or v <= 0:
+                problems.append(
+                    f"{name}: summary req_p99_ms = {v!r} (nonfinite or "
+                    "nonpositive)"
+                )
+            sr = s.get("req_shed_ratio")
+            if sr is not None and (
+                not isinstance(sr, (int, float)) or not 0 <= sr < 1
+            ):
+                problems.append(
+                    f"{name}: summary req_shed_ratio = {sr!r} not in "
+                    "[0, 1)"
+                )
+            if s.get("req_failover_ok") is False:
+                problems.append(
+                    f"{name}: summary req_failover_ok is false — a "
+                    "request was lost or double-terminated across the "
+                    "failover"
+                )
+        return problems
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "request_serving" in not_run:
+        return []
+    block = matrix.get("request_serving")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `request_serving` section and not recorded "
+                "as skipped (bench lost the front-door serve?)"]
+    if block.get("skipped"):
+        return []
+    problems: List[str] = []
+    pcts = []
+    for key in ("p50_ms", "p95_ms", "p99_ms"):
+        v = block.get(key)
+        if not isinstance(v, (int, float)) or not math.isfinite(v) or v <= 0:
+            problems.append(
+                f"{name}: request_serving.{key} = {v!r} (missing, "
+                "nonfinite, or zero — the sustained load never served?)"
+            )
+        else:
+            pcts.append(v)
+    if len(pcts) == 3 and not (pcts[0] <= pcts[1] <= pcts[2]):
+        problems.append(
+            f"{name}: request_serving percentiles not ordered "
+            f"(p50={pcts[0]}, p95={pcts[1]}, p99={pcts[2]})"
+        )
+    gp = block.get("goodput_qps")
+    if not isinstance(gp, (int, float)) or not math.isfinite(gp) or gp <= 0:
+        problems.append(
+            f"{name}: request_serving.goodput_qps = {gp!r} (missing, "
+            "nonfinite, or zero)"
+        )
+    sr = block.get("shed_ratio")
+    if not isinstance(sr, (int, float)) or not 0 <= sr < 1:
+        problems.append(
+            f"{name}: request_serving.shed_ratio = {sr!r} not in [0, 1)"
+        )
+    ratio = block.get("continuous_vs_fixed_p99")
+    if not isinstance(ratio, (int, float)) or ratio <= 1.0:
+        problems.append(
+            f"{name}: request_serving.continuous_vs_fixed_p99 = {ratio!r}"
+            " — continuous formation must beat the fixed-batch baseline "
+            "on light-load p99"
+        )
+    sat = block.get("saturation_goodput_ratio")
+    if not isinstance(sat, (int, float)) or sat < 0.8:
+        problems.append(
+            f"{name}: request_serving.saturation_goodput_ratio = {sat!r}"
+            " — continuous formation must MATCH fixed-batch throughput "
+            "at saturation (>= 0.8)"
+        )
+    fo = block.get("failover") or {}
+    if fo.get("all_terminal_exactly_once") is not True:
+        problems.append(
+            f"{name}: request_serving.failover.all_terminal_exactly_once"
+            f" = {fo.get('all_terminal_exactly_once')!r} — every request "
+            "in the failover-mid-traffic run must reach exactly one "
+            "terminal"
+        )
+    if not fo.get("completed", 0):
+        problems.append(
+            f"{name}: request_serving.failover completed 0 requests — "
+            "the cluster never resumed serving after the leader kill"
+        )
+    return problems
+
+
+def run_request_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_request_block(artifact_path or canonical_artifact_path())
+
+
 # ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
@@ -841,6 +975,9 @@ def main() -> None:
     for problem in run_lm_sharded_check(art_path):
         total += 1
         print(f"lm-sharded block: {problem}")
+    for problem in run_request_check(art_path):
+        total += 1
+        print(f"request block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")

@@ -68,4 +68,5 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "adaptive: probe-adaptive depth-controller coverage (the tier-1 smoke keeps the controller path from silently rotting)")
     config.addinivalue_line("markers", "sharded: tensor-parallel worker-group serving coverage (group topology, sharded-vs-single-chip output equality)")
     config.addinivalue_line("markers", "disagg: prefill/decode-disaggregated LM serving coverage (KV-slab handoff over the data plane, role-split groups)")
+    config.addinivalue_line("markers", "ingress: request front-door coverage (SLO admission/shedding, continuous batch formation, open-loop load, token streaming)")
 
