@@ -66,17 +66,19 @@ SECTION_EST_S = {
     # CPU-subprocess: 5-node cluster, 2 ShardedInference compiles,
     # group + single-chip serves (measured ~150 s warm on 1 core)
     "cluster_sharded_serving": 300.0,
-    # CPU-subprocess: 5-node cluster, 3 sharded-LM serving forms
-    # (param_gather / weight-resident / disaggregated) + the
-    # member-kill-mid-decode chaos case
-    "cluster_lm_sharded": 360.0,
+    # CPU-subprocess: 5-node cluster, 4 sharded-LM serving forms
+    # (param_gather / weight-resident / pipeline-parallel /
+    # disaggregated) + the whole-slab-vs-streamed handoff ladder with
+    # 1- and 2-peer fan-out + the member-kill-mid-stream chaos case
+    "cluster_lm_sharded": 560.0,
     "lm": 450.0,
     "cluster_lm_serving": 210.0,  # + >=15 s steady-state refill phase
     "chaos": 180.0,  # 2 soak seeds + 5 adversarial scenario families
     # per-request front door under open-loop load: light (continuous
-    # vs fixed formation), saturation, sustained mixed-class, and the
-    # leader-failover-mid-traffic case, all on one CPU stub cluster
-    "request_serving": 150.0,
+    # vs fixed formation), saturation, sustained mixed-class (+ the
+    # weighted-class-vs-FIFO rerun), and the leader-failover-mid-
+    # traffic case, all on one CPU stub cluster
+    "request_serving": 170.0,
     "train": 750.0,  # + b64/b128/grad-accum sweep points
     # isolated concat slope-timings at InceptionV3's 11 block shapes
     # + the CPU-safe jaxpr byte count (VERDICT r5 weak #5)
@@ -719,6 +721,36 @@ def _bench_request_serving(out, *, base_port=28741, n_nodes=4):
             block["p99_ms"] = sustained["latency_ms"]["p99"]
             block["goodput_qps"] = sustained["goodput_qps"]
             block["shed_ratio"] = sustained["shed_ratio"]
+            # ---- phase 3b: per-class weighted fair share vs FIFO ----
+            # same mixed-class trace with the scheduler's class
+            # weights DISABLED (one FIFO per model queue — the pre-PR
+            # behavior): interactive p99 must be better under the
+            # weighted split, which is the whole point of giving
+            # classes weighted shares of the queue
+            for sn in cluster.nodes.values():
+                sn.jobs.scheduler.class_weights = {}
+            fifo = await run_trace(main, "continuous")
+            for sn in cluster.nodes.values():
+                sn.jobs.scheduler.class_weights = {
+                    "interactive": 3.0, "batch": 1.0,
+                }
+
+            def _class_p99(summary, cls):
+                c = (summary.get("by_class") or {}).get(cls) or {}
+                return (c.get("latency_ms") or {}).get("p99")
+
+            p99_w = _class_p99(sustained, "interactive")
+            p99_f = _class_p99(fifo, "interactive")
+            block["class_fair"] = {
+                "weights": {"interactive": 3.0, "batch": 1.0},
+                "p99_ms_interactive_weighted": p99_w,
+                "p99_ms_interactive_fifo": p99_f,
+                "goodput_qps_fifo": fifo["goodput_qps"],
+                "interactive_p99_improved": (
+                    p99_w is not None and p99_f is not None
+                    and p99_w < p99_f
+                ),
+            }
             # ---- phase 4: leader failover mid-traffic ----------------
             set_formation("continuous")
             fail_trace = loadgen.open_loop_trace(
@@ -2235,25 +2267,29 @@ def _bench_b4_s2d(engine, out, batch=128):
 
 
 def _bench_cluster_lm_sharded(out):
-    """Weight-resident sharded LM decode + prefill/decode
-    disaggregation through the full cluster pipeline (ISSUE 6
-    tentpole; inference/lm_sharded.py): a 4-node cluster whose
-    eligible pool IS one dp=1×tp=2 group (H3 decode primary, H4
-    prefill role) serving an LM job three ways on the SAME topology —
-    per-forward param_gather (the PR-5-analog pessimization, full
-    weight all-gather per dispatch), weight-resident tp-sharded (no
-    gather), and disaggregated (prefill-role KV-slab handoff over the
-    TCP data plane) — plus a member-kill-mid-decode chaos case.
+    """Sharded LM serving forms through the full cluster pipeline
+    (inference/lm_sharded.py): a 5-node cluster whose eligible pool
+    IS one three-member group (H3 decode primary, H4+H5 prefill
+    roles) serving an LM job four ways on the SAME topology —
+    per-forward param_gather (the PR-5-analog pessimization),
+    weight-resident tp=2, PIPELINE-parallel pp=2 (the layer stack
+    split across members: models deeper than one member's HBM, with
+    the per-member byte budget recorded), and disaggregated
+    prefill/decode — plus the handoff ladder (whole-slab pull vs
+    chunk-STREAMED handoff TTFT, 1- vs 2-prefill-peer fan-out on a
+    prefill-heavy workload) and a member-kill-MID-STREAM chaos case
+    (typed per-request fallback, exactly-once tokens).
     Runs on a virtual 8-device CPU mesh in a subprocess. What
     transfers to a pod: the token-equality contract (every mode's
     merged outputs == isolated generate(); claim_check-enforced from
-    round 8), handoff bytes actually moving, and exactly-once token
-    delivery under degradation. The tok/s ratios on shared-core CPU
-    devices are an honest lower bound on what removing a
-    per-dispatch weight all-gather buys over ICI."""
+    round 8, the pp/streamed keys from round 10), handoff bytes
+    actually moving, and exactly-once token delivery under
+    degradation. The tok/s and overlap ratios on shared-core CPU
+    devices are an honest lower bound on the ICI story."""
     try:
         out["cluster_lm_sharded"] = _run_cpu_subprocess(
-            "dml_tpu.inference.lm_sharded", timeout=900, last_line=True
+            "dml_tpu.inference.lm_sharded", timeout=1100,
+            last_line=True,
         )
     except Exception as e:  # pragma: no cover
         out["cluster_lm_sharded"] = {"skipped": True, "reason": repr(e)}
@@ -2549,6 +2585,16 @@ def main() -> None:
         "lm_sharded_equal": g(
             "cluster_lm_sharded", "tokens_equal_single_chip"),
         "lm_kv_handoff_bytes": g("cluster_lm_sharded", "kv_handoff_bytes"),
+        # pipeline-parallel + chunk-streamed handoff (round-10 gate):
+        # pp-mode steady tok/s, streamed-handoff time-to-first-token,
+        # the stream-vs-whole-slab TTFT ratio, and the 2-vs-1 prefill
+        # peer context-phase speedup
+        "lm_pp_toks": g("cluster_lm_sharded", "tok_s_pp"),
+        "lm_stream_ttft_ms": g("cluster_lm_sharded", "ttft_stream_ms"),
+        "lm_stream_vs_slab": g(
+            "cluster_lm_sharded", "stream_vs_slab_ttft"),
+        "lm_fanout_speedup": g(
+            "cluster_lm_sharded", "fanout_ctx_speedup"),
         "parity_weights_found": g(
             "parity_store_probe", "any_weights_found"),
         "inception_concat_bound": g(
@@ -2658,7 +2704,7 @@ _COMPACT_DROP_ORDER = (
     "chaos_malformed_dropped", "train_mfu_b128_ga4", "opt_batch",
     "inception_concat_bound", "sharded_vs_single",
     "parity_weights_found", "lm_kv_handoff_bytes",
-    "lm_sharded_vs_gather", "b4_s2d_vs_stock",
+    "lm_sharded_vs_gather", "lm_fanout_speedup", "b4_s2d_vs_stock",
     "req_p50_ms", "req_cont_vs_fixed_p99",
     "inception_mfu_b128", "b4_mfu_b128", "headline_qps_range",
 )
@@ -2694,7 +2740,9 @@ def compact_summary_line(hl, device_str, baseline_qps, summary) -> str:
         # sharded_qps + sharded_equal survive for the same reason
         # (the round-7 worker-group gate), lm_sharded_toks /
         # lm_disagg_toks / lm_sharded_equal for the round-8
-        # sharded-LM gate, and req_p99_ms / req_goodput_qps /
+        # sharded-LM gate, lm_pp_toks / lm_stream_ttft_ms /
+        # lm_stream_vs_slab for the round-10 pipeline+streamed-
+        # handoff gate, and req_p99_ms / req_goodput_qps /
         # req_shed_ratio (+ req_failover_ok) for the round-9
         # request-serving gate.
         doc["summary"] = {
@@ -2704,6 +2752,8 @@ def compact_summary_line(hl, device_str, baseline_qps, summary) -> str:
                       "cluster_lm_steady_s", "sharded_qps",
                       "sharded_equal", "lm_sharded_toks",
                       "lm_disagg_toks", "lm_sharded_equal",
+                      "lm_pp_toks", "lm_stream_ttft_ms",
+                      "lm_stream_vs_slab",
                       "req_p99_ms", "req_goodput_qps",
                       "req_shed_ratio", "req_failover_ok",
                       "section_errors", "sections_skipped")

@@ -452,10 +452,57 @@ class NodeApp:
                 break
 
 
-def _setup_logging(verbose: bool, logfile: str = "debug.log") -> None:
-    """File + stdout logging (reference main.py:66-73)."""
-    handlers: List[logging.Handler] = [logging.FileHandler(logfile)]
-    if verbose:
+def default_log_path() -> str:
+    """Where CLI file logging lands: ``DML_TPU_LOG_FILE`` when set,
+    else a per-process file inside a PRIVATE (0700, owner-verified)
+    per-user directory under the system tempdir. NEVER the working
+    directory — `main()` runs from tests/benches/operator shells, and
+    a ``debug.log`` materializing in whatever directory the process
+    happened to start from (the repo root, PR 7's stray artifact) is
+    a litter bug, not a logging feature. The private dir (rather
+    than a bare predictable ``/tmp/dml_tpu_user.log``) means another
+    user on a shared host cannot pre-create the path or plant a
+    symlink under it (CWE-377); the pid suffix keeps two concurrent
+    nodes run by the same operator from interleaving one file."""
+    env = os.environ.get("DML_TPU_LOG_FILE")
+    if env:
+        return os.path.expanduser(env)
+    import getpass
+    import stat as _stat
+    import tempfile
+
+    try:
+        user = getpass.getuser()
+    except Exception:  # pragma: no cover - no passwd entry
+        user = "user"
+    d = os.path.join(tempfile.gettempdir(), f"dml_tpu_{user}")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        st = os.lstat(d)
+        if not _stat.S_ISDIR(st.st_mode) or (
+            hasattr(os, "geteuid") and st.st_uid != os.geteuid()
+        ):
+            raise OSError(f"unsafe log dir {d}")
+        if _stat.S_IMODE(st.st_mode) != 0o700:
+            os.chmod(d, 0o700)  # re-tighten a pre-existing dir
+    except OSError:
+        # pre-planted file/symlink or foreign-owned dir: a fresh
+        # private dir instead of logging through someone else's path
+        d = tempfile.mkdtemp(prefix=f"dml_tpu_{user}_")
+    return os.path.join(d, f"node_{os.getpid()}.log")
+
+
+def _setup_logging(verbose: bool, logfile: Optional[str] = None) -> None:
+    """File + stdout logging (reference main.py:66-73). The file
+    handler is best-effort: an unwritable log path must not kill the
+    node."""
+    logfile = logfile or default_log_path()
+    handlers: List[logging.Handler] = []
+    try:
+        handlers.append(logging.FileHandler(logfile))
+    except OSError:
+        pass
+    if verbose or not handlers:
         handlers.append(logging.StreamHandler())
     logging.basicConfig(
         level=logging.INFO,
@@ -535,6 +582,12 @@ async def _run_introducer(args) -> None:
 
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="dml_tpu", description=__doc__)
+    p.add_argument(
+        "--log-file", default=None, metavar="PATH",
+        help="log file path (default: $DML_TPU_LOG_FILE, else a "
+             "per-process file in a private per-user tempdir — never the "
+             "working directory)",
+    )
     sub = p.add_subparsers(dest="command", required=True)
 
     pn = sub.add_parser("node", help="run a cluster node")
@@ -603,7 +656,10 @@ def main(argv: Optional[List[str]] = None) -> None:
             with open(args.out, "w") as f:
                 f.write(text)
         return
-    _setup_logging(getattr(args, "verbose", False))
+    _setup_logging(
+        getattr(args, "verbose", False),
+        logfile=getattr(args, "log_file", None),
+    )
     if args.command == "node":
         asyncio.run(_run_node(args))
     elif args.command == "introducer":

@@ -30,6 +30,7 @@ import json
 import os
 import random
 import secrets
+import time
 from typing import Dict, List, Optional, Tuple
 
 from .local_store import CorruptionError, LocalStore
@@ -103,10 +104,39 @@ class StreamFeed:
                 break
         self._q.put_nowait(data)
 
+    async def put(self, data: bytes, timeout: float = 120.0) -> None:
+        """Backpressured push: awaits for queue room instead of
+        dropping. Bulk payloads (the KV-slab handoff) use this —
+        push()'s drop-oldest is a token-streaming latency trade that
+        would garble a framed byte stream, and an unbounded buffer
+        would hold a whole share's slabs in memory when the puller is
+        slower than prefill compute. ``timeout`` bounds the wait: a
+        puller that NEVER connects leaves the feed open (the serve
+        handler's close only fires once a puller came and went), and
+        a producer must fail loudly then, not wedge its task forever.
+        Raises asyncio.TimeoutError on expiry."""
+        if self.closed or not data:
+            return
+        deadline = time.monotonic() + timeout
+        while self._q.qsize() >= self._maxsize and not self.closed:
+            if time.monotonic() >= deadline:
+                raise asyncio.TimeoutError(
+                    f"stream put(): no queue room after {timeout:g}s "
+                    "(puller never drained)"
+                )
+            await asyncio.sleep(0.01)
+        if not self.closed:
+            self._q.put_nowait(data)
+
     def close(self) -> None:
         if not self.closed:
             self.closed = True
             self._q.put_nowait(None)
+
+    def drained(self) -> bool:
+        """True once the consumer has taken everything, EOF included
+        — the producer's cue that unexposing the stream costs nothing."""
+        return self.closed and self._q.qsize() == 0
 
     async def get(self) -> Optional[bytes]:
         return await self._q.get()
@@ -149,13 +179,15 @@ class DataPlane:
     def unexpose(self, token: str) -> None:
         self._exposed.pop(token, None)
 
-    def expose_stream(self) -> Tuple[str, StreamFeed]:
+    def expose_stream(self, maxsize: int = 4096) -> Tuple[str, StreamFeed]:
         """Register a live outbound stream; returns (token, feed). The
         serving side pushes chunks into the feed and close()s at EOF;
         the token travels to the consumer over the control plane
-        (REQUEST_STREAM_READY)."""
+        (REQUEST_STREAM_READY). ``maxsize`` bounds the buffered
+        chunks — producers of bulk framed payloads should pass a
+        small bound and feed via the backpressured ``put``."""
         token = secrets.token_hex(16)
-        feed = StreamFeed()
+        feed = StreamFeed(maxsize)
         self._streams[token] = feed
         return token, feed
 
@@ -278,6 +310,12 @@ class DataPlane:
             await writer.drain()
         finally:
             self._streams.pop(token, None)
+            # one puller per token: once it is done (EOF, idle
+            # timeout, or a dead connection unwinding through
+            # _handle), nothing will ever drain this feed again —
+            # close it so a producer awaiting put() backpressure
+            # unblocks instead of waiting on a consumer that left
+            feed.close()
 
     # ---- client ----
 

@@ -153,13 +153,24 @@ class WorkerGroupSpec:
     disaggregation (every chip does both). Role assignment living
     HERE (not in a runtime protocol) means degradation/reform and
     failover derive the same view from spec + liveness, exactly like
-    membership itself."""
+    membership itself.
+
+    A `mesh` with ``pp > 1`` serves the group's `lm_models` PIPELINE-
+    parallel (inference/lm_sharded.py PipelinedLMBackend): each
+    member holds only ``n_layers/pp`` of the layer stack, opening
+    models DEEPER than one member's HBM. `hbm_bytes` (optional, 0 =
+    unchecked) declares a member's HBM budget in bytes; the LM group
+    wiring refuses to start a layout whose per-member weight bytes
+    (`pp_hbm_report`) exceed it — a model bigger than the budget must
+    be served through a pp axis, never silently OOM-ed at first
+    batch."""
 
     name: str
     members: Tuple[str, ...] = ()
     mesh: MeshSpec = field(default_factory=lambda: MeshSpec(dp=-1, tp=1))
     lm_models: Tuple[str, ...] = ()
     roles: Dict[str, str] = field(default_factory=dict)
+    hbm_bytes: int = 0
 
 
 @dataclass
@@ -319,6 +330,7 @@ class ClusterSpec:
                 mesh=MeshSpec(**g["mesh"]) if g.get("mesh") else MeshSpec(),
                 lm_models=tuple(g.get("lm_models", ())),
                 roles=dict(g.get("roles", {}) or {}),
+                hbm_bytes=int(g.get("hbm_bytes", 0) or 0),
             )
             for g in raw.get("worker_groups", [])
         ]

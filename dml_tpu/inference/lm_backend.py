@@ -22,12 +22,15 @@ regardless of which worker served it or what else shared the batch.
 from __future__ import annotations
 
 import asyncio
+import logging
 import re
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from ..jobs.cost_model import ModelCost
 from .generate import LMConfig
@@ -205,14 +208,33 @@ class LMBackend:
         # can never interleave two drivers of one slot grid
         self.driver = LMDriver(self.server, server_lock=self._serve_lock)
 
+    @staticmethod
+    def _token_cbs(
+        paths: Sequence[str], on_token
+    ) -> Optional[list]:
+        """Per-prompt LMServer delivery callbacks from the service's
+        ``on_token(local_path, text)`` streaming contract
+        (ingress/streaming.py): each delivered token id streams as its
+        decimal text + separator, so the streamed concatenation is
+        exactly the result's token list in prompt-file format."""
+        if on_token is None:
+            return None
+        return [
+            (lambda t, p=p: on_token(p, f"{int(t)} ")) for p in paths
+        ]
+
     def serve_files(
-        self, paths: Sequence[str], on_dispatch=None
+        self, paths: Sequence[str], on_dispatch=None, on_token=None
     ) -> Tuple[Dict[str, Any], float, Dict[str, float]]:
         """Decode every prompt file; returns (results keyed by path,
         decode seconds, cost constants) — the sync core of
         `backend()`. `on_dispatch` (overlap mode) fires once the
         prompts are submitted to the shared driver, so the caller's
-        pipeline can promote its next staged batch immediately."""
+        pipeline can promote its next staged batch immediately.
+        `on_token(local_path, text)` (the ingress streaming contract)
+        fires per DELIVERED token from the decode grid's packed
+        readbacks — real-engine `request-load` streaming, with the
+        streamed text concatenating to exactly the final result."""
         parsed = [
             parse_prompt_file(p, self.cfg.vocab_size) for p in paths
         ]
@@ -236,10 +258,12 @@ class LMBackend:
                     f"{budget} exceeds the server's "
                     f"max_len {self.server.max_len}"
                 )
+        cbs = self._token_cbs(paths, on_token)
         if self.overlap:
             t0 = time.monotonic()
             toks = self.driver.serve(
-                prompts, budgets, on_dispatch=on_dispatch
+                prompts, budgets, on_dispatch=on_dispatch,
+                on_token=cbs,
             )
             infer_time = time.monotonic() - t0
             results = {
@@ -252,7 +276,9 @@ class LMBackend:
                 # preempted decode is queueing, not this batch's cost —
                 # it must not inflate the scheduler's per_query model
                 t0 = time.monotonic()
-                rids = self.server.submit_many(prompts, budgets)
+                rids = self.server.submit_many(
+                    prompts, budgets, on_token=cbs
+                )
                 # run(rids): drain only OUR requests — a bare run()
                 # would also consume (and discard) results of any
                 # in-flight driver tickets sharing the grid
@@ -270,17 +296,21 @@ class LMBackend:
         return results, infer_time, self.cost_constants()
 
     async def backend(
-        self, model: str, paths: Sequence[str], on_dispatch=None
+        self, model: str, paths: Sequence[str], on_dispatch=None,
+        on_token=None,
     ) -> Tuple[Dict[str, Any], float, Dict[str, float]]:
         """JobService-compatible coroutine; the blocking decode runs in
         a thread so the node's event loop stays live (same pattern as
         the engine's infer_files_async). Declaring `on_dispatch` opts
         in to the job pipeline's promote-at-dispatch (jobs/service.py
         detects the parameter): the staged next batch starts the
-        moment this batch's prompts are in the driver's grid."""
+        moment this batch's prompts are in the driver's grid.
+        Declaring `on_token` opts in to ingress per-request token
+        streaming: the service fans each delivered token out to the
+        request's data-plane stream as the grid reads it back."""
         del model
         return await asyncio.to_thread(
-            self.serve_files, paths, on_dispatch
+            self.serve_files, paths, on_dispatch, on_token
         )
 
     def close(self) -> None:
@@ -319,6 +349,7 @@ class LMBackend:
         prompts: Sequence[np.ndarray],
         budgets: Sequence[int],
         slabs: Sequence[Dict[str, Any]],
+        on_token=None,
     ) -> Tuple[List[np.ndarray], float]:
         """Decode a batch whose prefill happened ELSEWHERE: each slab
         ({"rows": per-layer KV cache for positions < len(prompt),
@@ -326,14 +357,57 @@ class LMBackend:
         `LMServer.submit_prefilled` and decodes to its budget. Returns
         (per-prompt generated tokens in order, decode seconds).
 
-        Drives the raw server serially under the serve lock (the
-        disaggregated group primary is ONE scheduler slot, so batches
-        arrive one at a time; sharing the overlap driver would add a
-        thread hop for nothing). Adoption is paced by free slots —
-        a slab waits host-side until a slot retires, exactly like a
-        queued local submit."""
+        The whole-slab convenience form of `serve_prefilled_stream`:
+        every slab is already host-side, so the arrival queue is
+        pre-filled. Failure discipline is PER REQUEST (a slab that
+        cannot be adopted falls back to a local prefill of that one
+        prompt); greedy outputs are identical either way."""
+        import queue as _queue
+
         if len(prompts) != len(slabs) or len(prompts) != len(budgets):
             raise ValueError("prompts/budgets/slabs length mismatch")
+        arrivals: "_queue.Queue" = _queue.Queue()
+        for i, slab in enumerate(slabs):
+            arrivals.put((i, slab))
+        toks, infer_time, _ = self.serve_prefilled_stream(
+            prompts, budgets, arrivals, on_token=on_token
+        )
+        return toks, infer_time
+
+    def serve_prefilled_stream(
+        self,
+        prompts: Sequence[np.ndarray],
+        budgets: Sequence[int],
+        arrivals,  # queue.Queue of (index, slab_entry_or_None)
+        on_token=None,
+        on_first_token=None,
+        arrival_timeout: float = 120.0,
+    ) -> Tuple[List[np.ndarray], float, Dict[str, int]]:
+        """Decode a batch whose KV slabs ARRIVE INCREMENTALLY (the
+        chunk-streamed handoff, inference/lm_sharded.py): `arrivals`
+        is a thread-safe queue that eventually yields exactly one
+        ``(index, entry)`` item per prompt — `entry` is the slab dict
+        to adopt, or None to run a LOCAL prefill for that request (a
+        failed/faulted handoff). Requests adopt slots AS THEIR SLABS
+        LAND, so decode of early arrivals overlaps the peer's
+        remaining prefill compute — the first decoded token can leave
+        before the last slab chunk is even computed.
+
+        Failure discipline is PER REQUEST: an entry whose adoption
+        fails (drifted peer spec, lying shapes) demotes to a local
+        prefill of that one prompt; nothing fails the batch. Returns
+        ``(per-prompt tokens in order, decode seconds,
+        {"adopted": n, "local": n})``.
+
+        `on_token` is the per-prompt callback list/None (the streaming
+        contract, see serve_files); `on_first_token` fires ONCE at the
+        batch's first delivered token (TTFT measurement hook). Drives
+        the raw server serially under the serve lock (the
+        disaggregated group primary is ONE scheduler slot)."""
+        import queue as _queue
+
+        if len(prompts) != len(budgets):
+            raise ValueError("prompts/budgets length mismatch")
         if self.server.temperature != 0.0:
             # sampled streams are keyed by THIS server's rids, which
             # the prefill node cannot know — disaggregation is a
@@ -341,35 +415,109 @@ class LMBackend:
             raise ValueError(
                 "disaggregated decode requires temperature == 0"
             )
+        n = len(prompts)
+        first_fired = [False]
+
+        def _cb(i: int):
+            inner = on_token[i] if on_token is not None else None
+
+            def fire(t: int) -> None:
+                if not first_fired[0]:
+                    first_fired[0] = True
+                    if on_first_token is not None:
+                        try:
+                            on_first_token()
+                        except Exception:
+                            pass
+                if inner is not None:
+                    inner(t)
+
+            return fire
+
         srv = self.server
+        stats = {"adopted": 0, "local": 0}
         with self._serve_lock:
             t0 = time.monotonic()
-            pending = list(zip(prompts, budgets, slabs))
-            rids: List[int] = []
+            received = 0
+            to_adopt: List[Tuple[int, Dict[str, Any]]] = []
+            rids: List[Optional[int]] = [None] * n
             done: Dict[int, np.ndarray] = {}
+
+            def submit_local(idx: int) -> None:
+                rids[idx] = srv.submit_many(
+                    [prompts[idx]], [budgets[idx]],
+                    on_token=[_cb(idx)],
+                )[0]
+                stats["local"] += 1
+
             try:
-                while pending or any(rid not in done for rid in rids):
-                    while pending and srv.free_slot_count() > 0:
-                        p, b, slab = pending.pop(0)
-                        rids.append(srv.submit_prefilled(
-                            p, b, slab["rows"], slab["first_token"]
-                        ))
-                    if any(rid not in done for rid in rids):
-                        srv.step()  # slots retire mid-batch; refill
+                while True:
+                    # 1) drain arrivals; block only when the grid has
+                    # nothing to chew on (otherwise decode overlaps
+                    # the wait for the next slab)
+                    block = (
+                        received < n and not to_adopt
+                        and not srv.has_work()
+                    )
+                    while received < n:
+                        try:
+                            idx, entry = arrivals.get(
+                                block=block,
+                                timeout=arrival_timeout if block else None,
+                            ) if block else arrivals.get_nowait()
+                        except _queue.Empty:
+                            if block:
+                                raise TimeoutError(
+                                    "KV slab arrivals stalled "
+                                    f"({received}/{n} after "
+                                    f"{arrival_timeout:g}s idle)"
+                                )
+                            break
+                        block = False
+                        received += 1
+                        if entry is None:
+                            submit_local(idx)
+                        else:
+                            to_adopt.append((idx, entry))
+                    # 2) adopt landed slabs into free slots; a bad slab
+                    # demotes to a local prefill of ITS request only
+                    while to_adopt and srv.free_slot_count() > 0:
+                        idx, entry = to_adopt.pop(0)
+                        try:
+                            rids[idx] = srv.submit_prefilled(
+                                prompts[idx], budgets[idx],
+                                entry["rows"], entry["first_token"],
+                                on_token=_cb(idx),
+                            )
+                            stats["adopted"] += 1
+                        except Exception as e:
+                            log.warning(
+                                "slab adoption failed for request %d "
+                                "(%r); local prefill", idx, e,
+                            )
+                            submit_local(idx)
+                    # 3) advance the grid
+                    if srv.has_work():
+                        srv.step()
                     done.update(srv.take_done())
+                    if (
+                        received >= n and not to_adopt
+                        and all(r is not None for r in rids)
+                        and all(r in done for r in rids)
+                    ):
+                        break
             except Exception:
-                # a mid-batch adoption failure (slab k of n mismatched
-                # this server's shapes) must not leave requests < k
-                # occupying the grid: drain them to completion and
-                # discard, so the caller's fallback serve starts clean
-                live = [r for r in rids if r not in done]
+                # arrivals stalling/dying must not leave the earlier
+                # requests occupying the grid: drain them to completion
+                # and discard, so the caller's fallback starts clean
+                live = [r for r in rids if r is not None and r not in done]
                 if live:
                     srv.run(live)
                 raise
             infer_time = time.monotonic() - t0
-        if prompts:
-            self._per_query = infer_time / len(prompts)
-        return [done[rid] for rid in rids], infer_time
+        if n:
+            self._per_query = infer_time / n
+        return [done[rid] for rid in rids], infer_time, stats
 
     @classmethod
     def from_spec(cls, spec: Dict[str, Any]) -> "LMBackend":

@@ -140,6 +140,27 @@ class _Request:
     emitted: int = 0
     slot: Optional[int] = None
     t_submit: float = 0.0  # monotonic submit time (queue-wait metric)
+    # per-request delivery callback (ingress token streaming): fired
+    # with each token VALUE the moment it is read back to the host —
+    # the decode grid's per-token stream source. Never on the device
+    # path: deliveries happen at the packed readback, so firing here
+    # adds no dispatches and no extra link round-trips.
+    on_token: Optional[Callable[[int], None]] = None
+
+    def deliver(self, toks) -> None:
+        """Append read-back token values to `out`, firing `on_token`
+        per token. The single append point — every readback path
+        (step, _flush_firsts, submit_prefilled) must land here so
+        streaming sees exactly the tokens the result carries."""
+        cb = self.on_token
+        for t in toks:
+            t = int(t)
+            self.out.append(t)
+            if cb is not None:
+                try:
+                    cb(t)
+                except Exception:
+                    pass  # a streaming hint, never a decode error
 
     @property
     def done(self) -> bool:
@@ -371,6 +392,7 @@ class LMServer:
         self,
         prompts: Sequence[np.ndarray],
         max_new_tokens,
+        on_token: Optional[Sequence[Optional[Callable[[int], None]]]] = None,
     ) -> List[int]:
         """Queue a burst of requests and place them in ONE batched
         round. `max_new_tokens` is an int shared by the burst or a
@@ -378,7 +400,11 @@ class LMServer:
         home turf: each slot refills the moment ITS request retires
         instead of waiting out the burst's slowest. Validates EVERY
         prompt before queueing ANY (atomic), preserving sequential
-        submit()'s rid order."""
+        submit()'s rid order.
+
+        `on_token` is an optional per-prompt sequence of callbacks;
+        request i's callback fires with each of its token values as
+        they are read back (the ingress per-token stream source)."""
         if isinstance(max_new_tokens, (int, np.integer)):
             budgets = [int(max_new_tokens)] * len(prompts)
         else:
@@ -387,14 +413,22 @@ class LMServer:
                 raise ValueError(
                     f"{len(budgets)} budgets for {len(prompts)} prompts"
                 )
+        if on_token is not None and len(on_token) != len(prompts):
+            raise ValueError(
+                f"{len(on_token)} on_token callbacks for "
+                f"{len(prompts)} prompts"
+            )
         validated = [
             self._validate(p, b) for p, b in zip(prompts, budgets)
         ]
         reqs = []
         now = time.monotonic()
-        for prompt, b in zip(validated, budgets):
+        for i, (prompt, b) in enumerate(zip(validated, budgets)):
             self._rid += 1
-            reqs.append(_Request(self._rid, prompt, b, t_submit=now))
+            reqs.append(_Request(
+                self._rid, prompt, b, t_submit=now,
+                on_token=on_token[i] if on_token is not None else None,
+            ))
         _M_REQS.inc(len(reqs))
         self._queue.extend(reqs)
         self._place_waiting()
@@ -411,6 +445,7 @@ class LMServer:
         max_new_tokens: int,
         rows: Dict[str, Dict[str, np.ndarray]],
         first_token: int,
+        on_token: Optional[Callable[[int], None]] = None,
     ) -> int:
         """Adopt an EXTERNALLY-prefilled request: place a KV-cache
         slab computed elsewhere (a prefill-role worker, transported as
@@ -464,7 +499,7 @@ class LMServer:
         self._rid += 1
         req = _Request(
             self._rid, prompt, int(max_new_tokens),
-            t_submit=time.monotonic(),
+            t_submit=time.monotonic(), on_token=on_token,
         )
         _M_REQS.inc()
         self.cache = self._insert(
@@ -479,7 +514,7 @@ class LMServer:
         self._pos_dev = self._merge_vec(
             self._pos_dev, jnp.asarray([tp], jnp.int32), sm
         )
-        req.out.append(int(first_token))
+        req.deliver([int(first_token)])
         req.emitted = 1
         req.slot = slot
         self._slot_req[slot] = req
@@ -603,7 +638,7 @@ class LMServer:
         identical or tokens land on the wrong requests."""
         for reqs, v in entries:
             for i, req in enumerate(reqs):
-                req.out.append(int(vals[off + i]))
+                req.deliver([int(vals[off + i])])
             off += int(v.shape[0])
         return off
 
@@ -664,7 +699,7 @@ class LMServer:
             if req is None:
                 continue
             take = min(self.chunk, req.max_new_tokens - req.emitted)
-            req.out.extend(int(t) for t in toks[:take, slot])
+            req.deliver(toks[:take, slot])
             req.emitted += take
             delivered += take
             # take < chunk ⇒ the request retires here; the slot's
@@ -747,6 +782,9 @@ class _Ticket:
     max_new_tokens: Any  # int, or per-prompt sequence of ints
     event: threading.Event
     on_dispatch: Optional[Callable[[], None]] = None
+    # per-prompt token-delivery callbacks (ingress streaming), passed
+    # through to LMServer.submit_many
+    on_token: Optional[Sequence[Optional[Callable[[int], None]]]] = None
     rids: Optional[List[int]] = None
     remaining: int = 0
     results: Optional[Dict[int, np.ndarray]] = None
@@ -814,6 +852,9 @@ class LMDriver:
         prompts: Sequence[np.ndarray],
         max_new_tokens,
         on_dispatch: Optional[Callable[[], None]] = None,
+        on_token: Optional[
+            Sequence[Optional[Callable[[int], None]]]
+        ] = None,
     ) -> List[np.ndarray]:
         """Blocking: decode `prompts`, return their completions in
         order. `max_new_tokens` is an int or a per-prompt sequence
@@ -821,12 +862,15 @@ class LMDriver:
         `on_dispatch` fires (on the DRIVER thread) the moment the
         ticket's prompts are submitted to the server — the caller's
         pipeline can start preparing its next batch from that point,
-        not from completion."""
+        not from completion. `on_token` (per-prompt callbacks, fired
+        on the driver thread per delivered token) streams each
+        request's tokens as they read back."""
         t = _Ticket(
             prompts=[np.asarray(p, np.int32).reshape(-1) for p in prompts],
             max_new_tokens=max_new_tokens,
             event=threading.Event(),
             on_dispatch=on_dispatch,
+            on_token=on_token,
         )
         with self._cv:
             if self._stop:
@@ -920,7 +964,10 @@ class LMDriver:
                         # before any of its prompts queue (submit_many
                         # is atomic), so a bad prompt file can't leave
                         # siblings decoding into a discarded result
-                        t.rids = srv.submit_many(t.prompts, t.max_new_tokens)
+                        t.rids = srv.submit_many(
+                            t.prompts, t.max_new_tokens,
+                            on_token=t.on_token,
+                        )
                         t.remaining = len(t.rids)
                         t.results = {}
                         for rid in t.rids:

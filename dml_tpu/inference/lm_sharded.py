@@ -165,6 +165,586 @@ def sharded_lm_backend(
 
 
 # ----------------------------------------------------------------------
+# pipeline-parallel serving (layer-stack sharded over the `pp` axis)
+# ----------------------------------------------------------------------
+
+
+def lm_param_bytes(params: Any) -> int:
+    """Total bytes of a params tree (HBM-budget accounting)."""
+    import jax
+
+    return int(sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree_util.tree_leaves(params)
+    ))
+
+
+def pp_hbm_report(lm_spec: Dict[str, Any], pp: int) -> Dict[str, Any]:
+    """Per-member HBM accounting for a pp group: the block stack
+    shards 1/pp per member while embed/ln_out/lm_head replicate. This
+    is the number `WorkerGroupSpec.hbm_bytes` is checked against — a
+    model whose FULL tree exceeds a member's budget can still serve
+    when `per_member_bytes` fits."""
+    params, _cfg = lm_spec_parts_cached(lm_spec)
+    blocks = {k: v for k, v in params.items() if k.startswith("block_")}
+    io = {k: v for k, v in params.items() if not k.startswith("block_")}
+    full = lm_param_bytes(params)
+    block_b = lm_param_bytes(blocks)
+    io_b = lm_param_bytes(io)
+    return {
+        "full_bytes": full,
+        "block_bytes": block_b,
+        "io_bytes": io_b,
+        "per_member_bytes": io_b + block_b // max(1, int(pp)),
+        "pp": int(pp),
+    }
+
+
+_SPEC_CACHE: Dict[str, Tuple[Any, Any]] = {}
+
+
+def lm_spec_parts_cached(lm_spec: Dict[str, Any]):
+    """lm_spec_parts with a process cache keyed on the JSON'd spec —
+    the pp wiring consults the tree for byte accounting AND builds the
+    engine from it; initializing the weights twice per node is wasted
+    startup wall."""
+    from .lm_backend import lm_spec_parts
+
+    key = json.dumps(
+        {k: v for k, v in lm_spec.items()}, sort_keys=True, default=str
+    )
+    hit = _SPEC_CACHE.get(key)
+    if hit is None:
+        hit = lm_spec_parts(lm_spec)
+        _SPEC_CACHE[key] = hit
+    return hit
+
+
+class PipelinedLMBackend:
+    """GPipe-style pipeline-parallel LM serving over a group mesh's
+    ``pp`` axis — the serving graft of `parallel/pipeline.py`'s stage
+    logic (same schedule skeleton: stacked stage params sharded over
+    `pp`, a single `lax.scan` of ticks inside one `shard_map`, one
+    `ppermute` hop per tick, masked bubble ticks), extended with what
+    decode needs and prefill doesn't: per-stage KV caches and a RING
+    token feedback (the last stage's sampled token rides the same
+    wrap-around ppermute edge back to stage 0, where it embeds as the
+    next step's input).
+
+    This is the serving form for models DEEPER than one member's HBM:
+    each pp device holds only ``n_layers/pp`` transformer blocks (the
+    dominant weights) plus the replicated embed/head, so a group of S
+    members serves a layer stack no single member could hold
+    (`pp_hbm_report` is the accounting the group wiring checks against
+    ``WorkerGroupSpec.hbm_bytes``).
+
+    Schedule:
+
+    - **prefill**: microbatch m enters stage 0 at tick m; stage s
+      applies its block slice with flash attention and writes its
+      layers' KV rows; S + M - 1 ticks total — `pipeline_apply`'s
+      exact shape, with the last stage reading per-row true-length
+      logits (bucket padding, like the LMServer) and emitting each
+      microbatch's first token.
+    - **decode**: microbatch m's token k occupies stage s at tick
+      (k-1)·S + m + s. With M = S microbatches the ring is FULL: every
+      device computes every tick (the S-1-tick bubble only at fill and
+      drain). Tokens travel as a separate i32 lane alongside the
+      hidden-state buffer, so vocab ids never round-trip through the
+      activation dtype.
+
+    Exactness: the stage body is `generate.py`'s `_apply_block` with
+    the same flash-prefill / einsum-decode attention closures, applied
+    in the same layer order with the same dtypes — greedy outputs are
+    token-identical to isolated `generate()` per prompt (asserted by
+    the bench and tests/test_lm_sharded.py). Greedy only (sampling
+    streams are server-rid-keyed); bf16/f32 cache layouts only
+    (kv_quant's scale planes would double the per-tick permute
+    traffic for a form the Pallas kernel owns anyway)."""
+
+    def __init__(
+        self,
+        lm_spec: Dict[str, Any],
+        mesh,
+        microbatches: Optional[int] = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self.pp = int(mesh.shape.get("pp", 1))
+        if self.pp < 2:
+            raise ValueError(
+                f"pipeline serving needs a pp axis >= 2, mesh has "
+                f"pp={self.pp}"
+            )
+        for ax in ("dp", "tp", "sp", "ep"):
+            if mesh.shape.get(ax, 1) != 1:
+                raise ValueError(
+                    "the pipeline serving form parallelizes over `pp` "
+                    f"only; mesh axis {ax}={mesh.shape[ax]} would "
+                    "replicate stage compute and misreport capacity "
+                    "(tp x pp composition is the real-ICI remainder, "
+                    "ROADMAP item 3)"
+                )
+        params, cfg = lm_spec_parts_cached(lm_spec)
+        if cfg.kv_quant:
+            raise ValueError("pipeline serving supports bf16/f32 "
+                             "KV cache layouts only (no kv_quant)")
+        if cfg.n_layers % self.pp:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by pp {self.pp}"
+            )
+        self.cfg = cfg
+        self.model = str(lm_spec.get("name", "LM"))
+        self.max_new_tokens = int(lm_spec.get("max_new_tokens", 32))
+        self.max_len = int(lm_spec.get("max_len", 1024))
+        self.temperature = float(lm_spec.get("temperature", 0.0))
+        if self.temperature != 0.0:
+            raise ValueError("pipeline serving is greedy-only")
+        self.microbatches = int(microbatches or self.pp)
+        if not (1 <= self.microbatches <= self.pp):
+            raise ValueError(
+                f"microbatches {self.microbatches} must be in "
+                f"[1, pp={self.pp}] (the ring holds at most one "
+                "in-flight token per stage)"
+            )
+        self._jax = jax
+        self._jnp = jnp
+        # stage-stacked block params: leaves [n_layers, ...] sharded
+        # over pp on the stack axis — each device holds its contiguous
+        # n_layers/pp slice and NOTHING else of the stack
+        blocks = [params[f"block_{i}"] for i in range(cfg.n_layers)]
+        stacked = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls, axis=0), *blocks
+        )
+        self.stacked = jax.device_put(
+            stacked,
+            jax.tree_util.tree_map(
+                lambda l: NamedSharding(
+                    mesh, P("pp", *([None] * (l.ndim - 1)))
+                ),
+                stacked,
+            ),
+        )
+        self.io = jax.device_put(
+            {k: v for k, v in params.items()
+             if not k.startswith("block_")},
+            NamedSharding(mesh, P()),
+        )
+        self.hbm = pp_hbm_report(lm_spec, self.pp)
+        self._per_query = 0.05
+        self._fns: Dict[Tuple, Any] = {}
+        self.tokens_delivered = 0
+        self.batches_served = 0
+
+    # -- compiled stage programs --------------------------------------
+
+    #: bound on retained (slots, bucket, T) program pairs — each is
+    #: two GSPMD compiles; a long-lived node must not grow this with
+    #: every batch-shape it ever saw
+    MAX_COMPILED_SHAPES = 8
+
+    def _stage_fns(self, slots: int, bucket: int, new_tokens: int):
+        """(prefill_fn, decode_fn) for one (slots, bucket, T) shape,
+        jit-cached with FIFO eviction at `MAX_COMPILED_SHAPES`.
+        `slots` must be a multiple of `microbatches`."""
+        key = (slots, bucket, new_tokens)
+        fns = self._fns.get(key)
+        if fns is None:
+            while len(self._fns) >= self.MAX_COMPILED_SHAPES:
+                self._fns.pop(next(iter(self._fns)))
+            fns = (
+                self._build_prefill(slots, bucket),
+                self._build_decode(slots, new_tokens),
+            )
+            self._fns[key] = fns
+        return fns
+
+    def _build_prefill(self, slots: int, bucket: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.flash_attention import flash_attention
+        from ..parallel.pipeline import shard_map_nocheck
+        from .generate import _apply_block, _head
+
+        cfg = self.cfg
+        s = self.pp
+        m_count = self.microbatches
+        mb = slots // m_count
+        l_per = cfg.n_layers // s
+        max_len = self.max_len
+        grp = cfg.n_heads // cfg.kv_heads
+        fwd = [(i, (i + 1) % s) for i in range(s)]
+        positions = jnp.arange(bucket)
+
+        def flash_fn(q, k, v):
+            if grp > 1:
+                k = jnp.repeat(k, grp, axis=2)
+                v = jnp.repeat(v, grp, axis=2)
+            return flash_attention(q, k, v, causal=True)
+
+        def per_device(blocks, io, prompts, tps):
+            # blocks leaves arrive [l_per, ...] (the pp shard); the
+            # replicated io/prompts arrive whole
+            stage = jax.lax.axis_index("pp")
+            prompts_m = prompts.reshape(m_count, mb, bucket)
+            tps_m = tps.reshape(m_count, mb)
+            cache0 = {
+                f"block_{l}": {
+                    "k": jnp.zeros(
+                        (slots, cfg.kv_heads, max_len, cfg.head_dim),
+                        cfg.dtype),
+                    "v": jnp.zeros(
+                        (slots, cfg.kv_heads, max_len, cfg.head_dim),
+                        cfg.dtype),
+                }
+                for l in range(l_per)
+            }
+            zero_act = jnp.zeros((mb, bucket, cfg.d_model), cfg.dtype)
+            firsts0 = jnp.zeros((m_count, mb), jnp.int32)
+
+            def tick(carry, t):
+                act, cache, firsts = carry
+                m = t - stage
+                valid = (m >= 0) & (m < m_count)
+                mc = jnp.clip(m, 0, m_count - 1)
+                inj = io["embed"]["embedding"][
+                    prompts_m[jnp.clip(t, 0, m_count - 1)]
+                ].astype(cfg.dtype)
+                x = jnp.where(stage == 0, inj, act)
+                off = mc * mb
+                for l in range(l_per):
+                    blk = jax.tree_util.tree_map(
+                        lambda a, l=l: a[l], blocks
+                    )
+                    x, k, v = _apply_block(
+                        blk, cfg, x, positions, flash_fn
+                    )
+                    pad4 = ((0, 0), (0, 0), (0, max_len - bucket), (0, 0))
+                    kh = jnp.pad(
+                        jnp.swapaxes(k, 1, 2).astype(cfg.dtype), pad4)
+                    vh = jnp.pad(
+                        jnp.swapaxes(v, 1, 2).astype(cfg.dtype), pad4)
+                    name = f"block_{l}"
+                    old_k = jax.lax.dynamic_slice_in_dim(
+                        cache[name]["k"], off, mb, axis=0)
+                    old_v = jax.lax.dynamic_slice_in_dim(
+                        cache[name]["v"], off, mb, axis=0)
+                    cache[name] = {
+                        "k": jax.lax.dynamic_update_slice_in_dim(
+                            cache[name]["k"],
+                            jnp.where(valid, kh, old_k), off, axis=0),
+                        "v": jax.lax.dynamic_update_slice_in_dim(
+                            cache[name]["v"],
+                            jnp.where(valid, vh, old_v), off, axis=0),
+                    }
+                # last stage: per-row true-length logits -> greedy
+                # first token (the bucket-padding exactness contract)
+                x_last = jax.vmap(
+                    lambda row, i: jax.lax.dynamic_slice_in_dim(
+                        row, i, 1, axis=0)
+                )(x, jnp.clip(tps_m[mc] - 1, 0, bucket - 1))
+                tok = jnp.argmax(
+                    _head(io, cfg, x_last), axis=-1).astype(jnp.int32)
+                write = valid & (stage == s - 1)
+                firsts = firsts.at[mc].set(
+                    jnp.where(write, tok, firsts[mc])
+                )
+                nxt = jax.lax.ppermute(x, "pp", fwd)
+                return (nxt, cache, firsts), None
+
+            (act, cache, firsts), _ = jax.lax.scan(
+                tick, (zero_act, cache0, firsts0),
+                jnp.arange(s + m_count - 1),
+            )
+            # every pp row must agree for the replicated out_spec
+            return jax.lax.psum(firsts, "pp"), cache
+
+        cache_spec = {
+            f"block_{l}": {"k": P("pp"), "v": P("pp")}
+            for l in range(l_per)
+        }
+        mapped = shard_map_nocheck(
+            per_device,
+            mesh=self.mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P("pp"), self.stacked),
+                jax.tree_util.tree_map(lambda _: P(), self.io),
+                P(), P(),
+            ),
+            out_specs=(P(), cache_spec),
+        )
+        return jax.jit(mapped)
+
+    def _build_decode(self, slots: int, new_tokens: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.pipeline import shard_map_nocheck
+        from .generate import _apply_block, _head
+
+        cfg = self.cfg
+        s = self.pp
+        m_count = self.microbatches
+        mb = slots // m_count
+        l_per = cfg.n_layers // s
+        max_len = self.max_len
+        t_new = int(new_tokens)
+        grp = cfg.n_heads // cfg.kv_heads
+        hd = cfg.head_dim
+        fwd = [(i, (i + 1) % s) for i in range(s)]
+        n_ticks = (t_new - 1) * s + m_count - 1 if t_new > 1 else 0
+
+        def per_device(blocks, io, cache, firsts, pos0):
+            stage = jax.lax.axis_index("pp")
+            firsts_m = firsts.reshape(m_count, mb)
+            pos0_m = pos0.reshape(m_count, mb)
+            act0 = jnp.zeros((mb, cfg.d_model), cfg.dtype)
+            ids0 = jnp.zeros((mb,), jnp.int32)
+            out0 = jnp.zeros((t_new, m_count, mb), jnp.int32)
+
+            def tick(carry, t):
+                act, ids, cache, out = carry
+                v_idx = t - stage
+                vc = jnp.clip(v_idx, 0, n_ticks)
+                m = vc % s
+                k = vc // s + 1
+                valid = (v_idx >= 0) & (m < m_count) & (k < t_new)
+                mc = jnp.clip(m, 0, m_count - 1)
+                off = mc * mb
+                # stage 0 input token: the prefill first token at
+                # k == 1, else the ring-delivered token from the last
+                # stage's previous tick
+                ids_in = jnp.where(k == 1, firsts_m[mc], ids)
+                x0 = io["embed"]["embedding"][ids_in].astype(cfg.dtype)
+                x = jnp.where(stage == 0, x0, act)[:, None, :]
+                # input token k-1 writes at its row's position
+                # tp + k - 1; invalid ticks park on the reserved last
+                # row (never a live position: the last GENERATED token
+                # is never written, so real writes stop at max_len-2)
+                pos_row = pos0_m[mc] + (k - 1)
+                pos_w = jnp.where(valid, pos_row, max_len - 1)
+                positions = pos_w[:, None]
+                att_valid = (
+                    jnp.arange(max_len)[None, :] <= pos_w[:, None]
+                )
+                for l in range(l_per):
+                    blk = jax.tree_util.tree_map(
+                        lambda a, l=l: a[l], blocks
+                    )
+                    name = f"block_{l}"
+
+                    def attn_fn(q, k_new, v_new, name=name):
+                        # mirror batched_decode_step's einsum path,
+                        # restricted to this microbatch's rows
+                        kh = jnp.swapaxes(k_new, 1, 2).astype(cfg.dtype)
+                        vh = jnp.swapaxes(v_new, 1, 2).astype(cfg.dtype)
+                        ck = cache[name]["k"]
+                        cv = cache[name]["v"]
+                        for bi in range(mb):
+                            start_k = [off + bi, 0, 0, 0]
+                            ck = jax.lax.dynamic_update_slice(
+                                ck, kh[bi : bi + 1],
+                                [start_k[0], jnp.int32(0),
+                                 pos_w[bi], jnp.int32(0)],
+                            )
+                            cv = jax.lax.dynamic_update_slice(
+                                cv, vh[bi : bi + 1],
+                                [start_k[0], jnp.int32(0),
+                                 pos_w[bi], jnp.int32(0)],
+                            )
+                        cache[name] = {"k": ck, "v": cv}
+                        rows_k = jax.lax.dynamic_slice_in_dim(
+                            ck, off, mb, axis=0)
+                        rows_v = jax.lax.dynamic_slice_in_dim(
+                            cv, off, mb, axis=0)
+                        qg = q.astype(jnp.float32).reshape(
+                            mb, 1, cfg.kv_heads, grp, hd)
+                        sc = jnp.einsum(
+                            "bqkgd,bktd->bkgqt", qg,
+                            rows_k.astype(jnp.float32)
+                        ) * (hd ** -0.5)
+                        sc = jnp.where(
+                            att_valid[:, None, None, None, :],
+                            sc, -1e30)
+                        p = jax.nn.softmax(sc, axis=-1)
+                        attn = jnp.einsum(
+                            "bkgqt,bktd->bqkgd", p,
+                            rows_v.astype(jnp.float32))
+                        return attn.reshape(mb, 1, cfg.n_heads, hd)
+
+                    x, _, _ = _apply_block(
+                        blk, cfg, x, positions, attn_fn
+                    )
+                tok = jnp.argmax(
+                    _head(io, cfg, x), axis=-1).astype(jnp.int32)
+                kc = jnp.clip(k, 0, t_new - 1)
+                write = valid & (stage == s - 1)
+                out = out.at[kc, mc].set(
+                    jnp.where(write, tok, out[kc, mc])
+                )
+                nxt_h = jax.lax.ppermute(x[:, 0, :], "pp", fwd)
+                nxt_ids = jax.lax.ppermute(
+                    jnp.where(stage == s - 1, tok, ids), "pp", fwd
+                )
+                return (nxt_h, nxt_ids, cache, out), None
+
+            if n_ticks > 0:
+                (act, ids, cache, out), _ = jax.lax.scan(
+                    tick, (act0, ids0, cache, out0),
+                    jnp.arange(n_ticks),
+                )
+            else:
+                out = out0
+            return jax.lax.psum(out, "pp")
+
+        l_per_spec = {
+            f"block_{l}": {"k": P("pp"), "v": P("pp")}
+            for l in range(l_per)
+        }
+        mapped = shard_map_nocheck(
+            per_device,
+            mesh=self.mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P("pp"), self.stacked),
+                jax.tree_util.tree_map(lambda _: P(), self.io),
+                l_per_spec,
+                P(), P(),
+            ),
+            out_specs=P(),
+        )
+        return jax.jit(mapped)
+
+    # -- serving ------------------------------------------------------
+
+    def generate_batch(
+        self, prompts: Sequence[np.ndarray], budgets: Sequence[int]
+    ) -> List[List[int]]:
+        """Decode a batch through the pipeline; returns per-prompt
+        generated tokens (len = its budget). The whole batch decodes
+        to the max budget (static ring schedule) and each row
+        truncates to its own — mixed budgets cost the difference, the
+        documented pp trade (continuous slot refill is the
+        single-chip/tp servers' territory)."""
+        import jax.numpy as jnp
+
+        from .lm_server import _bucket
+
+        if not prompts:
+            return []
+        prompts = [
+            np.asarray(p, np.int32).reshape(-1) for p in prompts
+        ]
+        budgets = [int(b) for b in budgets]
+        for p, b in zip(prompts, budgets):
+            if p.size == 0:
+                raise ValueError("empty prompt")
+            if b < 1:
+                raise ValueError("budget must be >= 1")
+            if p.size + b > self.max_len:
+                raise ValueError(
+                    f"prompt {p.size} + budget {b} exceeds max_len "
+                    f"{self.max_len}"
+                )
+        n = len(prompts)
+        # coarse shape buckets: ingress traffic varies batch size and
+        # per-request budget per formed batch, and every distinct
+        # (slots, bucket, t_new) triple is TWO multi-second GSPMD
+        # compiles — round the decode horizon and microbatch count up
+        # to powers of two (prompt lengths already bucket via
+        # _bucket). Rows truncate to their OWN budget and overflow
+        # cache writes clamp onto the reserved scratch row, so
+        # padding costs ticks, never answers.
+        t_new = max(budgets)
+        if t_new > 1:
+            t_new = 1 << (t_new - 1).bit_length()
+        t_new = min(t_new, self.max_len - 1)
+        bucket = min(_bucket(max(p.size for p in prompts)), self.max_len)
+        m_groups = -(-n // self.microbatches)
+        m_groups = 1 << (m_groups - 1).bit_length()
+        slots = m_groups * self.microbatches
+        padded = np.zeros((slots, bucket), np.int32)
+        tps = np.ones(slots, np.int32)
+        for i in range(slots):
+            p = prompts[i if i < n else 0]  # dummy rows repeat row 0
+            padded[i, : p.size] = p
+            padded[i, p.size:] = p[-1]  # the server's pad policy
+            tps[i] = p.size
+        prefill_fn, decode_fn = self._stage_fns(slots, bucket, t_new)
+        firsts, cache = prefill_fn(
+            self.stacked, self.io, jnp.asarray(padded), jnp.asarray(tps)
+        )
+        toks = decode_fn(
+            self.stacked, self.io, cache, firsts.reshape(-1),
+            jnp.asarray(tps),
+        )  # [t_new, M, mb]
+        firsts_host = np.asarray(firsts).reshape(-1)
+        rest = np.asarray(toks).reshape(t_new, -1)  # [t_new, slots]
+        out: List[List[int]] = []
+        for i in range(n):
+            seq = [int(firsts_host[i])] + [
+                int(rest[k, i]) for k in range(1, budgets[i])
+            ]
+            out.append(seq)
+        return out
+
+    def serve_files(
+        self, paths: Sequence[str], on_dispatch=None
+    ) -> Tuple[Dict[str, Any], float, Dict[str, float]]:
+        """JobService-shaped serve (the LMBackend.serve_files
+        contract): parse prompt files, pipeline-decode, key results by
+        path."""
+        from .lm_backend import parse_prompt_file
+
+        parsed = [
+            parse_prompt_file(p, self.cfg.vocab_size) for p in paths
+        ]
+        prompts = [ids for ids, _ in parsed]
+        budgets = [
+            b if b is not None else self.max_new_tokens
+            for _, b in parsed
+        ]
+        t0 = time.monotonic()
+        toks = self.generate_batch(prompts, budgets)
+        infer_time = time.monotonic() - t0
+        delivered = sum(len(t) for t in toks)
+        self.tokens_delivered += delivered
+        self.batches_served += 1
+        if paths:
+            self._per_query = infer_time / len(paths)
+        return (
+            {p: {"tokens": list(t)} for p, t in zip(paths, toks)},
+            infer_time,
+            self.cost_constants(),
+        )
+
+    async def backend(
+        self, model: str, paths: Sequence[str]
+    ) -> Tuple[Dict[str, Any], float, Dict[str, float]]:
+        del model
+        return await asyncio.to_thread(self.serve_files, paths)
+
+    def decode_tokens_total(self) -> int:
+        return int(self.tokens_delivered)
+
+    def cost_constants(self) -> Dict[str, float]:
+        return {
+            "load_time": 0.0,
+            "first_query": self._per_query,
+            "per_query": self._per_query,
+            "batch_size": max(self.microbatches, 1),
+        }
+
+    def close(self) -> None:  # symmetry with LMBackend
+        pass
+
+
+# ----------------------------------------------------------------------
 # KV-cache slab serialization (the prefill->decode handoff payload)
 # ----------------------------------------------------------------------
 
@@ -212,6 +792,83 @@ def _np_dtype(name: str) -> np.dtype:
 
         return np.dtype(ml_dtypes.bfloat16)
     return np.dtype(name)
+
+
+#: max payload bytes per pushed stream chunk — small enough that the
+#: decode side adopts early requests while later ones still transfer,
+#: large enough that framing overhead stays noise
+SLAB_STREAM_CHUNK = 1 << 18
+
+
+async def push_slab_entry(feed, idx: int, blob: bytes) -> None:
+    """Frame ONE request's serialized slab onto a live StreamFeed:
+    a JSON header chunk ``{"i", "size"}`` followed by the blob in
+    ``SLAB_STREAM_CHUNK`` pieces. Chunk boundaries survive the wire
+    (each push is one length-prefixed frame, data_plane fetch_stream),
+    so the reader's framing state machine needs no resync. Pushes via
+    the feed's BACKPRESSURED ``put`` — the lossy drop-oldest push()
+    is a token-streaming latency trade that would garble the framed
+    sequence, and buffering without bound would hold a whole share's
+    slabs when the puller lags prefill compute."""
+    await feed.put(json.dumps(
+        {"i": int(idx), "size": len(blob)}
+    ).encode())
+    for off in range(0, len(blob), SLAB_STREAM_CHUNK):
+        await feed.put(blob[off : off + SLAB_STREAM_CHUNK])
+
+
+async def push_slab_error(feed, idx: int, error: str) -> None:
+    """Frame a per-request prefill failure: the decode side falls
+    back to a LOCAL prefill for exactly this request."""
+    await feed.put(json.dumps(
+        {"i": int(idx), "error": str(error)[:500]}
+    ).encode())
+
+
+async def iter_slab_stream(chunks):
+    """Async generator over a framed slab stream: yields
+    ``(index, entry_or_None)`` per request as its chunks complete —
+    None for a request the peer reported failed. Raises ValueError on
+    a garbled frame (the caller treats the REST of that peer's share
+    as failed handoffs; requests already yielded stay adopted)."""
+    header: Optional[Dict[str, Any]] = None
+    buf: List[bytes] = []
+    got = 0
+    async for chunk in chunks:
+        if header is None:
+            try:
+                header = json.loads(chunk.decode())
+                if not isinstance(header, dict) or "i" not in header:
+                    raise ValueError
+            except (ValueError, UnicodeDecodeError):
+                raise ValueError("garbled slab-stream header frame")
+            if "error" in header:
+                yield int(header["i"]), None
+                header = None
+                continue
+            buf, got = [], 0
+            if int(header.get("size", -1)) < 0:
+                raise ValueError("slab-stream header without size")
+            if header["size"] == 0:
+                raise ValueError("zero-size slab entry")
+            continue
+        buf.append(chunk)
+        got += len(chunk)
+        if got > int(header["size"]):
+            raise ValueError(
+                f"slab stream overran its declared size "
+                f"({got} > {header['size']})"
+            )
+        if got == int(header["size"]):
+            entries = kv_slab_from_bytes(b"".join(buf))
+            if len(entries) != 1:
+                raise ValueError(
+                    f"slab-stream entry held {len(entries)} slabs"
+                )
+            yield int(header["i"]), entries[0]
+            header = None
+    if header is not None:
+        raise ValueError("slab stream ended mid-entry")
 
 
 def kv_slab_from_bytes(data: bytes) -> List[Dict[str, Any]]:
@@ -267,7 +924,10 @@ class LMPrefillBackend:
     decode side's adopted continuation is token-for-token what its
     own local prefill would have produced (greedy)."""
 
-    def __init__(self, params: Any, cfg, max_len: int = 1024):
+    def __init__(
+        self, params: Any, cfg, max_len: int = 1024,
+        min_prefill_s: float = 0.0,
+    ):
         import jax
 
         self.params = params
@@ -276,6 +936,15 @@ class LMPrefillBackend:
         self._jax = jax
         self._fns: Dict[int, Any] = {}
         self.slabs_built = 0
+        #: per-request device-time floor (seconds). 0 in production.
+        #: The bench's handoff-ladder phase sets it so fan-out and
+        #: stream-overlap measurements exercise the handoff
+        #: ORCHESTRATION against a stable simulated device time —
+        #: on the in-process shared-core CPU sim one XLA prefill
+        #: already saturates the host, so raw peer compute cannot
+        #: scale there no matter what the orchestration does (same
+        #: declared-stub discipline as chaos/request bench backends).
+        self.min_prefill_s = float(min_prefill_s)
 
     def _prefill_fn(self, bucket: int):
         fn = self._fns.get(bucket)
@@ -300,6 +969,7 @@ class LMPrefillBackend:
 
         from .lm_server import _bucket
 
+        t0 = time.monotonic()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         tp = int(prompt.size)
         if tp == 0:
@@ -326,6 +996,13 @@ class LMPrefillBackend:
                 sl = [slice(None)] * a.ndim
                 sl[t_axis] = slice(0, tp)
                 rows[name][key] = np.ascontiguousarray(a[tuple(sl)])
+        if self.min_prefill_s > 0:
+            # thread context (to_thread / slabs_bytes): a plain sleep
+            # pads this request to the declared floor without holding
+            # the event loop
+            left = self.min_prefill_s - (time.monotonic() - t0)
+            if left > 0:
+                time.sleep(left)
         return {
             "prompt_len": tp,
             "budget": int(budget),
@@ -343,6 +1020,37 @@ class LMPrefillBackend:
         self.slabs_built += len(entries)
         _M_PREFILL_SLABS.inc(len(entries))
         return kv_slab_to_bytes(entries)
+
+    async def stream_slabs(
+        self,
+        prompts: Sequence[Sequence[int]],
+        budgets: Sequence[int],
+        feed,
+    ) -> None:
+        """Chunk-streamed serving form: prefill each prompt IN TURN
+        and push its framed slab onto the live feed the moment it is
+        built — the decode side adopts request i while request i+1's
+        prefill is still computing (transfer overlaps compute; the
+        whole-slab form serializes them). A per-request failure frames
+        an error entry (decode falls back locally for that request);
+        the feed closes at the end either way."""
+        try:
+            for i, (p, b) in enumerate(zip(prompts, budgets)):
+                try:
+                    entry = await asyncio.to_thread(
+                        self.prefill_one, np.asarray(p, np.int32), int(b)
+                    )
+                    blob = kv_slab_to_bytes([entry])
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    await push_slab_error(feed, i, repr(e))
+                    continue
+                await push_slab_entry(feed, i, blob)
+                self.slabs_built += 1
+                _M_PREFILL_SLABS.inc()
+        finally:
+            feed.close()
 
 
 # ----------------------------------------------------------------------
@@ -402,21 +1110,36 @@ def sharded_lm_group_backend(
 
 
 class DisaggLMBackend:
-    """Decode-role group backend with the prefill offloaded: ship the
-    batch's prompt token ids to a live prefill-role member, pull the
-    serialized KV slab back over the data plane, adopt it into the
+    """Decode-role group backend with the prefill offloaded: scatter
+    the batch's prompt token ids across EVERY live prefill-role
+    member (multi-prefill fan-out), pull each peer's serialized KV
+    slabs back over the data plane, adopt them into the
     (weight-resident sharded) decode server, stream tokens through
     the normal completion path.
 
-    Fallback discipline: any handoff failure — no live prefill peer,
-    RPC timeout, tunnel fault on the slab pull, truncated slab,
-    prompts too large for a control-plane frame — falls back to LOCAL
-    prefill on the decode engine and is counted
-    (``jobs_kv_handoff_total{result="fallback"}``). Greedy outputs
-    are identical either way, so the fallback changes throughput
+    Two handoff forms:
+
+    - ``handoff="stream"`` (default): each peer ACKs a live
+      data-plane stream token IMMEDIATELY and pushes per-request slab
+      chunks as its prefills complete (`LMPrefillBackend.stream_slabs`
+      -> `iter_slab_stream`); the decode primary adopts each request
+      into a free slot the moment ITS chunks land — transfer overlaps
+      prefill compute and the first decoded token leaves before the
+      last prefill chunk is even computed.
+    - ``handoff="slab"``: the PR-6 whole-slab pull (one blob per peer
+      after its whole share prefilled), kept as the bench's measured
+      comparison baseline.
+
+    Fallback discipline is PER REQUEST: a dead/straggling peer, a
+    tunnel fault mid-stream, a garbled chunk, a truncated slab, or a
+    failed adoption demotes exactly the affected requests to LOCAL
+    prefill on the decode engine
+    (``jobs_kv_handoff_total{result="fallback"}`` per request; adopted
+    requests tick ``result="ok"``). Greedy outputs are identical
+    either way, so ANY handoff failure changes throughput
     attribution, never answers."""
 
-    #: prompts whose combined token count exceeds this ride the local
+    #: shares whose combined token count exceeds this ride the local
     #: path: the UDP control frame caps at ~60 KB and the ids travel
     #: as JSON ints
     MAX_FRAME_TOKENS = 8_000
@@ -433,7 +1156,11 @@ class DisaggLMBackend:
         alive_fn: Optional[Callable[[], Set[str]]] = None,
         capacity: Optional[float] = None,
         prefill_timeout: float = 30.0,
+        handoff: str = "stream",
+        fanout: int = 0,
     ):
+        if handoff not in ("stream", "slab"):
+            raise ValueError(f"unknown handoff form {handoff!r}")
         self.be = be
         self.model = model_name
         self.group_name = group_name
@@ -445,42 +1172,39 @@ class DisaggLMBackend:
             capacity if capacity is not None else max(len(members), 1)
         )
         self.prefill_timeout = float(prefill_timeout)
+        self.handoff = handoff
+        #: max prefill peers a batch scatters across; 0 = all alive
+        self.fanout = int(fanout)
         self._roles = node.spec.group_roles_unique(group_name)
-        self.handoffs = 0
+        self.handoffs = 0  # requests adopted from a peer slab
         self.handoff_bytes = 0
-        self.fallbacks = 0
+        self.fallbacks = 0  # requests locally prefilled instead
+        self.last_ttft_s: Optional[float] = None
         self.lm_backend = be
 
-    def _prefill_peer(self):
-        """First alive prefill-role member that is not this node."""
+    def _prefill_peers(self) -> List[Any]:
+        """Alive prefill-role members (not this node), deterministic
+        order, capped at `fanout` when set."""
         alive = self.alive_fn() if self.alive_fn is not None else set()
         me = self.node.me.unique_name
-        for u in sorted(self._roles):
-            if (
-                self._roles[u] == "prefill"
-                and u != me
-                and u in alive
-            ):
-                return self.node.spec.node_by_unique_name(u)
-        return None
+        peers = [
+            self.node.spec.node_by_unique_name(u)
+            for u in sorted(self._roles)
+            if self._roles[u] == "prefill" and u != me and u in alive
+        ]
+        if self.fanout > 0:
+            peers = peers[: self.fanout]
+        return peers
 
-    async def _fetch_slabs(
-        self, model: str, prompts: List[np.ndarray], budgets: List[int]
-    ) -> Optional[List[Dict[str, Any]]]:
-        from ..cluster.store_service import data_addr
+    async def _prefill_rpc(
+        self, peer, model: str, prompts: List[np.ndarray],
+        budgets: List[int], stream: bool,
+    ) -> Dict[str, Any]:
+        """LM_PREFILL_REQUEST with one retry (at-most-once UDP): a
+        single dropped frame costs half the window, not all of it;
+        a duplicate just mints another token/stream the TTL reaps."""
         from ..cluster.wire import MsgType
 
-        peer = self._prefill_peer()
-        if peer is None:
-            return None
-        if sum(int(p.size) for p in prompts) > self.MAX_FRAME_TOKENS:
-            return None
-        t0 = time.monotonic()
-        # the request is one at-most-once UDP datagram: retry once
-        # with a half-budget per-attempt timeout so a single dropped
-        # frame costs half the window, not all of it (slab builds are
-        # per-request; a duplicate just mints another token the TTL
-        # reaps)
         reply = None
         for _ in range(2):
             try:
@@ -490,6 +1214,7 @@ class DisaggLMBackend:
                         "model": model,
                         "prompts": [[int(t) for t in p] for p in prompts],
                         "budgets": [int(b) for b in budgets],
+                        "stream": bool(stream),
                     },
                     timeout=self.prefill_timeout / 2,
                 )
@@ -503,6 +1228,28 @@ class DisaggLMBackend:
             )
         if not reply.get("ok"):
             raise RuntimeError(f"prefill peer: {reply.get('error')}")
+        return reply
+
+    async def _fetch_slabs(
+        self, model: str, prompts: List[np.ndarray], budgets: List[int],
+        peer=None,
+    ) -> Optional[List[Dict[str, Any]]]:
+        """Whole-slab pull of one peer's share (``handoff="slab"``).
+        Returns the share's slab entries, or None when no peer is
+        available/eligible."""
+        from ..cluster.store_service import data_addr
+
+        if peer is None:
+            peers = self._prefill_peers()
+            peer = peers[0] if peers else None
+        if peer is None:
+            return None
+        if sum(int(p.size) for p in prompts) > self.MAX_FRAME_TOKENS:
+            return None
+        t0 = time.monotonic()
+        reply = await self._prefill_rpc(
+            peer, model, prompts, budgets, stream=False
+        )
         data = await self.store.data_plane.fetch_token_bytes(
             data_addr(peer), reply["token"],
             timeout=self.prefill_timeout,
@@ -518,7 +1265,126 @@ class DisaggLMBackend:
         self.handoff_bytes += len(data)
         return slabs
 
-    async def __call__(self, model: str, paths: List[str]):
+    def _shares(
+        self, n: int, n_peers: int
+    ) -> List[List[int]]:
+        """Contiguous near-equal index shares, one per peer — request
+        order within a share is prompt order, so a peer's stream
+        adopts in the order the decode grid wants them."""
+        if n_peers <= 0:
+            return []
+        base, extra = divmod(n, n_peers)
+        shares: List[List[int]] = []
+        start = 0
+        for j in range(n_peers):
+            size = base + (1 if j < extra else 0)
+            shares.append(list(range(start, start + size)))
+            start += size
+        return shares
+
+    async def _pull_share_stream(
+        self, peer, model: str, idxs: List[int],
+        prompts: List[np.ndarray], budgets: List[int], arrivals,
+    ) -> None:
+        """One peer's streamed share: RPC for the stream token, then
+        reassemble per-request entries as their chunks land, handing
+        each to the decode thread's arrival queue. ANY failure demotes
+        the share's REMAINING requests to local prefill — requests
+        already handed over stay adopted."""
+        from ..cluster.store_service import data_addr
+
+        t0 = time.monotonic()
+        delivered: Set[int] = set()
+        try:
+            if sum(int(prompts[i].size) for i in idxs) \
+                    > self.MAX_FRAME_TOKENS:
+                raise ValueError("share exceeds control-frame budget")
+            reply = await self._prefill_rpc(
+                peer, model,
+                [prompts[i] for i in idxs],
+                [budgets[i] for i in idxs],
+                stream=True,
+            )
+            if not reply.get("stream"):
+                # old-form peer: its token is a whole-slab file —
+                # treat as a one-shot arrival of the whole share
+                data = await self.store.data_plane.fetch_token_bytes(
+                    data_addr(peer), reply["token"],
+                    timeout=self.prefill_timeout,
+                )
+                slabs = kv_slab_from_bytes(data)
+                if len(slabs) != len(idxs):
+                    raise ValueError("slab count mismatch")
+                _M_HANDOFF_BYTES.inc(len(data))
+                self.handoff_bytes += len(data)
+                for i, entry in zip(idxs, slabs):
+                    arrivals.put_nowait((i, entry))
+                    delivered.add(i)
+                return
+            chunks = self.store.data_plane.fetch_stream(
+                data_addr(peer), reply["token"],
+                timeout=self.prefill_timeout,
+            )
+            async for local_i, entry in iter_slab_stream(
+                _counting(chunks, lambda n: _note_bytes(self, n))
+            ):
+                if not (0 <= local_i < len(idxs)):
+                    raise ValueError(
+                        f"peer streamed unknown index {local_i}"
+                    )
+                gi = idxs[local_i]
+                arrivals.put_nowait((gi, entry))
+                delivered.add(gi)
+            if len(delivered) != len(idxs):
+                raise ValueError(
+                    f"stream ended after {len(delivered)}/{len(idxs)} "
+                    "entries"
+                )
+            _M_HANDOFF_T.observe(time.monotonic() - t0)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.warning(
+                "%s: streamed KV handoff from %s failed (%r); local "
+                "prefill for its %d remaining request(s)",
+                self.group_name, peer, e, len(idxs) - len(delivered),
+            )
+            for i in idxs:
+                if i not in delivered:
+                    arrivals.put_nowait((i, None))
+
+    async def _pull_share_slab(
+        self, peer, model: str, idxs: List[int],
+        prompts: List[np.ndarray], budgets: List[int], arrivals,
+    ) -> None:
+        """One peer's whole-slab share (the comparison form)."""
+        try:
+            slabs = await self._fetch_slabs(
+                model,
+                [prompts[i] for i in idxs],
+                [budgets[i] for i in idxs],
+                peer=peer,
+            )
+            if slabs is None:
+                raise RuntimeError("no eligible peer/share")
+            for i, entry in zip(idxs, slabs):
+                arrivals.put_nowait((i, entry))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.warning(
+                "%s: KV handoff from %s failed (%r); local prefill "
+                "for its %d request(s)",
+                self.group_name, peer, e, len(idxs),
+            )
+            for i in idxs:
+                arrivals.put_nowait((i, None))
+
+    async def __call__(
+        self, model: str, paths: List[str], on_token=None
+    ):
+        import queue as _queue
+
         from .lm_backend import parse_prompt_file
 
         _member_check(self.group_name, self.members, self.alive_fn)
@@ -538,49 +1404,57 @@ class DisaggLMBackend:
                     f"{budget} exceeds the server's max_len "
                     f"{self.be.server.max_len}"
                 )
-        slabs = None
-        try:
-            slabs = await self._fetch_slabs(model, prompts, budgets)
-        except asyncio.CancelledError:
-            raise
-        except Exception as e:
-            log.warning(
-                "%s: KV handoff failed (%r); falling back to local "
-                "prefill", self.group_name, e,
+        peers = self._prefill_peers()
+        arrivals: "_queue.Queue" = _queue.Queue()
+        tasks: List[asyncio.Task] = []
+        t_batch0 = time.monotonic()
+        if not peers:
+            # no live prefill peer at all: every request is a typed
+            # local fallback
+            for i in range(len(prompts)):
+                arrivals.put_nowait((i, None))
+        else:
+            shares = self._shares(len(prompts), len(peers))
+            pull = (
+                self._pull_share_stream if self.handoff == "stream"
+                else self._pull_share_slab
             )
+            for peer, idxs in zip(peers, shares):
+                if not idxs:
+                    continue
+                tasks.append(asyncio.ensure_future(pull(
+                    peer, model, idxs, prompts, budgets, arrivals
+                )))
         _member_check(self.group_name, self.members, self.alive_fn)
-        results = None
-        if slabs is not None:
-            # adoption can still fail AFTER a clean pull (e.g. a peer
-            # running a drifted lm_spec ships rows whose shapes don't
-            # fit this server) — that too is a failed handoff, not a
-            # batch failure: fall back and count it, or the batch
-            # would requeue-loop against the same bad peer while the
-            # ok-handoff counter inflated
-            try:
-                toks, infer_time = await asyncio.to_thread(
-                    self.be.serve_prefilled, prompts, budgets, slabs
-                )
-                results = {
-                    p: {"tokens": [int(t) for t in ts]}
-                    for p, ts in zip(paths, toks)
-                }
-                cost = self.be.cost_constants()
-                self.handoffs += 1
-                _M_HANDOFF.inc(result="ok")
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:
-                log.warning(
-                    "%s: slab adoption failed (%r); falling back to "
-                    "local prefill", self.group_name, e,
-                )
-        if results is None:
-            self.fallbacks += 1
-            _M_HANDOFF.inc(result="fallback")
-            results, infer_time, cost = await asyncio.to_thread(
-                self.be.serve_files, list(paths)
+        ttft_box: List[float] = []
+
+        def on_first() -> None:
+            ttft_box.append(time.monotonic() - t_batch0)
+
+        try:
+            toks, infer_time, stats = await asyncio.to_thread(
+                self.be.serve_prefilled_stream,
+                prompts, budgets, arrivals,
+                self.be._token_cbs(paths, on_token),
+                on_first,
+                max(self.prefill_timeout * 2, 30.0),
             )
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+        self.last_ttft_s = ttft_box[0] if ttft_box else None
+        self.handoffs += stats["adopted"]
+        self.fallbacks += stats["local"]
+        if stats["adopted"]:
+            _M_HANDOFF.inc(stats["adopted"], result="ok")
+        if stats["local"]:
+            _M_HANDOFF.inc(stats["local"], result="fallback")
+        results = {
+            p: {"tokens": [int(t) for t in ts]}
+            for p, ts in zip(paths, toks)
+        }
+        cost = self.be.cost_constants()
         _member_check(self.group_name, self.members, self.alive_fn)
         _M_SHARDED_BATCHES.inc(group=self.group_name, mode="disagg")
         _M_SHARDED_TOKENS.inc(
@@ -590,26 +1464,82 @@ class DisaggLMBackend:
         return results, infer_time, cost
 
 
+def _counting(chunks, note):
+    """Wrap an async chunk iterator, reporting each chunk's size."""
+    async def it():
+        async for c in chunks:
+            note(len(c))
+            yield c
+
+    return it()
+
+
+def _note_bytes(gb: "DisaggLMBackend", n: int) -> None:
+    gb.handoff_bytes += n
+    _M_HANDOFF_BYTES.inc(n)
+
+
+def check_hbm_budget(
+    g, lm_spec: Dict[str, Any], pp: Optional[int] = None
+) -> Optional[Dict[str, Any]]:
+    """Enforce ``WorkerGroupSpec.hbm_bytes`` against the model's
+    weight layout: a pp group passes when each member's slice
+    (`pp_hbm_report.per_member_bytes`) fits; a non-pp group must fit
+    the FULL tree per member (weight-resident tp shards storage too,
+    but the gather form and degradation-to-single-chip both
+    materialize the full tree, so the budget is the honest bound).
+    ``pp`` overrides the spec's declared axis with the RESOLVED mesh
+    size — a spec axis of -1 (fill remaining devices) must be checked
+    against what it resolved to, not clamped to non-pp. Returns the
+    report, or None when no budget is declared. Raising HERE turns
+    first-batch OOM into a startup config error."""
+    budget = int(getattr(g, "hbm_bytes", 0) or 0)
+    if budget <= 0:
+        return None
+    if pp is None:
+        pp = max(int(g.mesh.pp), 1)
+    rep = pp_hbm_report(lm_spec, pp)
+    need = rep["per_member_bytes"] if pp > 1 else rep["full_bytes"]
+    if need > budget:
+        hint = (
+            "" if pp > 1 else
+            " — a model bigger than one member's HBM needs a pp axis "
+            "on the group mesh (pipeline-parallel serving)"
+        )
+        raise RuntimeError(
+            f"group {g.name}: model {lm_spec.get('name')!r} needs "
+            f"{need} bytes per member, hbm_bytes budget is "
+            f"{budget}{hint}"
+        )
+    return rep
+
+
 def wire_lm_group(node, store, lm_spec: Dict[str, Any]):
     """Production wiring for a NodeApp registering `lm_spec`: returns
     ``(group_backend, prefill_backend)`` for this node's role in a
     worker group that declares the model in ``lm_models`` — the LM
     analog of `jobs.groups.wire_group_backend`.
 
-    - group PRIMARY: a weight-resident sharded decode engine over the
-      group mesh; when any OTHER member carries the ``prefill`` role,
-      the disaggregated form (prefill handoff + local fallback);
+    - group PRIMARY: a sharded decode engine over the group mesh —
+      PIPELINE-parallel when the group mesh has a ``pp`` axis > 1
+      (each member holds only its layer-stack slice; models deeper
+      than one member's HBM), else weight-resident tp-sharded; when
+      any OTHER member carries the ``prefill`` role, the
+      disaggregated form (multi-peer streamed prefill handoff +
+      per-request local fallback; ``lm_spec["kv_handoff"]`` picks
+      "stream" (default) or "slab", ``lm_spec["prefill_fanout"]``
+      caps the peer fan-out, 0 = all alive);
     - prefill-role members: an `LMPrefillBackend` (serves
-      LM_PREFILL_REQUEST);
+      LM_PREFILL_REQUEST, whole-slab and streamed forms);
     - everyone else (lenders without a role, ungrouped nodes):
       ``(None, None)`` — they serve single-chip like before.
 
     Raises at startup if the group mesh wants more devices than this
     host sees (a group that silently served single-chip while the
     pool weighted it at group capacity would be slower than no
-    groups at all — same contract as `group_engine_backend`)."""
-    from .lm_backend import lm_spec_parts
-
+    groups at all — same contract as `group_engine_backend`), or if
+    the model's per-member weight bytes exceed a declared
+    ``hbm_bytes`` budget (`check_hbm_budget`)."""
     spec = node.spec
     uname = node.me.unique_name
     g = spec.group_of_unique(uname)
@@ -624,10 +1554,25 @@ def wire_lm_group(node, store, lm_spec: Dict[str, Any]):
 
     prefill = None
     if roles.get(uname) == "prefill":
-        params, cfg = lm_spec_parts(lm_spec)
-        prefill = LMPrefillBackend(
-            params, cfg, max_len=int(lm_spec.get("max_len", 1024))
-        )
+        if int(g.mesh.pp) == 1:
+            # the prefill backend materializes the FULL tree, so the
+            # budget gate must hold it to the full-tree bound
+            check_hbm_budget(g, lm_spec, pp=1)
+            params, cfg = lm_spec_parts_cached(lm_spec)
+            prefill = LMPrefillBackend(
+                params, cfg, max_len=int(lm_spec.get("max_len", 1024))
+            )
+        else:
+            # a pp group's primary never sends LM_PREFILL_REQUEST (the
+            # pipelined engine owns its own prefill schedule), so
+            # building the full-tree prefill backend here would hold
+            # weights the declared budget says don't fit — and never
+            # serve a single slab (tp x pp x disagg composition is the
+            # real-ICI remainder, ROADMAP item 3)
+            log.warning(
+                "%s: prefill role on %s ignored — the pp>1 serving "
+                "form does not disaggregate", g.name, uname,
+            )
     gb = None
     if members and uname == members[0]:
         import jax
@@ -647,24 +1592,44 @@ def wire_lm_group(node, store, lm_spec: Dict[str, Any]):
                 )
             devices = devices[:want]
         mesh = make_mesh(g.mesh, devices=devices)
-        be = sharded_lm_backend(lm_spec, mesh, form="resident")
-        cap = float(
-            mesh.shape.get("dp", 1) * mesh.shape.get("tp", 1)
-        )
+        pp = int(mesh.shape.get("pp", 1))
+        # budget-check against the RESOLVED pp: a spec axis of -1
+        # (fill remaining) may have resolved to a pipelined layout
+        # that fits where the full tree would not
+        check_hbm_budget(g, lm_spec, pp=pp)
         disagg = any(
             r == "prefill" for u, r in roles.items() if u != uname
         )
-        if disagg:
-            gb = DisaggLMBackend(
-                be, model_name=name, group_name=g.name, node=node,
-                store=store, members=members, alive_fn=alive,
-                capacity=cap,
+        if pp > 1:
+            # pipeline-parallel primary: the layer stack shards over
+            # pp; prefill disaggregation composes at the BATCH level
+            # only (the pp engine owns its own pipelined prefill), so
+            # role-split pp groups serve the pp form directly
+            be_pp = PipelinedLMBackend(lm_spec, mesh)
+            cap = float(pp * mesh.shape.get("dp", 1))
+            gb = sharded_lm_group_backend(
+                be_pp, model_name=name, group_name=g.name,
+                members=members, alive_fn=alive, capacity=cap,
+                mode="pp",
             )
         else:
-            gb = sharded_lm_group_backend(
-                be, model_name=name, group_name=g.name,
-                members=members, alive_fn=alive, capacity=cap,
+            be = sharded_lm_backend(lm_spec, mesh, form="resident")
+            cap = float(
+                mesh.shape.get("dp", 1) * mesh.shape.get("tp", 1)
             )
+            if disagg:
+                gb = DisaggLMBackend(
+                    be, model_name=name, group_name=g.name, node=node,
+                    store=store, members=members, alive_fn=alive,
+                    capacity=cap,
+                    handoff=str(lm_spec.get("kv_handoff", "stream")),
+                    fanout=int(lm_spec.get("prefill_fanout", 0) or 0),
+                )
+            else:
+                gb = sharded_lm_group_backend(
+                    be, model_name=name, group_name=g.name,
+                    members=members, alive_fn=alive, capacity=cap,
+                )
     return gb, prefill
 
 
@@ -680,32 +1645,32 @@ def bench_lm_sharded_serving(
     n_prompts: int = 16,
     new_tokens: int = 16,
     base_port: int = 28961,
-    steady_s: float = 5.0,
+    steady_s: float = 4.0,
     tmp: str = "/tmp/dml_tpu_bench_lm_sharded",
 ) -> Dict[str, Any]:
-    """Weight-resident sharded LM decode vs per-forward param_gather
-    vs prefill/decode disaggregation, all through the FULL cluster
-    pipeline on the same dp=1×tp=2 group (H3 decode primary, H4
-    prefill role), plus a member-kill-mid-decode chaos case.
+    """Sharded LM serving forms through the FULL cluster pipeline on
+    one topology (H3 decode primary, H4+H5 prefill roles):
 
-    4-node topology ON PURPOSE: leader + standby + the two-member
-    group means the formed group is the pool's ONLY slot, so every
-    timed batch flows through the group engine and the three mode
-    rates compare serving forms — not a mode-vs-whichever-single-chip
-    -worker-ran-concurrently mix (a 5th node's concurrent single-chip
-    batches perturbed the partitioned programs enough on shared CPU
-    cores to invert the comparison).
+    - param_gather vs weight-resident tp=2 (PR 6's comparison),
+    - PIPELINE-parallel pp=2 (layer stack split across members —
+      models deeper than one member's HBM; `pp_hbm_report` records
+      the budget story),
+    - disaggregated prefill/decode with the handoff ladder: whole-
+      slab pull vs chunk-STREAMED handoff (time-to-first-token must
+      strictly drop — decode adopts request 0 while request N still
+      prefills), and 1- vs 2-prefill-peer FAN-OUT on a prefill-heavy
+      workload (context-phase throughput must rise),
+    - a member-kill-MID-STREAM chaos case: the dying peer's in-flight
+      share demotes to typed per-request local-prefill fallbacks,
+      the job completes exactly once, tokens unchanged.
 
-    What transfers to a pod is (a) the token-equality contract —
-    every mode's merged job outputs are asserted EQUAL to isolated
-    `generate()` per prompt (f32, greedy), the dryrun tp-decode
-    contract carried end-to-end through the cluster; (b) the handoff
-    machinery (slab bytes > 0, exactly-once under degradation). The
-    tok/s ratios on shared-core CPU devices are an honest lower
-    bound, not the ICI story: what the resident form removes is a
-    full weight-tree all-gather per dispatch (the model is sized so
-    the gathered form's doubled per-chip compute dominates even
-    here)."""
+    5-node topology: leader + standby + the three-member group means
+    the formed group is the pool's ONLY slot, so every timed batch
+    flows through the group engine and mode rates compare serving
+    forms. What transfers to a pod is the token-equality contract
+    (every mode's merged outputs == isolated generate(), f32 greedy)
+    and the handoff/exactly-once machinery; tok/s and overlap ratios
+    on shared-core CPU devices are an honest lower bound."""
     import os
     import shutil
 
@@ -731,31 +1696,46 @@ def bench_lm_sharded_serving(
     # compute dominates its skipped partitioning overhead even on the
     # shared-core CPU mesh (at d64 the overhead wins and the
     # comparison would read backwards); small enough to compile in
-    # seconds per form
+    # seconds per form. n_layers 4 so the pp=2 pipeline splits the
+    # stack evenly (2 blocks per stage).
     lm_spec = {
         "name": "ShardLM", "vocab_size": 128, "d_model": 384,
-        "n_heads": 4, "n_kv_heads": 2, "n_layers": 3, "d_ff": 1536,
+        "n_heads": 4, "n_kv_heads": 2, "n_layers": 4, "d_ff": 1536,
         "dtype": "float32", "max_new_tokens": new_tokens,
         "max_slots": 4, "max_len": 128, "seed": 0, "chunk": 8,
     }
     params, cfg = lm_spec_parts(lm_spec)
     mesh = make_mesh(MeshSpec(dp=1, tp=2), devices=devices[:2])
-    # the three group-engine forms share one tp-sharded tree; the
-    # single-chip reference backend and the prefill worker use the
+    mesh_pp = make_mesh(
+        MeshSpec(dp=1, tp=1, pp=2), devices=devices[:2]
+    )
+    # the group-engine forms share one deterministic tree; the
+    # single-chip reference backend and the prefill workers use the
     # plain (single-device) placement of the SAME tree
     be_resident = sharded_lm_backend(lm_spec, mesh, form="resident")
     be_gather = sharded_lm_backend(lm_spec, mesh, form="gather")
     be_disagg = sharded_lm_backend(lm_spec, mesh, form="resident")
+    be_pp = PipelinedLMBackend(lm_spec, mesh_pp)
     be_single = LMBackend(
         params, cfg, max_new_tokens=new_tokens,
         max_slots=int(lm_spec["max_slots"]),
         max_len=int(lm_spec["max_len"]), chunk=int(lm_spec["chunk"]),
     )
-    prefill_be = LMPrefillBackend(params, cfg, max_len=lm_spec["max_len"])
+    # one prefill backend PER prefill-role node, so the fan-out phase
+    # can assert both peers actually built slabs
+    prefill_bes = {
+        "H4": LMPrefillBackend(params, cfg, max_len=lm_spec["max_len"]),
+        "H5": LMPrefillBackend(params, cfg, max_len=lm_spec["max_len"]),
+    }
+    # per-member HBM story: the pp split is what fits a member whose
+    # budget sits between its layer slice and the full tree
+    hbm = pp_hbm_report(lm_spec, 2)
+    hbm_budget = (hbm["per_member_bytes"] + hbm["full_bytes"]) // 2
     group = WorkerGroupSpec(
-        "tp0", ("H3", "H4"), MeshSpec(dp=1, tp=2),
+        "pd0", ("H3", "H4", "H5"), MeshSpec(dp=1, tp=2),
         lm_models=("ShardLM",),
-        roles={"H3": "decode", "H4": "prefill"},
+        roles={"H3": "decode", "H4": "prefill", "H5": "prefill"},
+        hbm_bytes=hbm_budget,
     )
     model = "ShardLM"
 
@@ -773,27 +1753,41 @@ def bench_lm_sharded_serving(
             members = node.spec.group_members_unique(group.name)
             is_primary = bool(members) and uname == members[0]
             if is_primary:
+                def disagg(handoff, fanout):
+                    return DisaggLMBackend(
+                        be_disagg, model_name=model,
+                        group_name=group.name, node=node, store=store,
+                        members=members, alive_fn=alive, capacity=3.0,
+                        prefill_timeout=8.0, handoff=handoff,
+                        fanout=fanout,
+                    )
+
                 # mode-swapped during the run via set_mode below
                 js._lm_group_modes = {
                     "resident": sharded_lm_group_backend(
                         be_resident, model_name=model,
                         group_name=group.name, members=members,
-                        alive_fn=alive, capacity=2.0, mode="resident",
+                        alive_fn=alive, capacity=3.0, mode="resident",
                     ),
                     "gather": sharded_lm_group_backend(
                         be_gather, model_name=model,
                         group_name=group.name, members=members,
-                        alive_fn=alive, capacity=2.0, mode="gather",
+                        alive_fn=alive, capacity=3.0, mode="gather",
                     ),
-                    "disagg": DisaggLMBackend(
-                        be_disagg, model_name=model,
-                        group_name=group.name, node=node, store=store,
-                        members=members, alive_fn=alive, capacity=2.0,
+                    "pp": sharded_lm_group_backend(
+                        be_pp, model_name=model,
+                        group_name=group.name, members=members,
+                        alive_fn=alive, capacity=3.0, mode="pp",
                     ),
+                    "disagg": disagg("stream", 0),
+                    "disagg_stream_f1": disagg("stream", 1),
+                    "disagg_stream_f2": disagg("stream", 2),
+                    "disagg_slab_f1": disagg("slab", 1),
                 }
+            pf = prefill_bes.get(node.me.name)
             js.register_lm(
                 model, backend=be_single.backend, cost=be_single.cost(),
-                prefill=prefill_be,
+                prefill=pf,
                 group_backend=(
                     js._lm_group_modes["resident"] if is_primary
                     else None
@@ -803,7 +1797,7 @@ def bench_lm_sharded_serving(
             return js
 
         cluster = LocalCluster(
-            4, tmp, base_port,
+            5, tmp, base_port,
             timing=Timing(ping_interval=0.2, ack_timeout=0.3,
                           cleanup_time=1.0, leader_rpc_timeout=10.0),
             worker_groups=[group],
@@ -815,7 +1809,7 @@ def bench_lm_sharded_serving(
                 cluster.converged, 20.0, "lm-sharded bench convergence"
             )
             members = cluster.spec.group_members_unique(group.name)
-            # the chaos phase kills the lender: the client driving
+            # the chaos phase kills a prefill peer: the client driving
             # submit/wait/get-output must be NEITHER group member (a
             # dead client wedges its own wait_job forever) nor the
             # leader (client() excludes it)
@@ -834,24 +1828,50 @@ def bench_lm_sharded_serving(
                     jnp.asarray(np.asarray(prompt, np.int32)[None]),
                     new_tokens,
                 ))[0]]
+            # prefill-heavy files for the handoff-comparison phase:
+            # long prompts, tiny budgets — the wall IS context phase.
+            # LOCAL files only (never store-put): the steady-mode jobs
+            # wrap-sample every matching store object, and mixing
+            # budget-4 files into them would corrupt the tok/s
+            # accounting above
+            ctx_budget = 4
+            ctx_files = []
+            ctx_prompt_toks = 0
+            for i in range(6):
+                prompt = rng.randint(0, cfg.vocab_size,
+                                     int(rng.randint(48, 64)))
+                fname = f"ctx_{i}.tokens.txt"
+                p = os.path.join(tmp, fname)
+                write_prompt_file(p, prompt, max_new_tokens=ctx_budget)
+                ctx_files.append(fname)
+                ctx_prompt_toks += int(prompt.size)
+                reference[fname] = [int(t) for t in np.asarray(generate(
+                    params, cfg,
+                    jnp.asarray(np.asarray(prompt, np.int32)[None]),
+                    ctx_budget,
+                ))[0]]
 
             primary_js = services[members[0]]
 
             def set_mode(mode: str) -> Any:
                 gb = primary_js._lm_group_modes[mode]
+                pf = prefill_bes.get(
+                    cluster.spec.node_by_unique_name(members[0]).name
+                )
                 primary_js.register_lm(
                     model, backend=be_single.backend,
-                    cost=be_single.cost(), prefill=prefill_be,
+                    cost=be_single.cost(), prefill=pf,
                     group_backend=gb,
                 )
                 return gb
 
-            async def timed_job() -> Tuple[float, Dict[str, Any]]:
+            async def timed_job(n=None) -> Tuple[float, Dict[str, Any]]:
+                n = n if n is not None else n_prompts
                 t0 = time.monotonic()
-                job_id = await client.jobs.submit_job(model, n_prompts)
+                job_id = await client.jobs.submit_job(model, n)
                 done = await client.jobs.wait_job(job_id, timeout=600.0)
                 wall = time.monotonic() - t0
-                assert done["total_queries"] == n_prompts, done
+                assert done["total_queries"] == n, done
                 merged = await client.jobs.get_output(
                     job_id, os.path.join(tmp, f"out_{job_id}.json")
                 )
@@ -865,7 +1885,7 @@ def bench_lm_sharded_serving(
 
             modes_out: Dict[str, Any] = {}
             all_equal = True
-            for mode in ("gather", "resident", "disagg"):
+            for mode in ("gather", "resident", "pp", "disagg"):
                 gb = set_mode(mode)
                 # warm the compiles outside the timed window
                 _, merged = await timed_job()
@@ -879,8 +1899,8 @@ def bench_lm_sharded_serving(
                     _, merged = await timed_job()
                     all_equal = all_equal and check_equal(merged)
                     # n_prompts queries per job, each decoding the
-                    # shared default budget (no per-file directives
-                    # seeded here)
+                    # shared default budget (the ctx_* files carry
+                    # directives but this phase samples prompt_*)
                     tokens += n_prompts * new_tokens
                     jobs += 1
                 wall = time.monotonic() - t0
@@ -896,10 +1916,102 @@ def bench_lm_sharded_serving(
                     entry["handoff_bytes"] = gb.handoff_bytes
                 modes_out[mode] = entry
 
+            # ---- handoff ladder: whole-slab vs chunk-streamed, and
+            # 1- vs 2-peer fan-out, on the prefill-heavy files. The
+            # scheduler wrap-samples the WHOLE store set, so these
+            # jobs submit exactly len(ctx_files) queries after
+            # clearing the prompt_* files from sampling via explicit
+            # n = multiple of the file count — instead we drive the
+            # group backend DIRECTLY with the ctx paths: same engine,
+            # no sampling ambiguity, per-job ttft from the backend.
+            ctx_paths = [os.path.join(tmp, f) for f in ctx_files]
+
+            async def handoff_trial(mode: str) -> Dict[str, Any]:
+                gb = set_mode(mode)
+                pf_counts0 = {
+                    n: pf.slabs_built for n, pf in prefill_bes.items()
+                }
+                results, _, _ = await gb(model, ctx_paths)  # warm
+                assert all(
+                    results[p]["tokens"]
+                    == reference[os.path.basename(p)]
+                    for p in ctx_paths
+                )
+                walls, ttfts = [], []
+                for _ in range(3):
+                    t0 = time.monotonic()
+                    results, _, _ = await gb(model, ctx_paths)
+                    walls.append(time.monotonic() - t0)
+                    if gb.last_ttft_s is not None:
+                        ttfts.append(gb.last_ttft_s)
+                    ok = all(
+                        results[p]["tokens"]
+                        == reference[os.path.basename(p)]
+                        for p in ctx_paths
+                    )
+                    if not ok:
+                        return {"error": "outputs diverged"}
+                med_wall = sorted(walls)[len(walls) // 2]
+                med_ttft = (
+                    sorted(ttfts)[len(ttfts) // 2] if ttfts else None
+                )
+                return {
+                    "wall_s": round(med_wall, 3),
+                    "ttft_ms": (
+                        round(med_ttft * 1000, 1)
+                        if med_ttft is not None else None
+                    ),
+                    "ctx_tok_s": round(ctx_prompt_toks / med_wall, 1),
+                    "handoffs": gb.handoffs,
+                    "fallbacks": gb.fallbacks,
+                    "peer_slabs": {
+                        n: pf.slabs_built - pf_counts0[n]
+                        for n, pf in prefill_bes.items()
+                    },
+                }
+
+            # declared per-request prefill device floor for the
+            # ladder (and the chaos case below): the in-process sim
+            # shares 2 host cores between every "peer", so raw peer
+            # COMPUTE cannot scale with fan-out here no matter what
+            # the orchestration does — the floor (same declared-stub
+            # discipline as the chaos/request stub backends) makes
+            # the ladder measure what the handoff machinery actually
+            # controls: per-request overlap of transfer, adoption,
+            # and peer device time. Token equality still runs the
+            # real engine end-to-end.
+            prefill_floor_s = 0.12
+            for pf in prefill_bes.values():
+                pf.min_prefill_s = prefill_floor_s
+            handoff = {
+                "prompt_tokens_per_job": ctx_prompt_toks,
+                "budget_per_prompt": ctx_budget,
+                "simulated_prefill_floor_s": prefill_floor_s,
+                "slab_f1": await handoff_trial("disagg_slab_f1"),
+                "stream_f1": await handoff_trial("disagg_stream_f1"),
+                "stream_f2": await handoff_trial("disagg_stream_f2"),
+            }
+            s1, s2 = handoff["stream_f1"], handoff["stream_f2"]
+            sl = handoff["slab_f1"]
+            if sl.get("ttft_ms") and s1.get("ttft_ms"):
+                handoff["ttft_stream_ms"] = s1["ttft_ms"]
+                handoff["ttft_slab_ms"] = sl["ttft_ms"]
+                handoff["stream_vs_slab_ttft"] = round(
+                    sl["ttft_ms"] / max(s1["ttft_ms"], 1e-9), 2
+                )
+                handoff["stream_vs_slab_wall"] = round(
+                    sl["wall_s"] / max(s1["wall_s"], 1e-9), 2
+                )
+            if s1.get("ctx_tok_s") and s2.get("ctx_tok_s"):
+                handoff["fanout_ctx_speedup"] = round(
+                    s2["ctx_tok_s"] / max(s1["ctx_tok_s"], 1e-9), 2
+                )
+
             # single-chip comparison rate on the SAME topology:
-            # grouping disabled, the two members serve as individual
+            # grouping disabled, the members serve as individual
             # chips (context for the mode rates; also re-checks
             # equality through the ungrouped path)
+            set_mode("resident")
             for js in services.values():
                 js.groups.enabled = False
             _, merged = await timed_job()  # warm the ungrouped route
@@ -915,27 +2027,30 @@ def bench_lm_sharded_serving(
             for js in services.values():
                 js.groups.enabled = True
 
-            # ---- member-kill-mid-decode chaos: exactly-once tokens,
-            # degradation to single chips, reform on return. The
-            # degradation ledger lives on the LEADER (its scheduling
-            # loop drives the collapse; the primary's own directory
-            # only refreshes on demand).
-            set_mode("resident")
+            # ---- member-kill-MID-STREAM chaos: a prefill peer dies
+            # while its streamed share is in flight. The affected
+            # requests demote to typed local-prefill fallbacks
+            # (jobs_kv_handoff_total{result=fallback}), the group
+            # degrades on SWIM detection, the job completes exactly
+            # once with tokens unchanged, and the group re-forms when
+            # the peer returns.
+            gb_chaos = set_mode("disagg_stream_f2")
             leader_js = services[cluster.leader_uname()]
-            batches_before = _value_of("lm_sharded_batches_total")
-            lender = cluster.resolve_target(group.members[-1])
+            fallbacks_before = gb_chaos.fallbacks
+            bytes_before = gb_chaos.handoff_bytes
+            victim = cluster.resolve_target("H5")
             chaos_n = 4 * n_prompts
             job_id = await client.jobs.submit_job(model, chaos_n)
-            # wait until the group engine is actually mid-decode
-            for _ in range(200):
-                if _value_of("lm_sharded_batches_total") > batches_before:
+            # kill while slab bytes are actively flowing (mid-stream,
+            # not between batches)
+            for _ in range(400):
+                if gb_chaos.handoff_bytes > bytes_before:
                     break
-                await asyncio.sleep(0.05)
-            await cluster.crash_node(lender)
+                await asyncio.sleep(0.02)
+            await cluster.crash_node(victim)
             # the degradation edge arrives with SWIM detection (~1-2s
-            # at this timing); wait for it so "degrades to
-            # single-chip serving" is an observed fact, not a race
-            # against a fast job
+            # at this timing); wait for it so "degrades" is an
+            # observed fact, not a race against a fast job
             try:
                 await cluster.wait_for(
                     lambda: leader_js.groups.degradations.get(
@@ -951,7 +2066,8 @@ def bench_lm_sharded_serving(
             chaos_equal = check_equal(merged)
             gstats = leader_js.group_stats().get(group.name, {})
             degraded = gstats.get("degradations", 0) >= 1
-            await cluster.restart_node(lender)
+            fallback_ticks = gb_chaos.fallbacks - fallbacks_before
+            await cluster.restart_node(victim)
 
             def reformed() -> bool:
                 st = leader_js.group_stats().get(group.name, {})
@@ -963,15 +2079,24 @@ def bench_lm_sharded_serving(
             except Exception:
                 did_reform = False
             chaos = {
-                "member_killed": group.members[-1],
+                "member_killed": "H5 (prefill role, mid-stream)",
                 "completed": done["total_queries"] == chaos_n,
                 "exactly_once_tokens": chaos_equal,
+                "typed_fallbacks": fallback_ticks,
                 "degraded": degraded,
                 "reformed": did_reform,
+                # green = completed exactly once with unchanged
+                # tokens AND the kill was actually felt (per-request
+                # fallback or a degradation edge — whichever side of
+                # the SWIM race the kill landed on)
+                "verdict_green": bool(
+                    done["total_queries"] == chaos_n and chaos_equal
+                    and (fallback_ticks > 0 or degraded)
+                ),
             }
 
             return {
-                "nodes": 4,
+                "nodes": 5,
                 "prompts_per_job": n_prompts,
                 "new_tokens_per_prompt": new_tokens,
                 "model_cfg": {
@@ -985,13 +2110,27 @@ def bench_lm_sharded_serving(
                             cluster.spec.group_members_unique(group.name)
                         ),
                         "mesh": {"dp": 1, "tp": 2},
+                        "pp_mesh": {"dp": 1, "tp": 1, "pp": 2},
                         "lm_models": list(group.lm_models),
                         "roles": dict(group.roles),
                     }
                 },
+                "hbm": {
+                    **hbm,
+                    "budget_bytes": hbm_budget,
+                    # the acceptance story: the full tree does NOT
+                    # fit the configured member budget; the pp slice
+                    # does — only the pipelined layout serves
+                    "fits_only_pipelined": bool(
+                        hbm["per_member_bytes"] <= hbm_budget
+                        < hbm["full_bytes"]
+                    ),
+                },
                 "modes": modes_out,
+                "handoff": handoff,
                 "tok_s_param_gather": modes_out["gather"]["tok_s"],
                 "tok_s_resident": modes_out["resident"]["tok_s"],
+                "tok_s_pp": modes_out["pp"]["tok_s"],
                 "tok_s_disagg": modes_out["disagg"]["tok_s"],
                 "tok_s_single_chip": tok_s_single,
                 "resident_vs_gather": round(
@@ -1000,15 +2139,17 @@ def bench_lm_sharded_serving(
                 ),
                 "tokens_equal_single_chip": bool(all_equal and chaos_equal),
                 "kv_handoff_bytes": modes_out["disagg"]["handoff_bytes"],
+                "ttft_stream_ms": handoff.get("ttft_stream_ms"),
+                "stream_vs_slab_ttft": handoff.get("stream_vs_slab_ttft"),
+                "fanout_ctx_speedup": handoff.get("fanout_ctx_speedup"),
                 "chaos": chaos,
                 "note": "virtual CPU mesh: the equality flag (every "
                         "mode's merged outputs == isolated generate() "
                         "per prompt, f32 greedy) and the handoff/"
                         "exactly-once machinery are the product "
-                        "claims; tok/s ratios on shared-core CPU "
-                        "devices are an honest lower bound on what "
-                        "removing a per-dispatch weight all-gather "
-                        "buys over ICI",
+                        "claims; tok/s and overlap ratios on shared-"
+                        "core CPU devices are an honest lower bound "
+                        "on the ICI story",
             }
         finally:
             await cluster.stop()

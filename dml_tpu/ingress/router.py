@@ -638,6 +638,7 @@ class RequestRouter:
                 job_id, fb.model, files,
                 requester=self._me, affinity=fb.affinity,
                 streams=streams or None,
+                slo_class=fb.slo.name,
             )
         except Exception as e:
             log.exception("%s: ingress dispatch of %d reqs failed",

@@ -160,6 +160,40 @@ def fair_split_weighted(
     return (i, j)
 
 
+def class_split(
+    n_slots: int,
+    cost: ModelCost,
+    weight_a: float,
+    weight_b: float,
+) -> Tuple[int, int]:
+    """Split `n_slots` free workers between TWO SLO classes of one
+    model in proportion to their weights, through the SAME fair-split
+    enumeration the dual-model scheduler uses: each class presents the
+    model's cost with its exec time scaled BY its weight. Since
+    ``query_rate ∝ capacity / exec``, equalizing the scaled rates
+    allocates capacity ∝ weight — interactive at weight 3 vs batch at
+    1 converges to a 3:1 slot share, with fair_split's granularity
+    handling (each class gets at least one slot when n >= 2) for
+    free."""
+    if n_slots <= 0:
+        return (0, 0)
+    wa = max(float(weight_a), 1e-9)
+    wb = max(float(weight_b), 1e-9)
+
+    def scaled(w: float) -> ModelCost:
+        return replace(
+            cost,
+            first_query=cost.first_query * w,
+            per_query=cost.per_query * w,
+            download_time=cost.download_time * w,
+            load_time=cost.load_time * w,
+        )
+
+    return fair_split_weighted(
+        [1.0] * n_slots, scaled(wa), scaled(wb)
+    )
+
+
 def fair_split_weighted_directed(
     weights: Sequence[float], cost_a: ModelCost, cost_b: ModelCost
 ) -> Tuple[int, int, bool]:

@@ -333,7 +333,8 @@ class GroupDirectory:
             out[g.name] = {
                 "members": list(mem),
                 "primary": mem[0] if mem else None,
-                "mesh": {"dp": g.mesh.dp, "tp": g.mesh.tp},
+                "mesh": {"dp": g.mesh.dp, "tp": g.mesh.tp,
+                         "pp": g.mesh.pp},
                 "lm_models": list(g.lm_models),
                 "roles": self.spec.group_roles_unique(g.name),
                 "formed": bool(self._formed_last.get(g.name)),

@@ -41,7 +41,12 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..observability import METRICS
-from .cost_model import ModelCost, fair_split_weighted_directed, query_rate
+from .cost_model import (
+    ModelCost,
+    class_split,
+    fair_split_weighted_directed,
+    query_rate,
+)
 
 # Coordinator metrics: the registry form of the reference's C1/C2
 # console (see observability.py's C1-C5 map). The exact-sample
@@ -431,6 +436,12 @@ class Batch:
     # store objects per formed batch, and nothing ever get-output's an
     # ingress job. Oversized results fall back to the store path.
     inline_results: bool = False
+    # SLO class of the requests this batch formed from (ingress;
+    # formed batches are single-class by construction). None =
+    # operator-submitted work. Classes sharing one model queue get
+    # WEIGHTED fair shares of its free workers (`class_weights` /
+    # `_take_batches`) instead of one FIFO.
+    slo_class: Optional[str] = None
 
     @property
     def key(self) -> Tuple[int, int]:
@@ -516,6 +527,20 @@ class Scheduler:
         # requeues observed (worker death + live-worker batch failure)
         # — the recovery evidence the failure-injection bench records
         self.requeue_count = 0
+        # Per-class WEIGHTED fair share inside each model queue: when
+        # batches of different SLO classes share a queue, free workers
+        # split between the classes in weight proportion (class_split,
+        # built on the dual-model fair_split_weighted enumeration)
+        # instead of strict FIFO — sustained batch-class load can no
+        # longer queue interactive requests behind its whole backlog.
+        # Unknown/None classes weigh 1.0; set to {} to restore FIFO.
+        self.class_weights: Dict[str, float] = {
+            "interactive": 3.0, "batch": 1.0,
+        }
+        # model -> class -> batches granted (the cross-round deficit
+        # memory that keeps single-slot rounds from starving the
+        # light-weight class); reset when the model's queue drains
+        self._class_served: Dict[str, Dict[Optional[str], int]] = {}
         # metrics (reference worker.py:485-495, 1000-1001); bounded
         # deques so a long-lived coordinator doesn't grow forever
         self.max_samples = 10_000
@@ -600,6 +625,7 @@ class Scheduler:
         affinity: Optional[str] = None,
         streams: Optional[Dict[str, List[Any]]] = None,
         inline_results: bool = False,
+        slo_class: Optional[str] = None,
     ) -> JobState:
         """Wrap-around sample `n_queries` inputs from `files`, slice
         into batches of the model's current batch size, queue them.
@@ -638,6 +664,7 @@ class Scheduler:
                         if f in chunk
                     },
                     inline_results=inline_results,
+                    slo_class=slo_class,
                 )
             )
         q = self._queue(model)
@@ -693,6 +720,11 @@ class Scheduler:
         if self.prefetch and len(staged_models | queued_models) > 1:
             self._unstage_all()
         active = self.active_models()
+        # drained models drop their class-deficit memory: a later mix
+        # starts fresh instead of replaying an old imbalance as a burst
+        for m in list(self._class_served):
+            if m not in active:
+                del self._class_served[m]
         if not active or not workers:
             return []
         workers = list(workers)
@@ -722,6 +754,110 @@ class Scheduler:
     def _free_workers(self, workers: Sequence[str]) -> List[str]:
         return [w for w in workers if w not in self.in_progress]
 
+    def _take_batches(self, model: str, k: int) -> List[Batch]:
+        """Pop up to `k` batches of `model` for this round — FIFO when
+        the queue is single-class (or `class_weights` is empty),
+        otherwise a WEIGHTED split of the k slots between the queued
+        SLO classes:
+
+        - two classes (the DEFAULT_CLASSES shape): `class_split`, the
+          dual-model fair_split_weighted enumeration with each class
+          presenting the model's cost scaled by its weight — slots
+          land in weight proportion;
+        - more: proportional stride over cumulative weighted grants.
+
+        Slots a class cannot fill redistribute; a cross-round deficit
+        memory (`_class_served`, reset when the queue drains) hands a
+        zero-slot class its overdue slot, so k=1 rounds cannot starve
+        the light class. FIFO order is preserved WITHIN each class —
+        the split changes who goes next, never reorders a class's own
+        work."""
+        q = self._queue(model)
+        n = min(k, len(q))
+        if n <= 0:
+            return []
+        order: List[Optional[str]] = []
+        per_class: Dict[Optional[str], int] = {}
+        for b in q:
+            if b.slo_class not in per_class:
+                order.append(b.slo_class)
+            per_class[b.slo_class] = per_class.get(b.slo_class, 0) + 1
+        if not self.class_weights or len(order) == 1:
+            return [q.popleft() for _ in range(n)]
+        order.sort(key=str)  # deterministic, not arrival-dependent
+        w = {
+            c: max(float(self.class_weights.get(c or "", 1.0)), 1e-9)
+            for c in order
+        }
+        served = self._class_served.setdefault(model, {})
+        counts: Dict[Optional[str], int]
+        if len(order) == 2:
+            cost = self.costs.get(model, ModelCost(0, 0, 0.001))
+            c1, c2 = order
+            n1, n2 = class_split(n, cost, w[c1], w[c2])
+            counts = {c1: n1, c2: n2}
+        else:
+            counts = {c: 0 for c in order}
+            for _ in range(n):
+                pick = min(order, key=lambda c: (
+                    (served.get(c, 0) + counts[c]) / w[c], str(c)
+                ))
+                counts[pick] += 1
+        # cap by availability, redistribute the leftovers
+        spare = 0
+        for c in order:
+            if counts[c] > per_class[c]:
+                spare += counts[c] - per_class[c]
+                counts[c] = per_class[c]
+        while spare > 0:
+            grantable = [c for c in order if counts[c] < per_class[c]]
+            if not grantable:
+                break
+            pick = min(grantable, key=lambda c: (
+                (served.get(c, 0) + counts[c]) / w[c], str(c)
+            ))
+            counts[pick] += 1
+            spare -= 1
+        # deficit correction: a class with work but zero slots takes
+        # one from the most-ahead donor once its weighted grant count
+        # trails by a full slot (otherwise k=1 rounds always go to the
+        # heavy class and the light one starves forever)
+        for c in order:
+            if counts[c] == 0 and per_class[c] > 0:
+                donors = [d for d in order if counts[d] > 0]
+                if not donors:
+                    continue
+                d = max(donors, key=lambda d: (
+                    (served.get(d, 0) + counts[d] - 1) / w[d], str(d)
+                ))
+                if (served.get(c, 0) + 1) / w[c] <= (
+                    served.get(d, 0) + counts[d] - 1
+                ) / w[d] + 1e-9:
+                    counts[d] -= 1
+                    counts[c] += 1
+        # single O(n) pass: partition the queue into granted batches
+        # (per-class quota, FIFO within class) and the rebuilt
+        # remainder — deque.remove per grant would rescan the whole
+        # queue per slot, quadratic in exactly the deep-backlog
+        # regime the class weighting exists for
+        out: List[Batch] = []
+        rest: List[Batch] = []
+        taken = {c: 0 for c in order}
+        want = sum(counts.values())
+        for b in q:
+            if (len(out) < want
+                    and taken.get(b.slo_class, 0)
+                    < counts.get(b.slo_class, 0)):
+                out.append(b)
+                taken[b.slo_class] = taken.get(b.slo_class, 0) + 1
+            else:
+                rest.append(b)
+        q.clear()
+        q.extend(rest)
+        for b in out:
+            served[b.slo_class] = served.get(b.slo_class, 0) + 1
+        return out
+
     def _assign_free(self, model: str, workers: Sequence[str]) -> List[Assignment]:
         """Single-model case (worker.py:257-300): pour the queue onto
         every free worker. Batches carrying a session-affinity target
@@ -749,20 +885,19 @@ class Scheduler:
                     )
                     free_set.discard(batch.affinity)
             free = [w for w in free if w in free_set]
-        for w in free:
-            if not q:
-                break
-            batch = q.popleft()
+        for w, batch in zip(free, self._take_batches(model, len(free))):
             self.in_progress[w] = batch
             out.append(Assignment(worker=w, batch=batch))
         if self.pipeline_depth > 1:
-            for w in workers:
-                if not q:
-                    break
-                if w in self.in_progress and w not in self.prefetch:
-                    batch = q.popleft()
-                    self.prefetch[w] = batch
-                    out.append(Assignment(worker=w, batch=batch, staged=True))
+            stageable = [
+                w for w in workers
+                if w in self.in_progress and w not in self.prefetch
+            ]
+            for w, batch in zip(
+                stageable, self._take_batches(model, len(stageable))
+            ):
+                self.prefetch[w] = batch
+                out.append(Assignment(worker=w, batch=batch, staged=True))
         return out
 
     def _schedule_two(
@@ -816,11 +951,15 @@ class Scheduler:
         have = sum(
             1 for w, b in self.in_progress.items() if b.model == model and w in workers
         )
-        # free workers first
-        for w in self._free_workers(workers):
-            if have >= want or not q:
-                break
-            batch = q.popleft()
+        # free workers first. The draw goes through _take_batches so
+        # the per-class weighted split applies in dual-model rounds
+        # too (an unclassed/single-class queue reduces to the exact
+        # popleft order) — one model's queue being all batch-class
+        # must not starve the other class just because a second model
+        # is active.
+        free = self._free_workers(workers)
+        take = min(len(free), max(0, want - have), len(q))
+        for w, batch in zip(free, self._take_batches(model, take)):
             self.in_progress[w] = batch
             out.append(Assignment(worker=w, batch=batch))
             have += 1
@@ -833,15 +972,13 @@ class Scheduler:
             ]
             n_victims = len(victims)
             surplus = victims[: max(0, n_victims - (len(workers) - want))]
-            for w in surplus:
-                if have >= want or not q:
-                    break
+            take = min(len(surplus), max(0, want - have), len(q))
+            for w, batch in zip(surplus, self._take_batches(model, take)):
                 # (no stage handling here: schedule() un-stages every
                 # prefetch batch before a dual-model round can run)
                 displaced = self.in_progress[w]
                 self._queue(displaced.model).appendleft(displaced)
                 _M_PREEMPTIONS.inc()
-                batch = q.popleft()
                 self.in_progress[w] = batch
                 out.append(Assignment(worker=w, batch=batch, preempted=displaced))
                 have += 1
@@ -1138,6 +1275,7 @@ class Scheduler:
                 "failures": b.failures,
                 "affinity": b.affinity,
                 "streams": {f: list(v) for f, v in b.streams.items()},
+                "slo_class": b.slo_class,
             }
 
         queues: Dict[str, List[Dict[str, Any]]] = {

@@ -1056,6 +1056,7 @@ class JobService:
         requester: str,
         affinity: Optional[str] = None,
         streams: Optional[Dict[str, List[Any]]] = None,
+        slo_class: Optional[str] = None,
     ) -> Any:
         """Leader-side direct intake for the request front door
         (dml_tpu/ingress/router.py): a batch the router FORMED from
@@ -1081,7 +1082,7 @@ class JobService:
         st = self.scheduler.submit_job(
             job_id, model, list(files), len(files), requester, replicas,
             batch_size=len(files), affinity=affinity, streams=streams,
-            inline_results=True,
+            inline_results=True, slo_class=slo_class,
         )
         self._relay_submit(
             job_id,
@@ -1089,7 +1090,7 @@ class JobService:
              "files": list(files), "batch_size": len(files),
              "requester": requester, "gen": self._relay_gen,
              "affinity": affinity, "streams": streams or {},
-             "inline": True},
+             "inline": True, "slo": slo_class},
         )
         self._run_schedule()
         return st
@@ -1203,8 +1204,16 @@ class JobService:
     async def _h_lm_prefill(self, msg: Message, addr) -> None:
         """Prefill-role worker side of disaggregated LM serving: a
         decode primary sent a batch's prompt token ids; run the
-        chunked prefill (LMPrefillBackend), expose the serialized
-        KV-cache slab on the data plane, and ACK with the pull token.
+        chunked prefill (LMPrefillBackend) and hand the slabs back
+        over the data plane. Two forms:
+
+        - ``stream: true`` (the chunk-streamed handoff): ACK a LIVE
+          stream token IMMEDIATELY, then push each request's framed
+          slab chunks as its prefill completes — the decode side
+          adopts early requests while later ones still compute.
+        - default: the whole-slab file token (PR-6 form, kept as the
+          bench's comparison baseline and for old-form callers).
+
         The prefill runs as a background task — blocking the receive
         loop on a device forward would stall SWIM heartbeats into
         false suspicion (same discipline as the shadow-restore
@@ -1223,6 +1232,36 @@ class JobService:
             return
         prompts = d.get("prompts") or []
         budgets = d.get("budgets") or []
+        if d.get("stream") and hasattr(pf, "stream_slabs"):
+            dp = self.store.data_plane
+            # small buffer bound: the slab producer pushes via the
+            # backpressured put(), so this caps in-flight memory per
+            # handoff instead of buffering a whole share's slabs
+            token, feed = dp.expose_stream(maxsize=64)
+
+            async def serve_stream() -> None:
+                try:
+                    await pf.stream_slabs(prompts, budgets, feed)
+                finally:
+                    # unexpose the moment the puller drains to EOF;
+                    # the TTL only bounds leakage when the puller
+                    # died mid-handoff and never comes back
+                    deadline = time.monotonic() + 120.0
+                    while (not feed.drained()
+                           and time.monotonic() < deadline):
+                        await asyncio.sleep(0.5)
+                    dp.unexpose_stream(token)
+
+            self._spawn_bg(
+                serve_stream(),
+                f"lm prefill stream {model} x{len(prompts)}",
+            )
+            self.node.send_unique(
+                msg.sender, MsgType.LM_PREFILL_ACK,
+                {"rid": rid, "ok": True, "token": token,
+                 "stream": True, "n": len(prompts)},
+            )
+            return
         self._spawn_bg(
             self._serve_prefill(pf, prompts, budgets, msg.sender, rid),
             f"lm prefill {model} x{len(prompts)}",
@@ -1519,6 +1558,7 @@ class JobService:
             affinity=d.get("affinity"),
             streams=d.get("streams") or None,
             inline_results=bool(d.get("inline")),
+            slo_class=d.get("slo"),
         )
 
     async def _h_ack_relay(self, msg: Message, addr) -> None:

@@ -46,6 +46,24 @@ except ImportError:  # pragma: no cover
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
+def shard_map_nocheck(f, *, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across the jax API
+    rename (>= 0.7 calls the kwarg ``check_vma``; 0.4.x calls it
+    ``check_rep``). The checker rejects the masked psum-collect
+    pattern both this module and the pipelined LM serving form
+    (inference/lm_sharded.py) use, so it is off in both."""
+    try:
+        return shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
 def stack_stage_params(per_stage: Sequence[Any]) -> Any:
     """Stack S per-stage param pytrees along a new leading axis
     (shard it over `pp` with `stage_sharding`)."""
@@ -125,7 +143,7 @@ def pipeline_apply(
     # compose); otherwise replicate (identical redundant compute)
     dp = mesh.shape.get("dp", 1)
     x_spec = P(None, "dp") if dp > 1 and mb % dp == 0 else P()
-    ym = shard_map(
+    ym = shard_map_nocheck(
         per_device,
         mesh=mesh,
         in_specs=(
@@ -133,6 +151,5 @@ def pipeline_apply(
             x_spec,  # stage 0 injects its dp-row's microbatch slice
         ),
         out_specs=x_spec,
-        check_vma=False,
     )(stacked_params, xm)
     return ym.reshape(b, *x.shape[1:])

@@ -676,6 +676,9 @@ def run_sharded_check(artifact_path: Optional[str] = None) -> List[str]:
 #: first round whose bench carries the sharded-LM serving section
 #: (weight-resident / param_gather / disaggregated on one group)
 LM_SHARDED_REQUIRED_FROM_ROUND = 8
+#: first round whose bench carries the pipeline-parallel serving form
+#: and the chunk-streamed multi-prefill KV handoff ladder
+LM_PP_STREAM_REQUIRED_FROM_ROUND = 10
 
 
 def check_lm_sharded_block(path: str) -> List[str]:
@@ -693,8 +696,25 @@ def check_lm_sharded_block(path: str) -> List[str]:
       plane (a zero here with handoffs recorded means the bench
       measured the fallback path and labeled it disaggregation).
 
+    From round ``LM_PP_STREAM_REQUIRED_FROM_ROUND`` additionally:
+
+    - ``tok_s_pp`` finite and positive (the pipeline-parallel form
+      served) with ``hbm.fits_only_pipelined`` True — the recorded
+      budget story must actually be "full tree does not fit a
+      member, the pp slice does";
+    - ``ttft_stream_ms`` finite/positive and
+      ``stream_vs_slab_ttft`` > 1 — the chunk-streamed handoff must
+      STRICTLY reduce time-to-first-token vs the whole-slab pull on
+      the same seed (that overlap is the entire point of streaming);
+    - ``fanout_ctx_speedup`` > 1 — two prefill peers must raise
+      context-phase throughput over one;
+    - the member-kill-mid-stream ``chaos.verdict_green`` is True
+      (completed exactly once, tokens unchanged, the kill actually
+      felt as typed fallbacks or a degradation edge).
+
     Artifacts before round 8 are exempt; summary-only driver captures
-    gate on the compact line's ``lm_sharded_equal`` flag."""
+    gate on the compact line's ``lm_sharded_equal`` flag (and the
+    round-10 ``lm_pp_toks`` / ``lm_stream_vs_slab`` keys)."""
     from .parity_table import load_bench
 
     name = os.path.basename(path)
@@ -704,15 +724,39 @@ def check_lm_sharded_block(path: str) -> List[str]:
     data = load_bench(path)
     if data.get("_summary_only"):
         s = data.get("summary") or {}
+        problems = []
         if (
             s.get("lm_sharded_toks") is not None
             and s.get("lm_sharded_equal") is False
         ):
-            return [
+            problems.append(
                 f"{name}: summary lm_sharded_equal is false — group-"
                 "sharded LM outputs diverged from isolated generate()"
-            ]
-        return []
+            )
+        if (
+            rnd is not None
+            and rnd >= LM_PP_STREAM_REQUIRED_FROM_ROUND
+            and s.get("lm_sharded_toks") is not None
+        ):
+            v = s.get("lm_pp_toks")
+            if v is not None and (
+                not isinstance(v, (int, float))
+                or not math.isfinite(v) or v <= 0
+            ):
+                problems.append(
+                    f"{name}: summary lm_pp_toks = {v!r} (nonfinite "
+                    "or zero — the pipeline-parallel form never ran?)"
+                )
+            r = s.get("lm_stream_vs_slab")
+            if r is not None and (
+                not isinstance(r, (int, float)) or not r > 1.0
+            ):
+                problems.append(
+                    f"{name}: summary lm_stream_vs_slab = {r!r} — the "
+                    "chunk-streamed handoff must strictly reduce TTFT "
+                    "vs the whole-slab pull"
+                )
+        return problems
     matrix = data.get("matrix", {})
     not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
     if "cluster_lm_sharded" in not_run:
@@ -766,6 +810,53 @@ def check_lm_sharded_block(path: str) -> List[str]:
             f"{name}: cluster_lm_sharded.groups does not echo the "
             "group topology (members + dp/tp mesh per group)"
         )
+    if rnd is not None and rnd >= LM_PP_STREAM_REQUIRED_FROM_ROUND:
+        pp_v = block.get("tok_s_pp")
+        if not isinstance(pp_v, (int, float)) or not math.isfinite(pp_v) \
+                or pp_v <= 0:
+            problems.append(
+                f"{name}: cluster_lm_sharded.tok_s_pp = {pp_v!r} "
+                "(missing, nonfinite, or zero — the pipeline-parallel "
+                "form never served)"
+            )
+        hbm = block.get("hbm") or {}
+        if hbm.get("fits_only_pipelined") is not True:
+            problems.append(
+                f"{name}: cluster_lm_sharded.hbm.fits_only_pipelined "
+                f"= {hbm.get('fits_only_pipelined')!r} — the recorded "
+                "budget must sit between the pp slice and the full "
+                "tree (the models-bigger-than-one-member claim)"
+            )
+        ttft = block.get("ttft_stream_ms")
+        if not isinstance(ttft, (int, float)) or not math.isfinite(ttft) \
+                or ttft <= 0:
+            problems.append(
+                f"{name}: cluster_lm_sharded.ttft_stream_ms = {ttft!r} "
+                "(the streamed handoff never recorded a first token)"
+            )
+        ratio = block.get("stream_vs_slab_ttft")
+        if not isinstance(ratio, (int, float)) or not ratio > 1.0:
+            problems.append(
+                f"{name}: cluster_lm_sharded.stream_vs_slab_ttft = "
+                f"{ratio!r} — chunk-streamed handoff must strictly "
+                "reduce time-to-first-token vs the whole-slab pull"
+            )
+        fan = block.get("fanout_ctx_speedup")
+        if not isinstance(fan, (int, float)) or not fan > 1.0:
+            problems.append(
+                f"{name}: cluster_lm_sharded.fanout_ctx_speedup = "
+                f"{fan!r} — 2-peer prefill fan-out must raise "
+                "context-phase throughput over 1 peer"
+            )
+        chaos = block.get("chaos") or {}
+        if chaos.get("verdict_green") is not True:
+            problems.append(
+                f"{name}: cluster_lm_sharded.chaos.verdict_green = "
+                f"{chaos.get('verdict_green')!r} — the member-kill-"
+                "mid-stream case must complete exactly once with "
+                "unchanged tokens and a felt kill (typed fallbacks "
+                "or a degradation edge)"
+            )
     return problems
 
 
@@ -902,6 +993,24 @@ def check_request_block(path: str) -> List[str]:
             f"{name}: request_serving.failover completed 0 requests — "
             "the cluster never resumed serving after the leader kill"
         )
+    if rnd is not None and rnd >= LM_PP_STREAM_REQUIRED_FROM_ROUND:
+        # per-class weighted fair share inside the scheduler landed
+        # with round 10: the mixed-class rerun must show interactive
+        # p99 better under the weighted split than under one FIFO
+        cf = block.get("class_fair")
+        if not isinstance(cf, dict):
+            problems.append(
+                f"{name}: request_serving.class_fair missing — the "
+                "weighted-vs-FIFO mixed-class rerun never happened"
+            )
+        elif cf.get("interactive_p99_improved") is not True:
+            problems.append(
+                f"{name}: request_serving.class_fair."
+                "interactive_p99_improved = "
+                f"{cf.get('interactive_p99_improved')!r} — weighted "
+                "per-class shares must improve interactive p99 over "
+                "FIFO under the sustained mixed-class load"
+            )
     return problems
 
 

@@ -69,4 +69,5 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "sharded: tensor-parallel worker-group serving coverage (group topology, sharded-vs-single-chip output equality)")
     config.addinivalue_line("markers", "disagg: prefill/decode-disaggregated LM serving coverage (KV-slab handoff over the data plane, role-split groups)")
     config.addinivalue_line("markers", "ingress: request front-door coverage (SLO admission/shedding, continuous batch formation, open-loop load, token streaming)")
+    config.addinivalue_line("markers", "pp: pipeline-parallel LM serving coverage (layer-stack stage sharding over the pp mesh axis, microbatched stage handoff)")
 

@@ -235,3 +235,73 @@ async def test_nodeapp_lm_spec_serving(tmp_path, capsys):
         for app in reversed(apps):
             await app.stop()
         await dns.stop()
+
+
+# ----------------------------------------------------------------------
+# log-path hygiene (ISSUE 8 satellite: debug.log must never reappear)
+# ----------------------------------------------------------------------
+
+
+def test_default_log_path_never_working_directory(monkeypatch, tmp_path):
+    """`debug.log` materialized in the repo root twice (PR 7 removed
+    it, it came back) because `_setup_logging` defaulted to a RELATIVE
+    path — whatever directory a test/bench/operator shell happened to
+    start the process from. The default must be absolute, live under
+    the system tempdir in a PRIVATE owner-verified dir (no
+    predictable world-writable /tmp filename another user could
+    pre-plant, CWE-377), and carry a per-process name so concurrent
+    nodes don't interleave one file. `DML_TPU_LOG_FILE` is the
+    explicit override."""
+    import os
+    import stat
+    import tempfile
+
+    from dml_tpu.cli import default_log_path
+
+    monkeypatch.delenv("DML_TPU_LOG_FILE", raising=False)
+    p = default_log_path()
+    assert os.path.isabs(p)
+    assert os.path.commonpath([p, tempfile.gettempdir()]) == \
+        tempfile.gettempdir()
+    assert os.path.dirname(p) != os.getcwd()
+    assert os.path.basename(p) != "debug.log"
+    assert f"_{os.getpid()}" in os.path.basename(p)
+    d = os.path.dirname(p)
+    st = os.lstat(d)
+    assert stat.S_ISDIR(st.st_mode)
+    if hasattr(os, "geteuid"):
+        assert st.st_uid == os.geteuid()
+        assert stat.S_IMODE(st.st_mode) == 0o700
+    # explicit override wins, ~ expanded
+    override = tmp_path / "node.log"
+    monkeypatch.setenv("DML_TPU_LOG_FILE", str(override))
+    assert default_log_path() == str(override)
+
+
+async def test_cluster_sim_leaves_no_repo_root_artifacts(tmp_path):
+    """A DEFAULT cluster sim run (the chaos.LocalCluster bring-up
+    every chaos/bench/ingress path shares) must not litter the repo
+    root: no debug.log, no stray merged-output files, nothing. The
+    sweep is exhaustive over new entries rather than a denylist so the
+    NEXT litter bug fails here too."""
+    import os
+
+    import dml_tpu
+    from dml_tpu.cluster import chaos
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(dml_tpu.__file__)))
+    # pytest/tooling churn that is not product output
+    infra = {".pytest_cache", "__pycache__", ".hypothesis"}
+    before = set(os.listdir(repo_root)) | infra
+    c = chaos.LocalCluster(3, str(tmp_path / "sim"), 23980, seed=0)
+    try:
+        await c.start()
+        await c.wait_for(c.converged, 15.0, "initial convergence")
+        client = c.client()
+        await client.store.put_bytes(
+            "artifact_probe.jpeg", b"x" * 256, timeout=20.0)
+    finally:
+        await c.stop()
+    new = set(os.listdir(repo_root)) - before
+    assert not new, f"cluster sim littered the repo root: {sorted(new)}"

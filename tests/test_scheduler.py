@@ -384,3 +384,133 @@ def test_pipeline_c5_shows_staged_assignments():
     c5 = s.c5_assignments()
     assert c5["w1"]["model"] == "a"
     assert c5["w1 (staged)"]["staged"] is True
+
+
+# ------------------------------------------------- per-class fair share
+
+
+def _drain_classes(s, workers, rounds=64):
+    """Drive schedule rounds, completing every assignment each round;
+    returns the grant order as a list of slo_class values."""
+    grants = []
+    for _ in range(rounds):
+        out = s.schedule(workers)
+        if not out:
+            break
+        for a in out:
+            grants.append(a.batch.slo_class)
+        for a in list(out):
+            s.on_batch_done(a.worker, a.batch.job_id, a.batch.batch_id,
+                            0.01, len(a.batch.files))
+    return grants
+
+
+def test_class_weighted_fair_share_deterministic():
+    """Interactive/batch classes sharing one model queue split its
+    free workers 3:1 by weight (class_split over the fair_split
+    machinery) with FIFO preserved WITHIN each class — deterministic
+    grant sequence, no starvation even at one slot per round."""
+    s, _ = make()
+    # 12 interactive + 12 batch single-file jobs, interleaved arrival
+    job = 0
+    for i in range(12):
+        for cls in ("batch", "interactive"):
+            job += 1
+            s.submit_job(job, "a", [f"f{job}"], 1, "c",
+                         batch_size=1, slo_class=cls)
+    grants = _drain_classes(s, ["w1"])  # ONE slot per round
+    assert len(grants) == 24
+    # 3:1 weighted share: every window of 4 grants holds 3
+    # interactive + 1 batch until interactive runs dry
+    for i in range(0, 16, 4):
+        win = grants[i : i + 4]
+        assert win.count("interactive") == 3 and win.count("batch") == 1
+    # leftovers (batch only) still drain
+    assert grants[16:].count("batch") == 8
+    # determinism: identical setup => identical sequence
+    s2, _ = make()
+    job = 100
+    for i in range(12):
+        for cls in ("batch", "interactive"):
+            job += 1
+            s2.submit_job(job, "a", [f"g{job}"], 1, "c",
+                          batch_size=1, slo_class=cls)
+    assert _drain_classes(s2, ["w1"]) == grants
+
+
+def test_class_fifo_within_class_and_disable():
+    s, _ = make()
+    for j, cls in enumerate(
+        ["interactive", "interactive", "batch", "interactive", "batch"],
+        start=1,
+    ):
+        s.submit_job(j, "a", [f"f{j}"], 1, "c",
+                     batch_size=1, slo_class=cls)
+    out = s.schedule(["w1", "w2", "w3", "w4"])
+    # 4 slots over {3 interactive, 2 batch}: 3:1 by weight
+    got = [(a.batch.job_id, a.batch.slo_class) for a in out]
+    assert [j for j, c in got if c == "interactive"] == [1, 2, 4]
+    assert [j for j, c in got if c == "batch"] == [3]
+    # class_weights = {} restores strict FIFO
+    s2, _ = make()
+    s2.class_weights = {}
+    for j, cls in enumerate(
+        ["batch", "batch", "batch", "interactive"], start=1
+    ):
+        s2.submit_job(j, "a", [f"f{j}"], 1, "c",
+                      batch_size=1, slo_class=cls)
+    out2 = s2.schedule(["w1", "w2"])
+    assert [a.batch.job_id for a in out2] == [1, 2]
+
+
+def test_class_unclassed_batches_keep_reference_fifo():
+    """Operator jobs (slo_class None) are untouched by the class
+    machinery: a single-class queue pops in reference FIFO order."""
+    s, _ = make()
+    for j in range(1, 5):
+        s.submit_job(j, "a", [f"f{j}"], 1, "c", batch_size=1)
+    out = s.schedule(["w1", "w2"])
+    assert [a.batch.job_id for a in out] == [1, 2]
+
+
+def test_class_weighted_share_applies_in_dual_model_rounds():
+    """The weighted class split must hold when TWO models are active
+    (the normal mixed deployment: an image model plus the ingress LM
+    model) — `_grow_to` draws through `_take_batches`, so a sustained
+    batch-class backlog on one model's queue cannot starve that
+    model's interactive requests just because another model shares
+    the round."""
+    s, _ = make()
+    # model b keeps the dual-model path engaged; model a's queue is
+    # mixed-class with batch submitted first
+    for j in range(1, 9):
+        s.submit_job(j, "a", [f"f{j}"], 1, "c", batch_size=1,
+                     slo_class="batch")
+    for j in range(9, 13):
+        s.submit_job(j, "a", [f"f{j}"], 1, "c", batch_size=1,
+                     slo_class="interactive")
+    s.submit_job(20, "b", [f"g{n}" for n in range(40)], 40, "c")
+    out = s.schedule(["w1", "w2", "w3", "w4"])
+    a_grants = [a.batch.slo_class for a in out if a.batch.model == "a"]
+    assert a_grants, "model a got no workers in the dual-model round"
+    # strict FIFO would hand model a's slots to the batch backlog
+    # exclusively; the weighted split (3:1) must seat interactive
+    # work first despite its later arrival
+    assert a_grants.count("interactive") >= a_grants.count("batch")
+    assert "interactive" in a_grants
+
+
+def test_class_weights_cap_by_availability():
+    """A class granted more slots than it has queued work hands the
+    spares to the other class — slots never idle while work waits."""
+    s, _ = make()
+    s.submit_job(1, "a", ["x"], 1, "c", batch_size=1,
+                 slo_class="interactive")
+    for j in range(2, 8):
+        s.submit_job(j, "a", [f"f{j}"], 1, "c", batch_size=1,
+                     slo_class="batch")
+    out = s.schedule(["w1", "w2", "w3", "w4"])
+    assert len(out) == 4  # 1 interactive + 3 batch (redistributed)
+    classes = [a.batch.slo_class for a in out]
+    assert classes.count("interactive") == 1
+    assert classes.count("batch") == 3
