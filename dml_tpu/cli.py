@@ -13,6 +13,7 @@ Run:
     python -m dml_tpu node --spec /tmp/cluster.json --name H1
     python -m dml_tpu chaos run --seed 7 --soak   # seeded fault plan
     python -m dml_tpu chaos run --seed 1 --scenario fuzz  # one family
+    python -m dml_tpu lint                        # async-hazard/drift lint
 """
 
 from __future__ import annotations
@@ -615,6 +616,20 @@ def main(argv: Optional[List[str]] = None) -> None:
     ps.add_argument("-o", "--out", default="-", help="output path (default stdout)")
     ps.add_argument("--base-port", type=int, default=8001)
 
+    pl = sub.add_parser(
+        "lint",
+        help="run the project-native async-hazard & protocol-drift "
+             "analyzer (tools/dmllint.py); exit 0 clean / 1 findings "
+             "/ 2 internal error",
+    )
+    pl.add_argument("--root", default=None,
+                    help="tree to lint (default: this repo)")
+    pl.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: "
+                         "dml_tpu/tools/dmllint_baseline.json)")
+    pl.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+
     pc = sub.add_parser(
         "chaos",
         help="run a seeded chaos plan against an in-process cluster "
@@ -647,6 +662,17 @@ def main(argv: Optional[List[str]] = None) -> None:
     pc.add_argument("-v", "--verbose", action="store_true")
 
     args = p.parse_args(argv)
+    if args.command == "lint":
+        from .tools import dmllint
+
+        lint_argv = []
+        if args.root:
+            lint_argv += ["--root", args.root]
+        if args.baseline:
+            lint_argv += ["--baseline", args.baseline]
+        if args.json:
+            lint_argv.append("--json")
+        raise SystemExit(dmllint.main(lint_argv))
     if args.command == "localspec":
         spec = ClusterSpec.localhost(args.n, base_port=args.base_port)
         text = spec.to_json()

@@ -1412,11 +1412,12 @@ class ChaosRunner:
                             await client.store.data_plane.fetch_from_store(
                                 data_addr(nid), name
                             )
-                        except Exception:
+                        except Exception as e:
                             # a corrupt/missing copy: its replica has
                             # now detected + quarantined it, which is
                             # the point of the scrub
-                            pass
+                            log.debug("scrub pull of %s from %s: %r",
+                                      name, uname, e)
                 got = await client.store.get_bytes(name, timeout=15.0)
                 if blob is not None and got != blob:
                     raise AssertionError(

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import random
 import secrets
@@ -34,6 +35,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .local_store import CorruptionError, LocalStore
+
+log = logging.getLogger(__name__)
 
 _CHUNK = 1 << 16
 
@@ -221,8 +224,10 @@ class DataPlane:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:
-                pass
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                # peer vanished mid-close; the OS already reclaimed the
+                # socket — but say so instead of eating a real bug
+                log.debug("data-plane serve close: %r", e)
 
     async def _reply(self, writer, header: dict, payload: bytes = b"") -> None:
         writer.write(json.dumps(header).encode() + b"\n")
@@ -336,8 +341,8 @@ class DataPlane:
             writer.close()
             try:
                 await writer.wait_closed()
-            except Exception:
-                pass
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                log.debug("data-plane rpc close: %r", e)
 
     async def fetch_from_store(
         self,
@@ -437,8 +442,8 @@ class DataPlane:
             writer.close()
             try:
                 await writer.wait_closed()
-            except Exception:
-                pass
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                log.debug("data-plane stream close: %r", e)
 
     async def fetch_token_to_store(
         self,

@@ -44,7 +44,10 @@ class MsgType(enum.IntEnum):
     ALL_LOCAL_FILES = 20
     ALL_LOCAL_FILES_RELAY = 21
     PUT_REQUEST = 22
-    PUT_REQUEST_ACK = 23
+    # 23 reserved: PUT_REQUEST_ACK existed in the reference taxonomy
+    # but nothing here ever sent or awaited it (the leader replies
+    # PUT_REQUEST_SUCCESS/FAIL directly); dmllint's dead-member rule
+    # keeps such stubs from accreting again
     PUT_REQUEST_SUCCESS = 24
     PUT_REQUEST_FAIL = 25
     DOWNLOAD_FILE = 26
@@ -54,7 +57,8 @@ class MsgType(enum.IntEnum):
     GET_FILE_REQUEST_ACK = 30
     GET_FILE_REQUEST_FAIL = 31
     DELETE_FILE_REQUEST = 32
-    DELETE_FILE_REQUEST_ACK = 33
+    # 33 reserved: DELETE_FILE_REQUEST_ACK, dead for the same reason
+    # as 23 — the leader replies DELETE_FILE_REQUEST_SUCCESS/FAIL
     DELETE_FILE_REQUEST_SUCCESS = 34
     DELETE_FILE_REQUEST_FAIL = 35
     DELETE_FILE = 36
@@ -146,6 +150,112 @@ class MsgType(enum.IntEnum):
     # in-flight requests either complete or are explicitly rejected
     # across a failover, never silently lost
     INGRESS_RELAY = 96
+
+
+# ----------------------------------------------------------------------
+# handler-ownership registry (lint-enforced)
+# ----------------------------------------------------------------------
+#
+# Every MsgType member is claimed by exactly one of:
+#
+# - a service class name ("Node", "StoreService", "JobService",
+#   "RequestRouter"): that class — and only that class — registers an
+#   ``_h_*`` handler for the type via ``Node.register``;
+# - "IntroducerService": handled by the introducer's inline dispatch
+#   loop (it is not a cluster node and has no handler table);
+# - RID_FALLBACK: deliberately unregistered — the type is a reply
+#   whose ``rid`` resolves an awaiting request future through the
+#   dispatcher's fallback (see Node._dispatch), like SET_BATCH_SIZE_ACK.
+#
+# tools/dmllint.py (rule drift-wire-handlers) cross-checks this table
+# against the actual ``.register(MsgType.X, self._h_y)`` calls in the
+# tree on every tier-1 run: a new MsgType without an owner, a handler
+# registered by a class that doesn't own the type, a registered type
+# claimed as RID_FALLBACK, or a member no code references at all are
+# all findings. Keep this table in the same order as the enum.
+
+RID_FALLBACK = "rid-fallback"
+
+HANDLER_OWNERS: Dict["MsgType", str] = {
+    # membership / failure detection
+    MsgType.PING: "Node",
+    MsgType.ACK: "Node",
+    MsgType.INTRODUCE: "Node",
+    MsgType.INTRODUCE_ACK: RID_FALLBACK,
+    MsgType.FETCH_INTRODUCER: "IntroducerService",
+    MsgType.FETCH_INTRODUCER_ACK: RID_FALLBACK,
+    MsgType.UPDATE_INTRODUCER: "IntroducerService",
+    MsgType.UPDATE_INTRODUCER_ACK: RID_FALLBACK,
+    # election
+    MsgType.ELECTION: "Node",
+    MsgType.COORDINATE: "Node",
+    MsgType.COORDINATE_ACK: "Node",
+    # replicated store
+    MsgType.ALL_LOCAL_FILES: "StoreService",
+    MsgType.ALL_LOCAL_FILES_RELAY: "StoreService",
+    MsgType.PUT_REQUEST: "StoreService",
+    MsgType.PUT_REQUEST_SUCCESS: RID_FALLBACK,
+    MsgType.PUT_REQUEST_FAIL: RID_FALLBACK,
+    MsgType.DOWNLOAD_FILE: "StoreService",
+    MsgType.DOWNLOAD_FILE_SUCCESS: "StoreService",
+    MsgType.DOWNLOAD_FILE_FAIL: "StoreService",
+    MsgType.GET_FILE_REQUEST: "StoreService",
+    MsgType.GET_FILE_REQUEST_ACK: RID_FALLBACK,
+    MsgType.GET_FILE_REQUEST_FAIL: RID_FALLBACK,
+    MsgType.DELETE_FILE_REQUEST: "StoreService",
+    MsgType.DELETE_FILE_REQUEST_SUCCESS: RID_FALLBACK,
+    MsgType.DELETE_FILE_REQUEST_FAIL: RID_FALLBACK,
+    MsgType.DELETE_FILE: "StoreService",
+    MsgType.DELETE_FILE_ACK: "StoreService",
+    MsgType.DELETE_FILE_NAK: "StoreService",
+    MsgType.REPLICATE_FILE: "StoreService",
+    MsgType.REPLICATE_FILE_SUCCESS: "StoreService",
+    MsgType.REPLICATE_FILE_FAIL: "StoreService",
+    MsgType.LIST_FILE_REQUEST: "StoreService",
+    MsgType.LIST_FILE_REQUEST_ACK: RID_FALLBACK,
+    MsgType.GET_ALL_MATCHING_FILES: "StoreService",
+    MsgType.GET_ALL_MATCHING_FILES_ACK: RID_FALLBACK,
+    MsgType.FILES_PER_NODE_REQUEST: "StoreService",
+    MsgType.FILES_PER_NODE_ACK: RID_FALLBACK,
+    MsgType.STORE_IDEMPOTENCY_RELAY: "StoreService",
+    # ML job pipeline
+    MsgType.SUBMIT_JOB_REQUEST: "JobService",
+    MsgType.SUBMIT_JOB_REQUEST_ACK: RID_FALLBACK,
+    MsgType.SUBMIT_JOB_REQUEST_SUCCESS: "JobService",
+    MsgType.SUBMIT_JOB_RELAY: "JobService",
+    MsgType.WORKER_TASK_REQUEST: "JobService",
+    MsgType.WORKER_TASK_REQUEST_ACK: "JobService",
+    MsgType.WORKER_TASK_ACK_RELAY: "JobService",
+    MsgType.SET_BATCH_SIZE: "JobService",
+    MsgType.GET_C2_COMMAND: "JobService",
+    MsgType.GET_C2_COMMAND_ACK: RID_FALLBACK,
+    MsgType.SET_BATCH_SIZE_ACK: RID_FALLBACK,
+    MsgType.WORKER_TASK_FAIL: "JobService",
+    MsgType.JOB_STATUS_REQUEST: "JobService",
+    MsgType.JOB_STATUS_ACK: RID_FALLBACK,
+    MsgType.JOBS_RESTORE_RELAY: "JobService",
+    MsgType.JOBS_RESTORE_RELAY_ACK: RID_FALLBACK,
+    MsgType.JOB_FAILED_RELAY: "JobService",
+    MsgType.WORKER_STAGE_CANCEL: "JobService",
+    MsgType.LM_PREFILL_REQUEST: "JobService",
+    MsgType.LM_PREFILL_ACK: RID_FALLBACK,
+    # observability
+    MsgType.METRICS_PULL: "Node",
+    MsgType.METRICS_PULL_ACK: RID_FALLBACK,
+    # request front door (90-96): the full ingress range audited —
+    # SUBMIT/STATUS/DONE/STREAM_READY/RELAY are RequestRouter
+    # handlers on every node (the role activates with leadership but
+    # registration is unconditional so clients receive DONE pushes
+    # and stream-ready notifications), the two ACKs ride the rid
+    # fallback
+    MsgType.REQUEST_SUBMIT: "RequestRouter",
+    MsgType.REQUEST_SUBMIT_ACK: RID_FALLBACK,
+    MsgType.REQUEST_DONE: "RequestRouter",
+    MsgType.REQUEST_STATUS: "RequestRouter",
+    MsgType.REQUEST_STATUS_ACK: RID_FALLBACK,
+    MsgType.REQUEST_STREAM_READY: "RequestRouter",
+    MsgType.INGRESS_RELAY: "RequestRouter",
+}
 
 
 @dataclass(frozen=True)

@@ -427,8 +427,10 @@ class LMBackend:
                     if on_first_token is not None:
                         try:
                             on_first_token()
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            # a TTFT probe hook, never a decode error —
+                            # but a broken hook must be visible
+                            log.warning("on_first_token hook failed: %r", e)
                 if inner is not None:
                     inner(t)
 

@@ -159,8 +159,11 @@ class _Request:
             if cb is not None:
                 try:
                     cb(t)
-                except Exception:
-                    pass  # a streaming hint, never a decode error
+                except Exception as e:
+                    # a streaming hint, never a decode error — debug
+                    # level: this fires per token and a broken stream
+                    # callback would flood anything louder
+                    log.debug("on_token callback failed: %r", e)
 
     @property
     def done(self) -> bool:
@@ -981,8 +984,9 @@ class LMDriver:
                     if t.on_dispatch is not None:
                         try:
                             t.on_dispatch()
-                        except Exception:
-                            pass  # a pipeline hint, never a decode error
+                        except Exception as e:
+                            # a pipeline hint, never a decode error
+                            log.warning("on_dispatch hook failed: %r", e)
                 if srv.has_work():
                     srv.step()
                     with self._cv:

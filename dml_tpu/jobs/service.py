@@ -1645,9 +1645,12 @@ class JobService:
         # relay queued right behind this one must not spawn a
         # concurrent fetch
         self._shadow_restoring = True
-        asyncio.create_task(
+        # tracked via _spawn_bg: stop() must be able to cancel a fetch
+        # still in flight, and a failed restore must be logged, not
+        # dropped as a never-retrieved task exception
+        self._spawn_bg(
             self._restore_shadow(version, gen, rid, msg.sender),
-            name=f"{self._me}-shadow-restore",
+            "shadow-restore",
         )
 
     async def _restore_shadow(
@@ -1826,7 +1829,7 @@ class JobService:
         weak refs — an untracked task can be GC'd before it runs) and
         exception logging (otherwise failures vanish as 'exception was
         never retrieved')."""
-        t = asyncio.create_task(coro)
+        t = asyncio.create_task(coro, name=f"{self._me}-{what}")
         self._bg_tasks.add(t)
 
         def _done(task: asyncio.Task) -> None:
@@ -2334,9 +2337,11 @@ class JobService:
         # an empty shadow and drop every restored job. Retried until
         # the standby ACKs: one lost datagram must not silently void
         # the failover guarantee.
-        asyncio.create_task(
+        # tracked via _spawn_bg (same teardown/logging contract as the
+        # shadow-restore task above)
+        self._spawn_bg(
             self._relay_restore_to_standby(version, self._relay_gen),
-            name=f"{self._me}-restore-relay",
+            "restore-relay",
         )
         self._run_schedule()
         return stats

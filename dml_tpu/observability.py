@@ -114,6 +114,98 @@ count, while real one-process-per-node deployments sum normally.
 
 Also here, unchanged from the seed: ``profile()`` (jax.profiler trace
 context), ``span()`` (wall-clock spans), ``jsonl_logging()``.
+
+Metric map (lint-enforced)
+--------------------------
+
+The complete registry, one metric per 4-space-indented line. This map
+is MACHINE-READ: ``tools/dmllint.py`` (rule drift-metrics-map, run by
+tier-1 via tests/test_dmllint.py) fails when a metric is registered in
+``dml_tpu/`` but missing here, or listed here but registered nowhere —
+the map cannot silently desynchronize from the code again. Add the
+line when you add the metric.
+
+    cluster_alive_nodes              SWIM live-member gauge
+    cluster_failover_recovery_seconds  chaos: leader-kill -> converged wall
+    cluster_false_positives_total    SWIM suspicions that proved alive
+    cluster_node_failures_total      SWIM members declared failed
+    cluster_suspicions_total         SWIM suspicion events
+    coordinator_batch_acks_total     batch ACKs seen by the coordinator
+    jobs_batch_exec_seconds          per-model batch execution wall
+    jobs_completed_total             jobs reaching terminal success
+    jobs_depth_probe_aborts_total    depth probes aborted (stall/timeout)
+    jobs_depth_probe_qps             probe-phase throughput by depth
+    jobs_depth_probes_total          depth probe cycles by trigger
+    jobs_failed_total                jobs retired at the failure cap
+    jobs_group_batches_total         batches served on a group engine
+    jobs_group_degradations_total    group formed -> degraded edges
+    jobs_group_formed                1 while a group is schedulable
+    jobs_group_members_alive         live members per group
+    jobs_group_reforms_total         group degraded -> formed edges
+    jobs_group_requeues_total        primary in-flight batches requeued
+    jobs_kv_handoff_bytes_total      serialized KV slab bytes pulled
+    jobs_kv_handoff_seconds          prefill RPC + slab pull wall
+    jobs_kv_handoff_total            disagg handoffs by result ok|fallback
+    jobs_pipeline_depth              worker-pipelining depth in force
+    jobs_preemptions_total           running batches preempted
+    jobs_queries_total               C1 per-model query counter
+    jobs_query_latency_seconds       C2 per-query latency histogram
+    jobs_query_rate_per_s            C1 trailing 10 s query rate
+    jobs_queue_depth                 schedulable batches per model
+    jobs_requeues_total              batches requeued after worker loss
+    jobs_workers_busy                C5 workers-with-assignments gauge
+    lm_server_compile_events_total   decode-graph compile events
+    lm_server_decode_tokens_total    tokens decoded (all slots)
+    lm_server_prefill_dispatch_seconds  prefill dispatch wall
+    lm_server_queue_wait_seconds     request queue wait
+    lm_server_readback_seconds       device->host readback stalls
+    lm_server_requests_completed_total  LM requests finished
+    lm_server_requests_total         LM requests admitted
+    lm_server_slots_active           busy decode slots
+    lm_server_slots_total            configured decode slots
+    lm_server_step_seconds           decode step wall
+    lm_server_steps_total            decode steps executed
+    lm_sharded_batches_total         LM batches on a group engine by mode
+    lm_sharded_prefill_slabs_total   KV slabs built by prefill workers
+    lm_sharded_tokens_total          tokens from group-sharded serving
+    request_admitted_total           front-door admissions per SLO class
+    request_batch_fill_fraction      formed-batch fill quality
+    request_batch_formation_seconds  batch formation wall
+    request_completed_total          request terminals per SLO class
+    request_deadline_miss_total      completions past their deadline
+    request_e2e_latency_seconds      admission -> completion latency
+    request_in_flight                admitted, not yet terminal
+    request_queue_wait_seconds       admission -> dispatch wait
+    request_rejected_total           post-admission typed rejections
+    request_shed_total               admission sheds by slo= reason=
+    request_stream_tokens_total      tokens pushed into request streams
+    store_corruption_detected_total  sha256 mismatches quarantined
+    store_deletes_total              delete operations
+    store_get_seconds                GET wall
+    store_gets_total                 GET operations
+    store_put_seconds                PUT wall
+    store_puts_total                 PUT operations
+    store_repair_seconds             chaos: corruption -> repaired wall
+    store_replication_failures_total replication attempts failed
+    store_replication_seconds        replication wall
+    store_replications_total         replication operations
+    store_write_failures_total       local write failures (ENOSPC etc.)
+    transport_bytes_received_total   datagram bytes in by msg type
+    transport_bytes_sent_total       datagram bytes out by msg type
+    transport_malformed_dropped_total  frames dying in Message.unpack
+    transport_packets_delayed_total  link-shaper delayed emits
+    transport_packets_dropped_inbound_total  inbound filter drops
+    transport_packets_dropped_total  loss-injection outbound drops
+    transport_packets_duplicated_total  link-shaper duplicate emits
+    transport_packets_received_total datagrams in by msg type
+    transport_packets_sent_total     datagrams out by msg type
+    worker_batch_failures_total      worker batch executions failed
+    worker_batches_total             worker batch executions
+    worker_decode_cache_hits_total   decoded-input cache hits
+    worker_decode_cache_misses_total decoded-input cache misses
+    worker_fetch_seconds             worker input-fetch stage wall
+    worker_infer_seconds             worker inference stage wall
+    worker_put_seconds               worker output-put stage wall
 """
 
 from __future__ import annotations

@@ -1019,6 +1019,78 @@ def run_request_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# static-analysis verdict: the bench preamble runs tools/dmllint.py and
+# records the result; from round 11 on an artifact must say the tree
+# is lint-clean (zero un-baselined async-hazard/drift findings) with a
+# bounded grandfather baseline
+# ----------------------------------------------------------------------
+
+#: first round whose bench carries the dmllint verdict block
+LINT_REQUIRED_FROM_ROUND = 11
+
+#: the baseline may only shrink; tests/test_dmllint.py enforces the
+#: same bound at tier-1 time, this enforces it on the artifact record
+LINT_BASELINE_MAX = 10
+
+
+def check_lint_block(path: str) -> List[str]:
+    """Validate the ``lint`` preamble block: ``lint_clean`` must be
+    True (an artifact built from a tree with un-baselined hazard or
+    drift findings is not a clean round), the finding count must be
+    recorded, and the grandfather baseline must stay within
+    ``LINT_BASELINE_MAX`` entries.
+
+    Artifacts before round ``LINT_REQUIRED_FROM_ROUND`` are exempt;
+    summary-only driver captures gate on the compact line's
+    ``lint_clean`` key."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < LINT_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        if s.get("lint_clean") is False:
+            return [f"{name}: summary lint_clean is false — the round "
+                    "ran on a tree with un-baselined dmllint findings"]
+        return []
+    matrix = data.get("matrix", {})
+    block = matrix.get("lint")
+    if block is None:
+        if rnd is None:
+            return []  # partial/preview artifact without the preamble
+        return [f"{name}: no `lint` block — the bench preamble must "
+                "record the dmllint verdict from round "
+                f"{LINT_REQUIRED_FROM_ROUND} on"]
+    problems: List[str] = []
+    if block.get("lint_clean") is not True:
+        problems.append(
+            f"{name}: lint.lint_clean = {block.get('lint_clean')!r} "
+            f"(error: {block.get('error')!r}) — un-baselined dmllint "
+            "findings (or a broken linter) at bench time"
+        )
+    n = block.get("findings")
+    if not isinstance(n, int) or n < 0:
+        problems.append(
+            f"{name}: lint.findings = {n!r} (missing or not a count)"
+        )
+    b = block.get("baseline_size")
+    if not isinstance(b, int) or not 0 <= b <= LINT_BASELINE_MAX:
+        problems.append(
+            f"{name}: lint.baseline_size = {b!r} — the grandfather "
+            f"baseline must hold <= {LINT_BASELINE_MAX} justified "
+            "entries (it only ever shrinks)"
+        )
+    return problems
+
+
+def run_lint_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_lint_block(artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
 # exists and parses (ISSUE 4 satellite; VERDICT r5 item 1)
@@ -1087,6 +1159,9 @@ def main() -> None:
     for problem in run_request_check(art_path):
         total += 1
         print(f"request block: {problem}")
+    for problem in run_lint_check(art_path):
+        total += 1
+        print(f"lint block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")

@@ -38,8 +38,11 @@ Run: ``python -m dml_tpu.tools.imagenet_parity [--json]``
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
 
 GOLDEN_DIR = "/root/reference/download"
 GOLDEN_IMAGE_DIRS = (
@@ -498,11 +501,11 @@ async def stage_weights_from_store(
             try:
                 await store.get(name, dest)
                 fetched.append(name)
-            except Exception:
+            except Exception as e:
                 # listed but transiently unfetchable (failover window,
                 # data-plane timeout): KEEP any previously staged copy
                 # — same reasoning as the listing-failure early return
-                pass
+                log.debug("staged-weights get %s failed: %r", name, e)
         else:
             try:  # genuinely gone from the store: un-mirror it
                 os.unlink(dest)

@@ -493,8 +493,8 @@ def load_bench(bench_path: str) -> Dict[str, Any]:
             "matrix" in doc or "metric" in doc
         ):
             return doc
-    except Exception:
-        pass
+    except ValueError:
+        pass  # no "{" / not JSON: fall through to the compact line
     for line in reversed(tail.splitlines()):
         line = line.strip()
         if '"bench_summary_v1"' not in line:
@@ -512,8 +512,8 @@ def load_bench(bench_path: str) -> Dict[str, Any]:
             summ, _ = json.JSONDecoder().raw_decode(tail[start:])
             if isinstance(summ, dict):
                 return {"summary": summ, "_summary_only": True}
-        except Exception:
-            pass
+        except ValueError:
+            pass  # truncated mid-summary: genuinely unparseable
     return {"_unparseable_wrapper": True}
 
 

@@ -70,4 +70,5 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "disagg: prefill/decode-disaggregated LM serving coverage (KV-slab handoff over the data plane, role-split groups)")
     config.addinivalue_line("markers", "ingress: request front-door coverage (SLO admission/shedding, continuous batch formation, open-loop load, token streaming)")
     config.addinivalue_line("markers", "pp: pipeline-parallel LM serving coverage (layer-stack stage sharding over the pp mesh axis, microbatched stage handoff)")
+    config.addinivalue_line("markers", "lint: static-analysis coverage (tools/dmllint.py rule fixtures and the tier-1 zero-unbaselined-findings enforcement)")
 

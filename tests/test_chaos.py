@@ -296,9 +296,10 @@ async def test_leader_retry_waits_out_leaderless_window():
         await asyncio.sleep(0.3)
         node.leader_node = object()
 
-    asyncio.get_running_loop().create_task(elect_later())
+    elect = asyncio.get_running_loop().create_task(elect_later())
     reply = await leader_retry(node, MsgType.GET_FILE_REQUEST, {}, timeout=2.0)
     assert reply["ok"] and node.calls == 1
+    await elect
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,621 @@
+"""dmllint coverage: rule-by-rule positive/negative fixtures, baseline
+add/expire round-trip, output-ordering determinism, exit codes, and —
+the point of the whole exercise — the tier-1 enforcement test that
+holds THIS repo to zero un-baselined findings from this PR forward.
+
+Fixture sources live as string literals (string literals are data to
+the AST scan, so deliberately-hazardous fixture code here cannot trip
+the enforcement test on this very file).
+"""
+
+import ast
+import json
+import os
+import textwrap
+
+import pytest
+
+from dml_tpu.tools import dmllint
+from dml_tpu.tools.dmllint import (
+    Finding,
+    LintInternalError,
+    analyze_source,
+    apply_baseline,
+    check_markers,
+    check_metrics,
+    check_summary,
+    check_wire,
+    collect_metric_registrations,
+    extract_bench_summary_keys,
+    extract_claim_gate_keys,
+    extract_handler_owners,
+    extract_msgtype_members,
+    extract_msgtype_refs,
+    extract_registrations,
+    load_baseline,
+    parse_ini_markers,
+    parse_metric_map,
+    run_lint,
+)
+
+pytestmark = pytest.mark.lint
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ----------------------------------------------------------------------
+# async-hazard rules: positives and negatives
+# ----------------------------------------------------------------------
+
+
+def test_naked_task_positive():
+    src = textwrap.dedent("""
+        import asyncio
+
+        async def go(self):
+            asyncio.create_task(self.loop())
+            asyncio.ensure_future(self.other())
+            asyncio.get_running_loop().create_task(self.third())
+    """)
+    fs = analyze_source(src, "dml_tpu/x.py")
+    assert rules_of(fs) == ["naked-task"] * 3
+
+
+def test_naked_task_negative():
+    src = textwrap.dedent("""
+        import asyncio
+
+        async def go(self):
+            t = asyncio.create_task(self.loop())        # stored
+            self._bg.add(asyncio.create_task(self.a())) # tracked
+            await asyncio.create_task(self.b())         # awaited
+            return asyncio.create_task(self.c())        # returned
+    """)
+    assert analyze_source(src, "dml_tpu/x.py") == []
+
+
+def test_silent_except_positive():
+    src = textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+            try:
+                g()
+            except:
+                pass
+            try:
+                g()
+            except (ValueError, Exception):
+                pass
+    """)
+    fs = analyze_source(src, "dml_tpu/x.py")
+    assert rules_of(fs) == ["silent-except"] * 3
+
+
+def test_silent_except_negative():
+    src = textwrap.dedent("""
+        import logging
+
+        def f():
+            try:
+                g()
+            except ValueError:
+                pass              # narrow type: fine
+            try:
+                g()
+            except Exception as e:
+                logging.warning("boom: %r", e)  # logged: fine
+    """)
+    assert analyze_source(src, "dml_tpu/x.py") == []
+
+
+def test_blocking_in_async_positive():
+    src = textwrap.dedent("""
+        import time, subprocess
+
+        async def f():
+            time.sleep(1)
+            subprocess.run(["ls"])
+    """)
+    fs = analyze_source(src, "dml_tpu/x.py")
+    assert rules_of(fs) == ["blocking-async"] * 2
+
+
+def test_blocking_in_async_negative():
+    src = textwrap.dedent("""
+        import asyncio, time
+
+        def sync_f():
+            time.sleep(1)         # not in async context
+
+        async def f():
+            await asyncio.sleep(1)
+
+            def worker():
+                time.sleep(1)     # nested SYNC def: runs off-loop
+            await asyncio.to_thread(worker)
+    """)
+    assert analyze_source(src, "dml_tpu/x.py") == []
+
+
+def test_unseeded_seam_positive():
+    src = textwrap.dedent("""
+        import random, time
+        from random import choice
+
+        def plan():
+            return random.randint(0, 5), time.time()
+    """)
+    fs = analyze_source(src, "dml_tpu/cluster/chaos.py")
+    assert sorted(rules_of(fs)) == ["unseeded-seam"] * 3
+
+
+def test_unseeded_seam_negative_and_scoped():
+    seeded = textwrap.dedent("""
+        import random
+
+        def plan(seed):
+            rng = random.Random(seed)
+            return rng.randint(0, 5)
+    """)
+    assert analyze_source(seeded, "dml_tpu/ingress/loadgen.py") == []
+    # same unseeded source OUTSIDE a determinism seam: not flagged
+    unseeded = "import random\nx = random.random()\n"
+    assert analyze_source(unseeded, "dml_tpu/jobs/service.py") == []
+
+
+def test_finding_keys_survive_line_drift():
+    src = "async def f():\n    import asyncio\n    asyncio.create_task(g())\n"
+    shifted = "\n\n# a comment\n\n" + src
+    (a,) = analyze_source(src, "dml_tpu/x.py")
+    (b,) = analyze_source(shifted, "dml_tpu/x.py")
+    assert a.key == b.key  # scope-anchored, not line-anchored
+    assert a.line != b.line
+
+
+# ----------------------------------------------------------------------
+# drift-wire-handlers (pure-core + extractor fixtures)
+# ----------------------------------------------------------------------
+
+WIRE_SRC = textwrap.dedent("""
+    class MsgType:
+        PING = 1
+        PING_ACK = 2
+        SNAP = 3
+        DEAD = 4
+
+    RID_FALLBACK = "rid-fallback"
+
+    HANDLER_OWNERS = {
+        MsgType.PING: "Node",
+        MsgType.PING_ACK: RID_FALLBACK,
+        MsgType.SNAP: "Node",
+        MsgType.DEAD: "Node",
+    }
+""")
+
+NODE_SRC = textwrap.dedent("""
+    class Node:
+        def start(self):
+            self.register(MsgType.PING, self._h_ping)
+            self.register(MsgType.SNAP, self._h_snap)
+            self.register(MsgType.DEAD, self._h_dead)
+
+        def pong(self):
+            return MsgType.PING_ACK
+""")
+
+
+def _wire_inputs(wire_src=WIRE_SRC, node_src=NODE_SRC):
+    wire_tree = ast.parse(wire_src)
+    node_tree = ast.parse(node_src)
+    members = extract_msgtype_members(wire_tree)
+    owners = extract_handler_owners(wire_tree)
+    regs = {"dml_tpu/node.py": extract_registrations(node_tree, "dml_tpu/node.py")}
+    refs = {
+        "dml_tpu/wire.py": extract_msgtype_refs(wire_tree),
+        "dml_tpu/node.py": extract_msgtype_refs(node_tree),
+    }
+    return members, owners, regs, refs
+
+
+def _run_wire(members, owners, regs, refs):
+    return check_wire(members, owners, regs, refs,
+                      "dml_tpu/wire.py", "dml_tpu/introducer.py")
+
+
+def test_wire_clean_fixture():
+    assert _run_wire(*_wire_inputs()) == []
+
+
+def test_wire_extractors():
+    members, owners, regs, refs = _wire_inputs()
+    assert members == {"PING": 3, "PING_ACK": 4, "SNAP": 5, "DEAD": 6}
+    assert owners["PING_ACK"] == "rid-fallback"
+    assert [(m, c, h) for m, c, h, _ in regs["dml_tpu/node.py"]] == [
+        ("PING", "Node", "_h_ping"),
+        ("SNAP", "Node", "_h_snap"),
+        ("DEAD", "Node", "_h_dead"),
+    ]
+
+
+def test_wire_detects_missing_owner():
+    members, owners, regs, refs = _wire_inputs()
+    del owners["SNAP"]
+    fs = _run_wire(members, owners, regs, refs)
+    assert any("no HANDLER_OWNERS entry" in f.msg for f in fs)
+
+
+def test_wire_detects_unregistered_owned_type():
+    members, owners, regs, refs = _wire_inputs(
+        node_src=NODE_SRC.replace(
+            "        self.register(MsgType.SNAP, self._h_snap)\n",
+            "        snap = MsgType.SNAP  # still referenced, not registered\n"))
+    fs = _run_wire(members, owners, regs, refs)
+    assert any("never registers a handler" in f.msg and "SNAP" in f.msg
+               for f in fs)
+
+
+def test_wire_detects_wrong_owner_and_fallback_registration():
+    members, owners, regs, refs = _wire_inputs()
+    owners["SNAP"] = "StoreService"     # Node registers it -> mismatch
+    owners["DEAD"] = "rid-fallback"     # but Node registers it
+    fs = _run_wire(members, owners, regs, refs)
+    msgs = " | ".join(f.msg for f in fs)
+    assert "owned by StoreService but Node registers" in msgs
+    assert "declared rid-fallback but Node registers" in msgs
+
+
+def test_wire_detects_dead_member_and_undeclared_reference():
+    # GHOST registered but not declared; PING_ACK referenced nowhere
+    # outside wire.py -> dead member
+    node_src = NODE_SRC.replace(
+        "    def pong(self):\n        return MsgType.PING_ACK\n", ""
+    ) + "\n    def late(self):\n        self.register(MsgType.GHOST, self._h_ghost)\n"
+    members, owners, regs, refs = _wire_inputs(node_src=node_src)
+    fs = _run_wire(members, owners, regs, refs)
+    msgs = " | ".join(f.msg for f in fs)
+    assert "undeclared MsgType.GHOST" in msgs
+    assert "MsgType.PING_ACK is referenced nowhere" in msgs
+
+
+def test_wire_detects_handler_naming_violation():
+    node_src = NODE_SRC.replace("self._h_dead", "self.on_dead")
+    members, owners, regs, refs = _wire_inputs(node_src=node_src)
+    fs = _run_wire(members, owners, regs, refs)
+    assert any("breaks the _h_* naming contract" in f.msg for f in fs)
+
+
+# ----------------------------------------------------------------------
+# drift-metrics-map
+# ----------------------------------------------------------------------
+
+MAP_DOC = textwrap.dedent("""
+    Some prose.
+
+    Metric map (lint-enforced)
+    --------------------------
+
+    Preamble line about the map.
+
+        foo_total        things fooed
+        bar_seconds      bar wall
+
+    Next section
+    ------------
+    not_a_metric_line
+""")
+
+
+def test_parse_metric_map():
+    assert parse_metric_map(MAP_DOC) == {"foo_total", "bar_seconds"}
+    assert parse_metric_map("no map here") is None
+
+
+def test_metric_map_drift_detected():
+    code_src = textwrap.dedent("""
+        M1 = METRICS.counter("foo_total", "help")
+        M2 = METRICS.histogram("baz_seconds", "help")
+    """)
+    code = collect_metric_registrations(
+        {"dml_tpu/m.py": ast.parse(code_src)})
+    fs = check_metrics({"foo_total", "bar_seconds"}, code, "dml_tpu/obs.py")
+    msgs = " | ".join(f.msg for f in fs)
+    assert "'bar_seconds' is in the docstring map but no code" in msgs
+    assert "'baz_seconds' is registered here but missing" in msgs
+    assert check_metrics({"foo_total"}, {"foo_total": ("dml_tpu/m.py", 2)},
+                         "dml_tpu/obs.py") == []
+
+
+def test_metric_map_missing_section_detected():
+    fs = check_metrics(None, {}, "dml_tpu/obs.py")
+    assert len(fs) == 1 and "no 'Metric map" in fs[0].msg
+
+
+# ----------------------------------------------------------------------
+# drift-summary-keys
+# ----------------------------------------------------------------------
+
+BENCH_FIXTURE = textwrap.dedent("""
+    _COMPACT_DROP_ORDER = ("b", "typo_drop")
+    _COMPACT_KEEP_KEYS = ("a", "typo_keep")
+
+    def emit(g):
+        summary = {"a": g("a"), "b": g("b"), "c": g("c")}
+        summary["interrupted"] = True
+        return summary
+""")
+
+CLAIM_FIXTURE = textwrap.dedent("""
+    def check_x(data):
+        s = data.get("summary") or {}
+        if s.get("a") is None:
+            return []
+        if s["ghost_key"]:
+            return ["bad"]
+        return [s.get("c")]
+""")
+
+
+def test_summary_extractors():
+    b = ast.parse(BENCH_FIXTURE)
+    assert set(extract_bench_summary_keys(b)) == {"a", "b", "c", "interrupted"}
+    gk = extract_claim_gate_keys(ast.parse(CLAIM_FIXTURE))
+    assert set(gk) == {"a", "ghost_key", "c"}
+
+
+def test_summary_drift_detected():
+    b = ast.parse(BENCH_FIXTURE)
+    fs = check_summary(
+        extract_bench_summary_keys(b),
+        dmllint._module_const_strs(b, "_COMPACT_KEEP_KEYS"),
+        dmllint._module_const_strs(b, "_COMPACT_DROP_ORDER"),
+        extract_claim_gate_keys(ast.parse(CLAIM_FIXTURE)),
+        "bench.py", "claim_check.py",
+    )
+    msgs = " | ".join(f.msg for f in fs)
+    assert "'ghost_key' but bench.py never emits" in msgs
+    assert "'c' but the key does not survive" in msgs       # gate-trimmed
+    assert "_COMPACT_DROP_ORDER entry 'typo_drop'" in msgs
+    assert "_COMPACT_KEEP_KEYS entry 'typo_keep'" in msgs
+    # and the missing-keep-list degradation is itself a finding
+    fs2 = check_summary({"a": 1}, None, None, {}, "bench.py", "c.py")
+    assert any("no module-level _COMPACT_KEEP_KEYS" in f.msg for f in fs2)
+
+
+# ----------------------------------------------------------------------
+# drift-pytest-markers
+# ----------------------------------------------------------------------
+
+INI_FIXTURE = textwrap.dedent("""
+    [pytest]
+    markers =
+        slow: heavyweight test (keras builds, chaos
+            soaks etc. continuation line)
+        lint: static-analysis coverage
+""")
+
+
+def test_parse_ini_markers():
+    assert set(parse_ini_markers(INI_FIXTURE)) == {"slow", "lint"}
+    assert parse_ini_markers("[pytest]\naddopts = -q\n") is None
+
+
+def test_marker_drift_detected():
+    ini = parse_ini_markers(INI_FIXTURE)
+    conftest = {"slow": 10}  # mirror missing 'lint', extra none
+    used = {"slow": ("tests/t.py", 3), "chaos": ("tests/t.py", 9),
+            "parametrize": ("tests/t.py", 1)}
+    fs = check_markers(ini, conftest, used, "pytest.ini", "tests/conftest.py")
+    msgs = " | ".join(f.msg for f in fs)
+    assert "'chaos' used here is not registered" in msgs
+    assert "'lint' is in pytest.ini but missing from the" in msgs
+    assert "'lint' is used by no test" in msgs
+    assert "parametrize" not in msgs  # builtin marks exempt
+    # conftest-only direction
+    fs2 = check_markers(ini, {"slow": 1, "lint": 2, "extra": 3},
+                        {"slow": ("tests/t.py", 3),
+                         "lint": ("tests/t.py", 4)},
+                        "pytest.ini", "tests/conftest.py")
+    assert any("'extra' is in the conftest mirror but not" in f.msg
+               for f in fs2)
+
+
+# ----------------------------------------------------------------------
+# baseline: add/expire round-trip, malformed forms
+# ----------------------------------------------------------------------
+
+HAZARD_SRC = "async def f():\n    import asyncio\n    asyncio.create_task(g())\n"
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = analyze_source(HAZARD_SRC, "dml_tpu/x.py")
+    assert len(findings) == 1
+    # add: baselining the key suppresses the finding
+    baseline = {findings[0].key: "held handle lands with PR N+1"}
+    new, suppressed = apply_baseline(findings, baseline, "baseline.json")
+    assert new == [] and len(suppressed) == 1
+    # expire: fixing the hazard turns the entry into baseline-stale
+    new2, _ = apply_baseline([], baseline, "baseline.json")
+    assert [f.rule for f in new2] == ["baseline-stale"]
+    assert findings[0].key in new2[0].msg
+
+
+def test_baseline_loader_contract(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"entries": [
+        {"key": "k1", "justification": "a real reason"}]}))
+    assert load_baseline(str(p)) == {"k1": "a real reason"}
+    # missing justification is a malformed baseline, not a suppression
+    p.write_text(json.dumps({"entries": [{"key": "k1"}]}))
+    with pytest.raises(LintInternalError, match="justification"):
+        load_baseline(str(p))
+    p.write_text(json.dumps({"entries": [
+        {"key": "k1", "justification": "x y z"},
+        {"key": "k1", "justification": "dup"}]}))
+    with pytest.raises(LintInternalError, match="duplicate"):
+        load_baseline(str(p))
+    p.write_text("{not json")
+    with pytest.raises(LintInternalError):
+        load_baseline(str(p))
+    assert load_baseline(str(tmp_path / "absent.json")) == {}
+
+
+# ----------------------------------------------------------------------
+# driver: determinism, exit codes, fixture-tree scan
+# ----------------------------------------------------------------------
+
+
+def _fixture_tree(tmp_path, src=HAZARD_SRC):
+    (tmp_path / "dml_tpu").mkdir()
+    (tmp_path / "dml_tpu" / "bad.py").write_text(src)
+    return str(tmp_path)
+
+
+def test_exit_codes(tmp_path, capsys):
+    root = _fixture_tree(tmp_path)
+    assert dmllint.main(["--root", root]) == 1      # findings
+    out = capsys.readouterr().out
+    assert "dml_tpu/bad.py" in out and "naked-task" in out
+    # baseline the finding -> clean
+    res = run_lint(root)
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"entries": [
+        {"key": res.findings[0].key, "justification": "fixture waiver"}]}))
+    assert dmllint.main(["--root", root, "--baseline", str(bl)]) == 0
+    # malformed baseline -> internal error
+    bl.write_text("{broken")
+    assert dmllint.main(["--root", root, "--baseline", str(bl)]) == 2
+
+
+def test_output_ordering_deterministic(tmp_path):
+    root = _fixture_tree(tmp_path, textwrap.dedent("""
+        import asyncio, time
+
+        async def z():
+            asyncio.create_task(g())
+
+        async def a():
+            time.sleep(1)
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    (tmp_path / "dml_tpu" / "also.py").write_text(HAZARD_SRC)
+    r1 = run_lint(root)
+    r2 = run_lint(root)
+    assert [f.key for f in r1.findings] == [f.key for f in r2.findings]
+    ordered = [(f.path, f.line, f.rule) for f in r1.findings]
+    assert ordered == sorted(ordered)
+    assert len(r1.findings) == 4
+
+
+def test_json_output_shape(tmp_path, capsys):
+    root = _fixture_tree(tmp_path)
+    assert dmllint.main(["--root", root, "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["clean"] is False
+    assert doc["findings"][0]["rule"] == "naked-task"
+    assert {"path", "line", "rule", "msg", "key"} <= set(doc["findings"][0])
+
+
+def test_syntax_error_is_internal_error(tmp_path):
+    root = _fixture_tree(tmp_path, "def broken(:\n")
+    assert dmllint.main(["--root", root]) == 2
+
+
+# ----------------------------------------------------------------------
+# the tier-1 enforcement test: THIS repo is clean
+# ----------------------------------------------------------------------
+
+
+def test_repo_zero_unbaselined_findings():
+    """The contract of ISSUE 9: zero un-baselined findings on the real
+    tree, with a near-empty justified baseline. A finding here means a
+    hazard/drift regression landed — fix it or (exceptionally) baseline
+    it WITH a justification."""
+    res = run_lint()
+    assert res.findings == [], "un-baselined dmllint findings:\n" + "\n".join(
+        f.render() for f in res.findings
+    )
+    assert res.baseline_size <= 10
+    # every suppression corresponds to a live finding (no stale
+    # entries — apply_baseline would have surfaced them above)
+    assert len(res.suppressed) == res.baseline_size
+
+
+def test_bench_block_shape():
+    block = dmllint.bench_block()
+    assert block["lint_clean"] is True
+    assert block["findings"] == 0
+    assert isinstance(block["baseline_size"], int)
+
+
+# ----------------------------------------------------------------------
+# claim_check round-11 gate
+# ----------------------------------------------------------------------
+
+
+def _artifact(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_claim_check_lint_gate(tmp_path):
+    from dml_tpu.tools.claim_check import check_lint_block
+
+    good = {"metric": "x", "matrix": {
+        "lint": {"lint_clean": True, "findings": 0, "baseline_size": 1}}}
+    assert check_lint_block(_artifact(tmp_path, "BENCH_r11.json", good)) == []
+    # pre-round-11 artifacts exempt, even without the block
+    old = {"metric": "x", "matrix": {}}
+    assert check_lint_block(_artifact(tmp_path, "BENCH_r10.json", old)) == []
+    # round 11+: missing block is a violation
+    assert check_lint_block(_artifact(tmp_path, "BENCH_r12.json", old))
+    # dirty tree is a violation
+    bad = {"metric": "x", "matrix": {
+        "lint": {"lint_clean": False, "findings": 3, "baseline_size": 1}}}
+    probs = check_lint_block(_artifact(tmp_path, "BENCH_r11b.json", bad))
+    assert any("lint_clean" in p for p in probs)
+    # oversized baseline is a violation
+    fat = {"metric": "x", "matrix": {
+        "lint": {"lint_clean": True, "findings": 0, "baseline_size": 99}}}
+    probs = check_lint_block(_artifact(tmp_path, "BENCH_r11c.json", fat))
+    assert any("baseline_size" in p for p in probs)
+
+
+def test_claim_check_lint_gate_summary_only(tmp_path):
+    from dml_tpu.tools.claim_check import check_lint_block
+
+    line = json.dumps({"bench_summary_v1": True,
+                       "summary": {"lint_clean": False}})
+    doc = {"tail": "garbage prefix\n" + line + "\n"}
+    probs = check_lint_block(_artifact(tmp_path, "BENCH_r11.json", doc))
+    assert any("lint_clean is false" in p for p in probs)
+    ok_line = json.dumps({"bench_summary_v1": True,
+                          "summary": {"lint_clean": True}})
+    doc = {"tail": ok_line + "\n"}
+    assert check_lint_block(
+        _artifact(tmp_path, "BENCH_r11d.json", doc)) == []
+
+
+def test_compact_line_keeps_lint_clean():
+    """The round-11 summary-only gate can only fire if lint_clean
+    survives bench.py's last-resort compact-line trim."""
+    import bench
+
+    assert "lint_clean" in bench._COMPACT_KEEP_KEYS
+    hl = {"qps": 100.0}
+    fat_summary = {k: "x" * 50 for k in
+                   [f"pad_{i}" for i in range(200)]}
+    fat_summary["lint_clean"] = True
+    line = bench.compact_summary_line(hl, "cpu", 4.0, fat_summary)
+    assert len(line) <= bench.COMPACT_SUMMARY_BUDGET
+    doc = json.loads(line)
+    assert doc["summary"]["lint_clean"] is True
