@@ -73,7 +73,12 @@ SECTION_EST_S = {
     "cluster_lm_sharded": 560.0,
     "lm": 450.0,
     "cluster_lm_serving": 210.0,  # + >=15 s steady-state refill phase
-    "chaos": 180.0,  # 2 soak seeds + 5 adversarial scenario families
+    "chaos": 200.0,  # 2 soak seeds + 6 adversarial scenario families
+    # control-plane scale matrix: 16/64/128-node membership-only
+    # clusters x full-vs-delta gossip (bring-up, traffic window,
+    # metrics aggregation, kill + election each) + the 64-node
+    # store-services churn run (measured ~120 s warm on 1 core)
+    "control_plane_scale": 300.0,
     # per-request front door under open-loop load: light (continuous
     # vs fixed formation), saturation, sustained mixed-class (+ the
     # weighted-class-vs-FIFO rerun), and the leader-failover-mid-
@@ -574,6 +579,149 @@ def _bench_chaos(out, *, seeds=(1, 2), scenario_seeds=(1,),
                 "is the FAST sim profile (ping 50ms, cleanup 300ms), "
                 "so walls measure protocol rounds, not deployed "
                 "wall-clock",
+    }
+
+
+def _bench_control_plane_scale(
+    out, *, ns=(16, 64, 128), base_port=29500, seed=1, measure_s=3.0,
+    churn_nodes=64, churn_rate=2.0, churn_duration=10.0,
+):
+    """Control-plane scale matrix (ROADMAP item 5): bring an N-node
+    membership-only LocalCluster up under BOTH gossip protocols —
+    "full" (the reference full-table piggyback) and "delta" (bounded
+    freshness-prioritized piggyback + random epidemic ping, the
+    product default) — at N ∈ {16, 64, 128}, and score per cell:
+    gossip convergence wall, steady-state control-plane bytes/node/s,
+    cluster-wide failure-detection latency, election wall, and the
+    leader's metrics-aggregation wall + ingress bytes for direct
+    bounded fan-out vs two-level relay aggregation. Then a sustained
+    CHURN run (seeded join/leave stream, store services up) proves
+    the invariants — exactly one leader, no lost store files, no dead
+    coroutines — hold while the membership plane never settles.
+
+    Verdicts claim_check holds round-12+ artifacts to: the delta
+    protocol's bytes/node/s strictly below full-table at N >= 64,
+    failure detection within 1.5x of small-N, the relay metrics wall
+    sub-linear in N, and a green churn sweep. CPU-only; every N runs
+    the same SCALE timing envelope so walls compare across N."""
+    from dml_tpu.cluster.chaos import (
+        SCALE_TIMING, churn_plan, control_plane_probe_sync,
+        run_plan_sync,
+    )
+
+    matrix = {}
+    port = base_port
+    for n in ns:
+        row = {}
+        for proto in ("full", "delta"):
+            row[proto] = control_plane_probe_sync(
+                n, port, seed=seed, protocol=proto, measure_s=measure_s,
+            )
+            port += n + 12
+        matrix[str(n)] = row
+
+    churn_rep = run_plan_sync(
+        churn_plan(seed, n_nodes=churn_nodes, rate_per_s=churn_rate,
+                   duration=churn_duration, with_jobs=False),
+        base_port=port,
+        timing=SCALE_TIMING,
+        services="store",
+    )
+    churn = {
+        "n_nodes": churn_nodes,
+        "rate_per_s": churn_rate,
+        "duration_s": churn_duration,
+        "crash_restart_pairs": sum(
+            1 for e in churn_rep.plan.events if e.kind == "crash"
+        ),
+        "ok": churn_rep.ok,
+        "failures": churn_rep.invariants.failures,
+        "wall_s": round(churn_rep.wall_s, 1),
+    }
+
+    small, big = str(ns[0]), str(ns[-1])
+
+    def cell(n, proto, key, default=None):
+        v = matrix.get(n, {}).get(proto, {}).get(key)
+        return v if v is not None else default
+
+    def ratio(a, b):
+        return round(a / b, 3) if a and b else None
+
+    bytes_vs_full = {
+        n: ratio(cell(n, "delta", "bytes_per_node_s"),
+                 cell(n, "full", "bytes_per_node_s"))
+        for n in matrix
+    }
+    detect_small = cell(small, "delta", "detect_s")
+    detect_big = cell(big, "delta", "detect_s")
+
+    def mcell(n, mode, key):
+        return (matrix[n]["delta"].get(f"metrics_{mode}") or {}).get(key)
+
+    # sub-50ms walls are below the sim envelope's measurement
+    # resolution (event-loop jitter + 250ms ping bursts on one core);
+    # the sub-linearity ratio floors both ends there so it reflects
+    # protocol growth, not scheduler noise
+    mw_floor = 0.05
+    mw_small = mcell(small, "relay", "wall_s")
+    mw_big = mcell(big, "relay", "wall_s")
+    mi_big_direct = mcell(big, "direct", "leader_ingress_bytes")
+    mi_big_relay = mcell(big, "relay", "leader_ingress_bytes")
+    straggler = matrix[big]["delta"].get("metrics_straggler") or {}
+    strag_ratio = ratio(
+        straggler.get("serial_wall_s"), straggler.get("relay_wall_s")
+    )
+    n_ratio = int(big) / int(small)
+    detect_ratio = ratio(detect_big, detect_small)
+    metrics_ratio = ratio(
+        max(mw_big, mw_floor) if mw_big is not None else None,
+        max(mw_small, mw_floor) if mw_small is not None else None,
+    )
+    verdicts = {
+        # delta strictly below full-table traffic at every N >= 64
+        "bytes_below_full_at_64plus": all(
+            v is not None and v < 1.0
+            for n, v in bytes_vs_full.items() if int(n) >= 64
+        ),
+        # big-N failure detection within 1.5x of small-N
+        "detect_within_1p5x_of_small_n": (
+            detect_ratio is not None and detect_ratio <= 1.5
+        ),
+        # metrics-pull wall grows slower than N on the healthy
+        # cluster — and with dead peers on the list, the aggregated
+        # pull stays bounded by ~one timeout while the serial shape
+        # pays one PER straggler (that is what used to melt)
+        "metrics_wall_sublinear": (
+            metrics_ratio is not None and metrics_ratio < n_ratio
+            and strag_ratio is not None and strag_ratio > 1.5
+        ),
+        "churn_green": bool(churn["ok"]),
+    }
+    out["control_plane_scale"] = {
+        "ns": list(ns),
+        "seed": seed,
+        "matrix": matrix,
+        "churn": churn,
+        "bytes_vs_full_by_n": bytes_vs_full,
+        "detect_ratio_vs_small_n": detect_ratio,
+        "metrics_wall_ratio_vs_small_n": metrics_ratio,
+        "metrics_wall_floor_s": mw_floor,
+        "metrics_straggler": straggler,
+        "straggler_serial_vs_relay": strag_ratio,
+        "relay_vs_direct_ingress": ratio(mi_big_direct, mi_big_relay),
+        "scale_converge_s": cell(big, "delta", "converge_s"),
+        "scale_detect_s": detect_big,
+        "scale_election_s": cell(big, "delta", "election_s"),
+        "scale_bytes_per_node_s": cell(big, "delta", "bytes_per_node_s"),
+        "scale_metrics_wall_s": mw_big,
+        "verdicts": verdicts,
+        "scale_ok": all(verdicts.values()),
+        "note": "membership-only nodes for the N x protocol matrix "
+                "(services=core; store/jobs planes scored by churn + "
+                "the small-N sections); SCALE timing envelope (ping "
+                "250ms, cleanup 2.5s) shared by every N, so walls "
+                "measure protocol rounds, comparable across N",
     }
 
 
@@ -2510,6 +2658,10 @@ def main() -> None:
             # chaos (stub backend; the admission/formation/failover
             # machinery is what's scored)
             ("request_serving", lambda: _bench_request_serving(out)),
+            # control-plane scale matrix: CPU-only, membership-level —
+            # the O(100)-node gossip/metrics/churn story (round 12)
+            ("control_plane_scale",
+             lambda: _bench_control_plane_scale(out)),
             # concat accounting needs the chip (isolated slope-timed
             # concats at Inception's shapes) and the models sweep's
             # b128 point above for its verdict line
@@ -2637,6 +2789,20 @@ def main() -> None:
             "request_serving", "continuous_vs_fixed_p99"),
         "req_failover_ok": g(
             "request_serving", "failover", "all_terminal_exactly_once"),
+        # control-plane scale (cluster/chaos.py control_plane_probe,
+        # round-12 gate): 128-node delta-protocol convergence wall,
+        # cluster-wide failure-detection latency, steady control-plane
+        # bytes/node/s, the relay metrics wall, and the overall
+        # verdict (bytes below full-table at 64+, detection within
+        # 1.5x of small-N, metrics wall sub-linear, churn green)
+        "scale_converge_s": g("control_plane_scale", "scale_converge_s"),
+        "scale_detect_s": g("control_plane_scale", "scale_detect_s"),
+        "scale_bytes_per_node_s": g(
+            "control_plane_scale", "scale_bytes_per_node_s"),
+        "scale_metrics_wall_s": g(
+            "control_plane_scale", "scale_metrics_wall_s"),
+        "scale_ok": g("control_plane_scale", "scale_ok"),
+        "scale_churn_ok": g("control_plane_scale", "churn", "ok"),
         # static-analysis verdict (tools/dmllint.py, round-11 gate)
         "lint_clean": g("lint", "lint_clean"),
         "lint_findings": g("lint", "findings"),
@@ -2727,6 +2893,7 @@ def main() -> None:
 _COMPACT_DROP_ORDER = (
     "section_wall_s", "kv_heads_tok_s", "chaos_scenarios_ok",
     "lint_findings", "lint_baseline",
+    "scale_metrics_wall_s", "scale_churn_ok",
     "lm_tok_s", "fail_detect_s", "fail_completed", "cluster_readback_ms",
     "chaos_malformed_dropped", "train_mfu_b128_ga4", "opt_batch",
     "inception_concat_bound", "sharded_vs_single",
@@ -2751,7 +2918,8 @@ COMPACT_SUMMARY_BUDGET = 1500
 #: lm_sharded_equal the round-8 sharded-LM gate; lm_pp_toks /
 #: lm_stream_ttft_ms / lm_stream_vs_slab the round-10 pipeline+
 #: streamed-handoff gate; req_* the round-9 request-serving gate;
-#: lint_clean the round-11 static-analysis gate.
+#: lint_clean the round-11 static-analysis gate; scale_* the
+#: round-12 control-plane-scale gate.
 _COMPACT_KEEP_KEYS = (
     "headline_qps", "cluster_qps", "cluster_pipelining",
     "cluster_lm_tok_s", "cluster_lm_steady_tok_s",
@@ -2763,6 +2931,8 @@ _COMPACT_KEEP_KEYS = (
     "req_p99_ms", "req_goodput_qps",
     "req_shed_ratio", "req_failover_ok",
     "lint_clean",
+    "scale_converge_s", "scale_detect_s",
+    "scale_bytes_per_node_s", "scale_ok",
     "section_errors", "sections_skipped",
 )
 

@@ -13,6 +13,8 @@ Run:
     python -m dml_tpu node --spec /tmp/cluster.json --name H1
     python -m dml_tpu chaos run --seed 7 --soak   # seeded fault plan
     python -m dml_tpu chaos run --seed 1 --scenario fuzz  # one family
+    python -m dml_tpu chaos run --seed 1 --scenario churn  # join/leave
+    python -m dml_tpu scale --nodes 128           # control-plane probe
     python -m dml_tpu lint                        # async-hazard/drift lint
 """
 
@@ -646,12 +648,14 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "(leader-kill-mid-put/job + partition heal + "
                          "2%% loss + duplicate delivery)")
     pc.add_argument("--scenario", default=None,
-                    choices=["asym", "disk", "dns", "skew", "fuzz"],
+                    choices=["asym", "disk", "dns", "skew", "fuzz",
+                             "churn"],
                     help="run one adversarial scenario family: "
                          "asym(metric partition), disk(-full + "
                          "corruption), dns (introducer outage during "
                          "failover), (clock) skew, fuzz (byzantine "
-                         "datagrams)")
+                         "datagrams), churn (sustained seeded "
+                         "join/leave)")
     pc.add_argument("--plan", default=None, metavar="FILE",
                     help="replay a saved plan JSON instead of generating")
     pc.add_argument("--dump", default=None, metavar="FILE",
@@ -660,6 +664,34 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="print/dump the schedule without running it")
     pc.add_argument("--base-port", type=int, default=24001)
     pc.add_argument("-v", "--verbose", action="store_true")
+
+    pscale = sub.add_parser(
+        "scale",
+        help="control-plane scale probe: bring up an N-node "
+             "membership-level in-process cluster under the chosen "
+             "gossip protocol and print convergence / traffic / "
+             "metrics-aggregation / detection / election measurements "
+             "as JSON (the bench control_plane_scale section runs the "
+             "full 16/64/128 x full-vs-delta matrix)",
+    )
+    pscale.add_argument("--nodes", type=int, default=64)
+    pscale.add_argument("--protocol", choices=["delta", "full"],
+                        default="delta",
+                        help="gossip piggyback protocol (delta = "
+                             "bounded product default, full = "
+                             "reference full-table baseline)")
+    pscale.add_argument("--services", choices=["core", "store", "full"],
+                        default="core",
+                        help="per-node service stack (core = "
+                             "membership only, the affordable 128-node "
+                             "form)")
+    pscale.add_argument("--seed", type=int, default=1)
+    pscale.add_argument("--measure-s", type=float, default=4.0,
+                        help="steady-state traffic window seconds")
+    pscale.add_argument("--relays", type=int, default=None,
+                        help="metrics relay count (default ~sqrt(N))")
+    pscale.add_argument("--base-port", type=int, default=26001)
+    pscale.add_argument("-v", "--verbose", action="store_true")
 
     args = p.parse_args(argv)
     if args.command == "lint":
@@ -692,6 +724,18 @@ def main(argv: Optional[List[str]] = None) -> None:
         asyncio.run(_run_introducer(args))
     elif args.command == "chaos":
         raise SystemExit(asyncio.run(_run_chaos(args)))
+    elif args.command == "scale":
+        from .cluster.chaos import control_plane_probe_sync
+
+        print(json.dumps(control_plane_probe_sync(
+            args.nodes,
+            args.base_port,
+            seed=args.seed,
+            protocol=args.protocol,
+            services=args.services,
+            measure_s=args.measure_s,
+            metrics_relays=args.relays,
+        ), indent=2))
 
 
 if __name__ == "__main__":  # pragma: no cover

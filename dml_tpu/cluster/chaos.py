@@ -87,6 +87,20 @@ FAST_TIMING = Timing(
     leader_rpc_timeout=5.0,
 )
 
+#: the O(100)-node envelope: a 128-node sim at FAST_TIMING pushes
+#: ~15k datagrams/s through one event loop — protocol behavior would
+#: drown in scheduler jitter. This profile keeps a whole 128-node
+#: bring-up + kill + election cycle under a minute while every
+#: latency is still measured in protocol rounds, comparable across N
+#: because ALL N run the same envelope.
+SCALE_TIMING = Timing(
+    ping_interval=0.25,
+    ack_timeout=0.6,
+    cleanup_time=2.5,
+    missed_acks_to_suspect=2,
+    leader_rpc_timeout=10.0,
+)
+
 #: model served by the deterministic stub backend (a registry CNN so
 #: the coordinator's intake accepts it without register_lm)
 STUB_MODEL = "ResNet50"
@@ -157,8 +171,10 @@ EVENT_KINDS = (
 )
 
 #: the adversarial scenario families `scenario_plan` generates and the
-#: bench chaos section + claim_check validate per-family
-SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz")
+#: bench chaos section + claim_check validate per-family ("churn" —
+#: sustained seeded join/leave, not one-off restarts — landed with the
+#: control-plane scale work and is claim_check-gated from round 12)
+SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz", "churn")
 
 
 @dataclass(frozen=True)
@@ -343,6 +359,76 @@ def fuzz_datagrams(
     return malformed, byzantine
 
 
+def churn_plan(
+    seed: int,
+    n_nodes: int = 5,
+    rate_per_s: float = 0.9,
+    duration: float = 7.0,
+    with_jobs: bool = True,
+    max_down: Optional[int] = None,
+) -> ChaosPlan:
+    """SUSTAINED churn: a seeded stream of join/leave pairs at
+    ``rate_per_s`` crash events per second for ``duration`` seconds —
+    the membership plane never settles, which is a different regime
+    from the soak plans' one-off kill-and-recover. Victims are drawn
+    from the non-leader/non-standby name pool (the leader dying is the
+    *election* story, measured separately); each crash is paired with
+    a same-identity restart after a seeded downtime that straddles the
+    cleanup window, so the cluster sees both flavors: a flap that
+    returns before cleanup (false-positive pressure) and a real
+    death-and-rejoin. At most ``max_down`` nodes are down at once
+    (defaults scale with N, bounded so replication_factor survivors
+    always exist). Ends with every victim back and a verification
+    tail: the invariant sweep must find exactly one leader, every
+    seeded store file intact at factor, and no dead coroutines."""
+    rng = random.Random(_child_seed(seed, "churn"))
+    j = lambda a, b: round(rng.uniform(a, b), 3)  # noqa: E731
+    # H1/H2 are the rank-ordered leader + standby; churning them turns
+    # every cycle into an election, which drowns the churn signal
+    pool = [f"H{i + 1}" for i in range(2, n_nodes)]
+    if not pool:
+        raise ValueError("churn needs at least 3 nodes")
+    if max_down is None:
+        max_down = max(1, min(len(pool) - 1 or 1, 1 + n_nodes // 16))
+    events = [
+        event(j(0.15, 0.3), "put", name="churn_seed_a.bin", size=1024),
+        event(j(0.35, 0.5), "put", name="churn_seed_b.bin", size=1024),
+    ]
+    if with_jobs:
+        events.append(event(j(0.6, 0.8), "job", n=16))
+    t = 1.2
+    #: victim -> time it becomes free again (restart + margin)
+    busy: Dict[str, float] = {}
+    # seeded rotation: every pool member gets churned before anyone
+    # is churned twice (a pure random choice can hammer one node)
+    order = list(pool)
+    rng.shuffle(order)
+    idx = 0
+    end = 1.2 + max(1.0, duration)
+    while t < end:
+        down = sum(1 for until in busy.values() if until > t)
+        victim = None
+        if down < max_down:
+            for off in range(len(order)):
+                cand = order[(idx + off) % len(order)]
+                if busy.get(cand, 0.0) <= t:
+                    victim = cand
+                    idx = (idx + off + 1) % len(order)
+                    break
+        if victim is not None:
+            downtime = j(1.2, 2.4)
+            events.append(event(t, "crash", victim))
+            events.append(event(t + downtime, "restart", victim))
+            busy[victim] = t + downtime + 0.5
+        t += max(0.15, rng.uniform(0.6, 1.4) / max(rate_per_s, 0.05))
+    tail = max(end, max(busy.values(), default=end)) + 0.5
+    events.append(event(tail, "get", name="churn_seed_a.bin", scrub=False))
+    if with_jobs:
+        events.append(event(tail + 0.2, "job", n=12))
+    return ChaosPlan(seed=seed, events=tuple(events), n_nodes=n_nodes,
+                     settle_s=2.0, name=f"churn-{seed}")
+
+
 def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
     """One focused plan per adversarial scenario family (the chaos-
     coverage gaps ROADMAP listed after PR 2):
@@ -374,6 +460,10 @@ def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
     if family not in SCENARIO_FAMILIES:
         raise ValueError(f"unknown scenario family {family!r} "
                          f"(choose from {SCENARIO_FAMILIES})")
+    if family == "churn":
+        # sustained join/leave pressure has its own generator (rate ×
+        # duration, paired crash/restart, bounded concurrent downs)
+        return churn_plan(seed, n_nodes=n_nodes)
     rng = random.Random(_child_seed(seed, f"scenario/{family}"))
     j = lambda a, b: round(rng.uniform(a, b), 3)  # noqa: E731
     seed_file = f"{family}_seed.bin"
@@ -605,8 +695,13 @@ class SimNode:
     """One live node's service stack inside a LocalCluster."""
 
     node: Node
-    store: StoreService
-    jobs: Any  # JobService (imported lazily to keep jax out)
+    #: None when the cluster runs services="core" (membership-only
+    #: scale sims: no per-node TCP data plane / store loops)
+    store: Optional[StoreService]
+    #: JobService (imported lazily to keep jax out); None under
+    #: services="core"/"store" — a 128-node control-plane sim must
+    #: not pay 128 job-service stacks it never schedules on
+    jobs: Any
     #: RequestRouter when the cluster runs with_ingress=True (the
     #: request front door, dml_tpu/ingress/); None otherwise
     ingress: Any = None
@@ -631,6 +726,8 @@ class LocalCluster:
         with_ingress: bool = False,
         ingress_formation: str = "continuous",
         ingress_classes: Optional[Dict[str, Any]] = None,
+        services: str = "full",
+        gossip_protocol: Optional[str] = None,
     ):
         """`worker_groups` (config.WorkerGroupSpec list) pools nodes
         into tensor-parallel serving groups (jobs/groups.py); the
@@ -647,10 +744,25 @@ class LocalCluster:
         traffic through the same invariant-checked chassis.
         `ingress_formation` picks the batch-formation mode
         ("continuous" product default | "fixed" naive baseline);
-        `ingress_classes` overrides the SLO class table."""
+        `ingress_classes` overrides the SLO class table.
+
+        `services` bounds the per-node stack so O(100)-node sims stay
+        affordable: "full" (default) = node + store + jobs (+ingress),
+        "store" = node + store (churn/metadata scenarios — no job
+        stacks), "core" = membership/election/metrics only (the pure
+        control-plane scale probe: one UDP socket + two coroutines
+        per node). `gossip_protocol` overrides the spec's piggyback
+        protocol ("delta" product default | "full" reference
+        baseline) — the scale bench scores one against the other."""
+        if services not in ("full", "store", "core"):
+            raise ValueError(f"unknown services mode {services!r}")
         self.root = root
         self.seed = seed
         self.batch_size = batch_size
+        self.services = services
+        spec_kw: Dict[str, Any] = {}
+        if gossip_protocol is not None:
+            spec_kw["gossip_protocol"] = gossip_protocol
         self.spec = ClusterSpec.localhost(
             n_nodes,
             base_port=base_port,
@@ -661,6 +773,7 @@ class LocalCluster:
                 download_dir=os.path.join(root, "dl"),
             ),
             worker_groups=list(worker_groups or []),
+            **spec_kw,
         )
         self._make_jobs = make_jobs or self._default_jobs
         self.with_ingress = with_ingress
@@ -740,27 +853,31 @@ class LocalCluster:
     async def start_node(self, nid: NodeId) -> SimNode:
         node = Node(self.spec, nid,
                     seed=_child_seed(self.seed, f"node/{nid.unique_name}"))
-        store = StoreService(
-            node, root=os.path.join(self.root, f"st_{nid.port}")
-        )
-        jobs = self._make_jobs(node, store)
-        ingress = None
-        if self.with_ingress:
-            from ..ingress.router import RequestRouter
-
-            ingress = RequestRouter(
-                jobs,
-                classes=self.ingress_classes,
-                formation=self.ingress_formation,
+        store = jobs = ingress = None
+        if self.services != "core":
+            store = StoreService(
+                node, root=os.path.join(self.root, f"st_{nid.port}")
             )
+        if self.services == "full":
+            jobs = self._make_jobs(node, store)
+            if self.with_ingress:
+                from ..ingress.router import RequestRouter
+
+                ingress = RequestRouter(
+                    jobs,
+                    classes=self.ingress_classes,
+                    formation=self.ingress_formation,
+                )
         started: List[Any] = []
         try:
             await node.start()
             started.append(node)
-            await store.start()
-            started.append(store)
-            await jobs.start()
-            started.append(jobs)
+            if store is not None:
+                await store.start()
+                started.append(store)
+            if jobs is not None:
+                await jobs.start()
+                started.append(jobs)
             if ingress is not None:
                 await ingress.start()
         except Exception:
@@ -782,8 +899,10 @@ class LocalCluster:
         sn = self.nodes.pop(uname)
         if sn.ingress is not None:
             await sn.ingress.stop()
-        await sn.jobs.stop()
-        await sn.store.stop()
+        if sn.jobs is not None:
+            await sn.jobs.stop()
+        if sn.store is not None:
+            await sn.store.stop()
         await sn.node.stop()
 
     async def restart_node(self, uname: str) -> SimNode:
@@ -819,12 +938,12 @@ class LocalCluster:
                                  f"shape/{uname}/{self._restart_counter}"),
                 **self._shape_args,
             )
-        if self._store_fault_args:
+        if self._store_fault_args and sn.store is not None:
             sn.store.data_plane.fault = TunnelFault(
                 seed=_child_seed(self.seed, f"tunnel/{uname}"),
                 **self._store_fault_args,
             )
-        if uname in self._disk_faults:
+        if uname in self._disk_faults and sn.store is not None:
             sn.store.store.fault = DiskFault(
                 seed=_child_seed(
                     self.seed, f"disk/{uname}/{self._restart_counter}"),
@@ -869,6 +988,8 @@ class LocalCluster:
     def set_store_fault(self, **kw: float) -> None:
         self._store_fault_args = {k: v for k, v in kw.items() if v} or None
         for uname, sn in self.nodes.items():
+            if sn.store is None:
+                continue
             sn.store.data_plane.fault = (
                 TunnelFault(
                     seed=_child_seed(self.seed, f"tunnel/{uname}"), **kw
@@ -884,11 +1005,12 @@ class LocalCluster:
         if uname is None or not kw:
             self._disk_faults.clear()
             for sn in self.nodes.values():
-                sn.store.store.fault = None
+                if sn.store is not None:
+                    sn.store.store.fault = None
             return
         self._disk_faults[uname] = kw
         sn = self.nodes.get(uname)
-        if sn is not None:
+        if sn is not None and sn.store is not None:
             sn.store.store.fault = DiskFault(
                 seed=_child_seed(
                     self.seed, f"disk/{uname}/{self._restart_counter}"),
@@ -913,6 +1035,8 @@ class LocalCluster:
         nobody holds the file). Detection happens on the next read of
         that replica (a scrubbed GET guarantees one)."""
         for uname in sorted(self.nodes):
+            if self.nodes[uname].store is None:
+                continue
             st = self.nodes[uname].store.store
             if st.has(name):
                 path = st.get_path(name)
@@ -1010,7 +1134,7 @@ class LocalCluster:
 
     def any_leader_store(self) -> Optional[StoreService]:
         for sn in self.nodes.values():
-            if sn.node.is_leader:
+            if sn.node.is_leader and sn.store is not None:
                 return sn.store
         return None
 
@@ -1033,9 +1157,12 @@ class LocalCluster:
                     return uname
             return self.leader_uname()
         if target == "standby":
+            # Node.standby_node: the one standby definition, shared
+            # with the store's failover relays — and available in
+            # membership-only "core" sims too
             for sn in self.nodes.values():
                 if sn.node.is_leader:
-                    sb = sn.store.standby_node()
+                    sb = sn.node.standby_node()
                     return sb.unique_name if sb else None
             return None
         if target == "worker":
@@ -1092,6 +1219,10 @@ class LocalCluster:
         copies (capped by cluster size) — and the leader's table
         actually knows every expected file, so the check can't pass
         vacuously on a table that lost entries to churn."""
+        if self.services == "core":
+            # membership-only sim: no stores exist, so replication is
+            # vacuously whatever convergence says
+            return bool(self.converged())
         leader_store = self.any_leader_store()
         if leader_store is None or not self.converged():
             return False
@@ -1188,7 +1319,7 @@ async def invariant_sweep(
         if outcome is None:
             failures.append(f"job {job_id} never reached a terminal state")
             continue
-        if leader_sn is None:
+        if leader_sn is None or leader_sn.jobs is None:
             continue
         st = leader_sn.jobs.scheduler.job_state(job_id)
         if st is None:
@@ -1233,6 +1364,12 @@ async def invariant_sweep(
         )
     client = cluster.client()
     for name, blob in sorted(seed_files.items()):
+        if client.store is None:
+            failures.append(
+                f"seed file {name} expected but the cluster runs "
+                "without store services"
+            )
+            continue
         try:
             got = await client.store.get_bytes(name, timeout=10.0)
         except Exception as e:
@@ -1249,6 +1386,8 @@ async def invariant_sweep(
     bad_copies = []
     for name, blob in sorted(seed_files.items()):
         for uname in sorted(cluster.nodes):
+            if cluster.nodes[uname].store is None:
+                continue
             st = cluster.nodes[uname].store.store
             if not st.has(name):
                 continue
@@ -1275,18 +1414,22 @@ async def invariant_sweep(
     # node's dispatch/failure-detection/store loops must still be
     # running (a dead dispatcher serves nothing and says nothing)
     dead = []
+    checked = 0
     for uname, sn in sorted(cluster.nodes.items()):
         for t in sn.node._tasks:
             tname = t.get_name()
             if (tname.endswith("-dispatch") or tname.endswith("-fd")) \
                     and t.done():
                 dead.append(f"{uname}:{tname}")
-        rt = sn.store._resend_task
-        if rt is not None and rt.done():
-            dead.append(f"{uname}:store-resend")
+        checked += 2
+        if sn.store is not None:
+            checked += 1
+            rt = sn.store._resend_task
+            if rt is not None and rt.done():
+                dead.append(f"{uname}:store-resend")
     if dead:
         failures.append(f"core coroutines died: {dead}")
-    checks["coroutines_checked"] = 3 * len(cluster.nodes)
+    checks["coroutines_checked"] = checked
 
     # 6. when the plan fuzzed the wire, every guaranteed-malformed
     # datagram must have died in Message.unpack, visibly: the
@@ -1662,8 +1805,14 @@ class ChaosRunner:
 
     async def run(self) -> ChaosReport:
         t_start = asyncio.get_running_loop().time()
+        # headroom scales with N: the bench churn run drives this
+        # with a 64-node cluster whose full convergence legitimately
+        # takes longer than the 5-node plans' (same rule as
+        # control_plane_probe)
         await self.cluster.wait_for(
-            self.cluster.converged, 15.0, "initial convergence"
+            self.cluster.converged,
+            15.0 + 0.3 * len(self.cluster.spec.nodes),
+            "initial convergence",
         )
         # seed the job inputs (the intake samples *.jpeg names from
         # the store) BEFORE any fault fires; they double as the
@@ -1736,9 +1885,13 @@ async def run_plan(
     base_port: int,
     root: Optional[str] = None,
     timing: Timing = FAST_TIMING,
+    services: str = "full",
 ) -> ChaosReport:
     """Bring up a LocalCluster, run the plan, tear down. The one
-    entry point tests, the CLI verb, and the bench section share."""
+    entry point tests, the CLI verb, and the bench section share.
+    ``services`` bounds the per-node stack (see LocalCluster) — plans
+    whose workload is store-only (e.g. big-N churn) run "store" so a
+    64-node sim doesn't pay 64 job-service stacks."""
     own_root = root is None
     root = root or os.path.join(
         "/tmp", f"dml_tpu_chaos_{os.getpid()}_{base_port}"
@@ -1746,7 +1899,8 @@ async def run_plan(
     shutil.rmtree(root, ignore_errors=True)
     os.makedirs(root, exist_ok=True)
     cluster = LocalCluster(
-        plan.n_nodes, root, base_port, seed=plan.seed, timing=timing
+        plan.n_nodes, root, base_port, seed=plan.seed, timing=timing,
+        services=services,
     )
     try:
         await cluster.start()
@@ -1758,5 +1912,255 @@ async def run_plan(
 
 
 def run_plan_sync(plan: ChaosPlan, base_port: int,
-                  root: Optional[str] = None) -> ChaosReport:
-    return asyncio.run(run_plan(plan, base_port, root=root))
+                  root: Optional[str] = None,
+                  timing: Timing = FAST_TIMING,
+                  services: str = "full") -> ChaosReport:
+    return asyncio.run(
+        run_plan(plan, base_port, root=root, timing=timing,
+                 services=services)
+    )
+
+
+# ----------------------------------------------------------------------
+# control-plane scale probe (ROADMAP item 5): how do gossip
+# convergence, failure detection, election, metrics aggregation, and
+# control-plane traffic behave at N ∈ {16, 64, 128}?
+# ----------------------------------------------------------------------
+
+
+async def control_plane_probe(
+    n_nodes: int,
+    base_port: int,
+    root: Optional[str] = None,
+    seed: int = 0,
+    protocol: str = "delta",
+    services: str = "core",
+    timing: Timing = SCALE_TIMING,
+    measure_s: float = 4.0,
+    metrics_relays: Optional[int] = None,
+    converge_timeout: Optional[float] = None,
+) -> Dict[str, Any]:
+    """One scale measurement cycle on an N-node in-process cluster
+    running the given gossip ``protocol`` ("delta" product default |
+    "full" reference baseline):
+
+    1. bring-up → full convergence wall (every node sees every node
+       ALIVE and one agreed leader);
+    2. a steady-state traffic window → control-plane bytes/node/s and
+       packets/node/s (per-transport accounting, so the shared
+       in-process metrics registry can't blur per-node attribution);
+    3. leader metrics aggregation: bounded-concurrency direct pull vs
+       two-level relay fan-out — wall and leader ingress bytes each;
+    4. failure detection: a non-leader crash → wall until EVERY live
+       node stops seeing the victim ALIVE;
+    5. election: leader crash → wall until the survivors reconverge
+       on the new leader.
+
+    Runs ``services="core"`` by default: membership-only nodes (one
+    UDP socket + two coroutines each) keep a 128-node bring-up
+    affordable; the store/jobs planes are scored by the churn run and
+    the small-N sections. All Ns share the same timing envelope, so
+    walls are comparable across N."""
+    own_root = root is None
+    root = root or os.path.join(
+        "/tmp", f"dml_tpu_scale_{os.getpid()}_{base_port}"
+    )
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root, exist_ok=True)
+    cluster = LocalCluster(
+        n_nodes, root, base_port, seed=seed, timing=timing,
+        services=services, gossip_protocol=protocol,
+    )
+    loop = asyncio.get_running_loop()
+    out: Dict[str, Any] = {
+        "n_nodes": n_nodes,
+        "protocol": protocol,
+        "services": services,
+        "timing": {
+            "ping_interval": timing.ping_interval,
+            "cleanup_time": timing.cleanup_time,
+        },
+    }
+
+    async def wait(cond: Callable[[], bool], timeout: float,
+                   what: str, interval: float = 0.1) -> float:
+        # coarser poll than LocalCluster.wait_for: converged() is
+        # O(N^2) per call and a 128-node probe polling at 20 Hz would
+        # measure its own polling
+        t0 = loop.time()
+        deadline = t0 + timeout
+        while loop.time() < deadline:
+            if cond():
+                return loop.time() - t0
+            await asyncio.sleep(interval)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    try:
+        t_up0 = loop.time()
+        await cluster.start()
+        out["bringup_s"] = round(loop.time() - t_up0, 2)
+        conv_to = (
+            converge_timeout if converge_timeout is not None
+            else 30.0 + 0.3 * n_nodes
+        )
+        await wait(cluster.converged, conv_to, "full convergence")
+        out["converge_s"] = round(loop.time() - t_up0, 2)
+
+        # 2. steady-state traffic window
+        def traffic() -> Tuple[int, int]:
+            b = p = 0
+            for sn in cluster.nodes.values():
+                t = sn.node.transport
+                b += t.bytes_sent
+                p += t.packets_sent
+            return b, p
+
+        b0, p0 = traffic()
+        await asyncio.sleep(measure_s)
+        b1, p1 = traffic()
+        out["bytes_per_node_s"] = round(
+            (b1 - b0) / max(1, n_nodes) / measure_s, 1)
+        out["packets_per_node_s"] = round(
+            (p1 - p0) / max(1, n_nodes) / measure_s, 1)
+
+        # 3. metrics aggregation at the leader, healthy cluster:
+        #    direct — bounded-concurrency fan-out;
+        #    relay  — two-level pre-merged aggregation.
+        # Walls are min-of-3 reps: in a one-core sim the per-pull wall
+        # rides event-loop jitter and the background ping bursts, and
+        # a single sample is noise, not protocol.
+        relays = metrics_relays
+        if relays is None:
+            relays = max(2, int(round((n_nodes - 1) ** 0.5)))
+        leader_uname = cluster.leader_uname()
+        leader = cluster.nodes[leader_uname].node if leader_uname else None
+        if leader is not None and leader.transport is not None:
+            # the leader hears background gossip (ring + epidemic
+            # pings) the whole time — sample its ingress rate first
+            # and net it out, or the direct-vs-relay ingress
+            # comparison silently includes whatever PING/ACK traffic
+            # happened to land inside each pull's wall
+            bg0 = leader.transport.bytes_received
+            await asyncio.sleep(1.0)
+            bg_rate = leader.transport.bytes_received - bg0  # bytes/s
+            for label, reps, kw in (
+                ("direct", 3, {"relays": 0, "concurrency": 8}),
+                ("relay", 3, {"relays": relays, "concurrency": 8}),
+            ):
+                wall = None
+                in0 = leader.transport.bytes_received
+                for rep in range(reps):
+                    t0 = loop.time()
+                    view = await leader.pull_cluster_metrics(
+                        timeout=5.0, **kw
+                    )
+                    w = loop.time() - t0
+                    wall = w if wall is None else min(wall, w)
+                    if rep == 0:
+                        ingress = max(
+                            0,
+                            leader.transport.bytes_received - in0
+                            - int(bg_rate * w),
+                        )
+                covered = len(view["nodes"]) + len(
+                    view.get("relay", {}).get("covered", [])
+                )
+                out[f"metrics_{label}"] = {
+                    "wall_s": round(wall, 3),
+                    "leader_ingress_bytes": ingress,
+                    "nodes_covered": covered,
+                    "merged_from": view["cluster"].get("merged_from"),
+                    **(
+                        {"fallbacks": view["relay"]["fallbacks"],
+                         "relays": view["relay"]["relays"]}
+                        if "relay" in view else {}
+                    ),
+                }
+
+        # 4. failure detection: non-leader victim, everyone must see it
+        victim = cluster.resolve_target("worker")
+        if victim is not None:
+            await cluster.crash_node(victim)
+            t0 = loop.time()
+
+            def victim_gone() -> bool:
+                return all(
+                    not sn.node.membership.is_alive(victim)
+                    for sn in cluster.nodes.values()
+                )
+
+            try:
+                await wait(
+                    victim_gone, 30.0 + timing.cleanup_time,
+                    "cluster-wide failure detection", interval=0.05,
+                )
+                out["detect_s"] = round(loop.time() - t0, 2)
+            except AssertionError:
+                out["detect_s"] = None
+
+        # 5. election: kill the leader, survivors reconverge
+        leader_uname = cluster.leader_uname()
+        if leader_uname is not None:
+            await cluster.crash_node(leader_uname)
+            t0 = loop.time()
+            try:
+                await wait(
+                    cluster.converged, 45.0 + timing.cleanup_time,
+                    "post-kill reconvergence",
+                )
+                out["election_s"] = round(loop.time() - t0, 2)
+                out["new_leader"] = cluster.leader_uname()
+            except AssertionError:
+                out["election_s"] = None
+
+        # 6. straggler metrics: THE melt case the metrics rework
+        # exists for — kill several peers, then pull against a frozen
+        # peer list that still includes them (a console on a
+        # slightly-stale view). Serial pays one full timeout PER dead
+        # peer; bounded/relay fan-out overlaps them into ~one timeout.
+        # Victims come from the TAIL of the sorted peer list so the
+        # deterministic relay choice (the head) stays alive.
+        leader_uname = cluster.leader_uname()
+        leader = (
+            cluster.nodes[leader_uname].node if leader_uname else None
+        )
+        if leader is not None and len(cluster.nodes) >= 10:
+            peers = sorted(
+                (
+                    n for n in leader.membership.alive_nodes()
+                    if n.unique_name != leader.me.unique_name
+                ),
+                key=lambda n: n.unique_name,
+            )
+            victims = [
+                p.unique_name for p in peers[-4:]
+                if p.unique_name in cluster.nodes
+            ]
+            for v in victims:
+                await cluster.crash_node(v)
+            straggler_timeout = 1.0
+            strag: Dict[str, Any] = {
+                "dead_peers": len(victims),
+                "timeout_s": straggler_timeout,
+            }
+            for label, kw in (
+                ("serial", {"relays": 0, "concurrency": 1}),
+                ("direct", {"relays": 0, "concurrency": 8}),
+                ("relay", {"relays": relays, "concurrency": 8}),
+            ):
+                t0 = loop.time()
+                await leader.pull_cluster_metrics(
+                    timeout=straggler_timeout, peers=peers, **kw
+                )
+                strag[f"{label}_wall_s"] = round(loop.time() - t0, 3)
+            out["metrics_straggler"] = strag
+        return out
+    finally:
+        await cluster.stop()
+        if own_root:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def control_plane_probe_sync(n_nodes: int, base_port: int,
+                             **kw: Any) -> Dict[str, Any]:
+    return asyncio.run(control_plane_probe(n_nodes, base_port, **kw))

@@ -20,7 +20,9 @@ Semantics preserved from the reference:
 
 from __future__ import annotations
 
+import random
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -42,6 +44,15 @@ _M_FAILED = METRICS.counter(
     "cluster_node_failures_total", "suspects cleaned up as dead")
 _M_ALIVE = METRICS.gauge(
     "cluster_alive_nodes", "members this node currently sees ALIVE")
+# delta-gossip accounting: how many piggyback payloads go out bounded
+# vs full-table, and how many member entries they carry — the
+# per-datagram O(N) vs O(K) story the control_plane_scale bench scores
+_M_GOSSIP_EX = METRICS.counter(
+    "membership_gossip_exchanges_total",
+    "gossip piggyback payloads built, by mode (delta|full)")
+_M_GOSSIP_ENTRIES = METRICS.counter(
+    "membership_gossip_entries_total",
+    "member entries carried by gossip piggybacks, by mode (delta|full)")
 
 
 @dataclass
@@ -76,6 +87,11 @@ class MembershipList:
     #: incarnation numbers; the reference — and this repro — use wall
     #: timestamps, so the clamp is the minimal skew armor.)
     max_future_skew: Optional[float] = None
+    #: seed for the delta-gossip random-tail selection stream; the
+    #: node runtime passes its own seed so one cluster seed
+    #: reproduces every node's piggyback choices (tested:
+    #: same seed ⇒ identical selection stream)
+    gossip_seed: int = 0
 
     def __post_init__(self):
         if self.max_future_skew is None:
@@ -83,6 +99,17 @@ class MembershipList:
         self._members: Dict[str, Tuple[float, int]] = {
             self.me.unique_name: (self._now(), ALIVE)
         }
+        # delta-gossip state: per-entry piggyback count since the
+        # entry last CHANGED (new member, status flip). Fresh entries
+        # (low counts) get piggyback priority; timestamps-only
+        # refreshes don't reset it (steady-state heartbeats ride the
+        # self-entry + random tail + periodic full exchange instead).
+        self._fresh: Dict[str, int] = {}
+        self._gossip_rounds = 0
+        self._gossip_rng = random.Random(
+            zlib.crc32(f"{self.gossip_seed}/{self.me.unique_name}"
+                       .encode()) & 0x7FFFFFFF
+        )
         self._suspect_since: Dict[str, float] = {}
         # tombstones: uname -> last gossip timestamp at cleanup time.
         # Without these, a lagging peer's stale gossip re-adds a cleaned
@@ -113,6 +140,81 @@ class MembershipList:
         membershipList.py:97-101, runs _cleanup on every call)."""
         self.cleanup()
         return dict(self._members)
+
+    def delta_active(self) -> bool:
+        """True when ``gossip()`` is actually bounding its payloads
+        (delta protocol AND the table has outgrown the bound). The
+        node runtime keys its scale behaviors off this — e.g. the
+        extra random-member ping that turns ring-structured gossip
+        spread into an epidemic — so small-N clusters stay
+        bit-compatible with the reference protocol."""
+        return (
+            self.spec.gossip_protocol == "delta"
+            and len(self._members)
+            > 1 + max(0, self.spec.gossip_delta_k)
+            + max(0, self.spec.gossip_delta_tail)
+        )
+
+    def gossip(self) -> Dict[str, Tuple[float, int]]:
+        """The piggyback payload for one PING/ACK.
+
+        In ``full`` mode (or whenever the table is small enough that a
+        bound would be a no-op) this IS ``snapshot()`` — bit-identical
+        to the reference protocol, which is why the small-N tier-1
+        tests pass unmodified. In ``delta`` mode the payload is
+        bounded: our own entry (heartbeat freshness must always
+        propagate), the ``gossip_delta_k`` entries with the highest
+        recent-change priority (fewest piggybacks since their status
+        last changed; newest timestamp, then name, as deterministic
+        tie-breaks), and a seeded random tail of ``gossip_delta_tail``
+        of the rest (the slow anti-entropy that keeps stable entries'
+        timestamps circulating). Every ``gossip_full_every``-th
+        payload is a full table — the bounded-delta analog of SWIM's
+        periodic anti-entropy sync, closing any gap the bounded
+        selection left.
+
+        The receiving side is unchanged: a delta payload is just a
+        partial members dict and ``merge`` is already newest-wins per
+        entry, so delta and full peers interoperate freely."""
+        self.cleanup()
+        spec = self.spec
+        k = max(0, spec.gossip_delta_k)
+        tail = max(0, spec.gossip_delta_tail)
+        if not self.delta_active():
+            out = dict(self._members)
+            _M_GOSSIP_EX.inc(1, mode="full")
+            _M_GOSSIP_ENTRIES.inc(len(out), mode="full")
+            return out
+        self._gossip_rounds += 1
+        if (
+            spec.gossip_full_every > 0
+            and self._gossip_rounds % spec.gossip_full_every == 0
+        ):
+            out = dict(self._members)
+            _M_GOSSIP_EX.inc(1, mode="full")
+            _M_GOSSIP_ENTRIES.inc(len(out), mode="full")
+            return out
+        me = self.me.unique_name
+        others = [u for u in self._members if u != me]
+        # freshness priority: fewest sends since change, then newest
+        # timestamp, then name — a total, deterministic order
+        others.sort(key=lambda u: (
+            self._fresh.get(u, 1 << 30), -self._members[u][0], u
+        ))
+        chosen = others[:k]
+        rest = others[k:]
+        if rest and tail:
+            chosen += self._gossip_rng.sample(rest, min(tail, len(rest)))
+        out = {me: self._members[me]}
+        for u in chosen:
+            out[u] = self._members[u]
+            # only tracked-fresh entries age; a random-tail pick of a
+            # long-stable entry must not be re-minted as "fresh"
+            if u in self._fresh:
+                self._fresh[u] += 1
+        _M_GOSSIP_EX.inc(1, mode="delta")
+        _M_GOSSIP_ENTRIES.inc(len(out), mode="delta")
+        return out
 
     def alive_nodes(self) -> List[NodeId]:
         out = []
@@ -169,6 +271,7 @@ class MembershipList:
                     continue  # stale gossip about a node we already cleaned
                 self._tombstones.pop(uname, None)  # genuinely rejoined
                 self._members[uname] = (ts, status)
+                self._fresh[uname] = 0  # new entry: piggyback priority
                 changed = True
                 if status == SUSPECT:
                     self._suspect_since[uname] = self._now()
@@ -186,6 +289,7 @@ class MembershipList:
                     _M_SUSPECT.inc()
                 if cur[1] != status:
                     changed = True
+                    self._fresh[uname] = 0  # status flip: re-prioritize
                 self._members[uname] = (ts, status)
         if changed:
             self.recompute_ping_targets()
@@ -202,6 +306,7 @@ class MembershipList:
             return
         self._members[unique_name] = (self._now(), SUSPECT)
         self._suspect_since[unique_name] = self._now()
+        self._fresh[unique_name] = 0  # the suspicion must spread fast
         _M_SUSPECT.inc()
         self.recompute_ping_targets()
         if self.hooks.on_topology_change:
@@ -220,6 +325,7 @@ class MembershipList:
         self._suspect_since.pop(unique_name, None)
         self._members[unique_name] = (self._now(), ALIVE)
         if changed:
+            self._fresh[unique_name] = 0  # resurrection must spread fast
             self.recompute_ping_targets()
             if self.hooks.on_topology_change:
                 self.hooks.on_topology_change()
@@ -228,6 +334,7 @@ class MembershipList:
         """Voluntary leave (reference CLI option 4)."""
         self._members.pop(unique_name, None)
         self._suspect_since.pop(unique_name, None)
+        self._fresh.pop(unique_name, None)
         self.recompute_ping_targets()
 
     def reset(self) -> None:
@@ -235,6 +342,7 @@ class MembershipList:
         self._members = {self.me.unique_name: (self._now(), ALIVE)}
         self._suspect_since.clear()
         self._tombstones.clear()
+        self._fresh.clear()
         self.leader = None
         self.recompute_ping_targets()
 
@@ -253,6 +361,7 @@ class MembershipList:
             if ent is not None:
                 self._tombstones[uname] = ent[0]
             self._suspect_since.pop(uname, None)
+            self._fresh.pop(uname, None)
             self.cleaned_since_replication.append(uname)
             if uname == self.leader:
                 self.leader = None

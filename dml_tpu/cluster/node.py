@@ -25,10 +25,13 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import math
+import random
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ..config import ClusterSpec, NodeId
+from ..observability import METRICS
 from .election import Election
 from .membership import MembershipHooks, MembershipList
 from .transport import UdpTransport
@@ -36,6 +39,19 @@ from .util import reap_task
 from .wire import Message, MsgType
 
 log = logging.getLogger(__name__)
+
+# two-level metrics aggregation accounting (Node.pull_cluster_metrics
+# relay mode): shard pulls executed by role, the per-shard wall, and
+# shards that fell back to direct leader pulls after a relay failure
+_M_RELAY_PULLS = METRICS.counter(
+    "metrics_relay_pulls_total",
+    "relay-shard metrics aggregations executed, by role (leader|relay)")
+_M_RELAY_T = METRICS.histogram(
+    "metrics_relay_seconds",
+    "one relay shard: bounded peer pulls + pre-merge wall")
+_M_RELAY_FALLBACK = METRICS.counter(
+    "metrics_relay_fallback_total",
+    "relay shards that failed and fell back to direct leader pulls")
 
 Handler = Callable[[Message, Tuple[str, int]], Awaitable[None]]
 
@@ -56,6 +72,7 @@ class Node:
                 on_node_failed=self._on_node_failed,
                 on_replication_needed=self._on_replication_needed,
             ),
+            gossip_seed=seed,
         )
         self.election = Election(spec, me)
         self.joined = False
@@ -65,10 +82,20 @@ class Node:
         self._pending: Dict[str, asyncio.Future] = {}
         self._rid_counter = itertools.count(1)
         self._tasks: List[asyncio.Task] = []
+        # short-lived background work spawned by handlers (e.g. a
+        # relay-shard metrics pull, which must NOT run inline in the
+        # dispatch loop — it awaits replies that arrive through that
+        # same loop). Self-pruning; reaped at stop().
+        self._bg_tasks: set = set()
         self._introducer_reg_task: Optional[asyncio.Task] = None
         self._stopped = asyncio.Event()
         self._left = False
         self._probe_idx = 0  # anti-entropy probe round-robin cursor
+        # seeded chooser for the delta-mode random gossip target (one
+        # extra ping per tick at scale; see _random_gossip_target)
+        self._gossip_rng = random.Random(
+            (seed * 2654435761 + self.me.port) & 0x7FFFFFFF
+        )
         # services hook these (wired by store/job services at attach)
         self.on_node_failed_cbs: List[Callable[[str], None]] = []
         self.on_coordinate_ack_cbs: List[Callable[[str, Dict], None]] = []
@@ -105,6 +132,9 @@ class Node:
             # `except (CancelledError, Exception)` swallowed them)
             await reap_task(t, self.me, f"task {t.get_name()}")
         self._tasks = []
+        for t in list(self._bg_tasks):
+            await reap_task(t, self.me, f"bg task {t.get_name()}")
+        self._bg_tasks.clear()
         if self.transport is not None:
             self.transport.close()
             self.transport = None
@@ -182,6 +212,18 @@ class Node:
     def is_leader(self) -> bool:
         return self.joined and self.membership.leader == self.me.unique_name
 
+    def standby_node(self) -> Optional[NodeId]:
+        """The hot standby: the would-be election winner if the
+        leader died now (reference hardcodes H2; we compute it). The
+        ONE definition — the store's failover relays and the chaos
+        engine's target resolution both delegate here, so the rule
+        can't drift between them."""
+        alive = [
+            n for n in self.membership.alive_nodes()
+            if n.unique_name != self.me.unique_name
+        ]
+        return self.spec.election_winner(alive)
+
     async def leader_request(
         self, mtype: MsgType, data: Dict[str, Any], timeout: Optional[float] = None
     ) -> Dict[str, Any]:
@@ -232,10 +274,47 @@ class Node:
 
     async def _ping_round(self) -> None:
         targets = self.membership.ping_targets
-        gossip = self.membership.snapshot()
+        extra = self._random_gossip_targets(targets)
+        if extra:
+            targets = targets + extra
+        # bounded piggyback (full table in "full" mode / at small N /
+        # on the periodic anti-entropy round) — built ONCE per round,
+        # shared by every target, like the reference
+        gossip = self.membership.gossip()
         await asyncio.gather(
             *(self._ping_one(t, gossip) for t in targets), return_exceptions=True
         )
+
+    def _random_gossip_targets(
+        self, ring_targets: List[NodeId]
+    ) -> List[NodeId]:
+        """Seeded-random ALIVE members pinged on top of the ring
+        successors — only while the bounded delta protocol is active
+        (``MembershipList.delta_active``; small-N clusters stay
+        bit-compatible with the reference's pure ring pings).
+
+        Ring-structured gossip spreads a status change LINEARLY in N
+        (each tick pushes it ring_k hops along the ring): at 128
+        nodes a suspicion took ~N/ring_k ticks to reach everyone and
+        cluster-wide failure detection scaled with N. Random peers
+        per tick make the spread an epidemic — O(log N) rounds —
+        which is exactly SWIM's random-member probe; the ring pings
+        remain the deterministic failure-detection backbone. One
+        random target suffices for the epidemic exponent; a second
+        joins past ~64 alive members to keep the constant factor (and
+        with it cluster-wide failure-detection latency) flat in N."""
+        if not self.membership.delta_active():
+            return []
+        exclude = {t.unique_name for t in ring_targets}
+        exclude.add(self.me.unique_name)
+        candidates = [
+            n for n in self.membership.alive_nodes()
+            if n.unique_name not in exclude
+        ]
+        if not candidates:
+            return []
+        want = min(len(candidates), 2 if len(candidates) > 64 else 1)
+        return self._gossip_rng.sample(candidates, want)
 
     async def _ping_one(self, target: NodeId, gossip: Dict[str, Any]) -> None:
         """One ping + ACK wait (reference check/_wait,
@@ -251,6 +330,12 @@ class Node:
             self._missed_acks[uname] = self._missed_acks.get(uname, 0) + 1
             if self._missed_acks[uname] > self.spec.timing.missed_acks_to_suspect:
                 log.info("%s: suspecting %s", self.me, uname)
+                if log.isEnabledFor(logging.DEBUG):
+                    # the table render is O(N) string work — at 128
+                    # nodes that's real money on a hot path, so it is
+                    # never built unless DEBUG is actually on
+                    log.debug("%s membership table:\n%s",
+                              self.me, self.membership.format())
                 self.membership.suspect(uname)
                 self._missed_acks[uname] = 0
         finally:
@@ -472,24 +557,50 @@ class Node:
         self.register(MsgType.COORDINATE, self._h_coordinate)
         self.register(MsgType.COORDINATE_ACK, self._h_coordinate_ack)
         self.register(MsgType.METRICS_PULL, self._h_metrics_pull)
+        self.register(MsgType.METRICS_RELAY_PULL, self._h_metrics_relay)
 
-    async def _h_metrics_pull(self, msg: Message, addr) -> None:
-        """Reply with this process's metrics-registry snapshot (the
-        node-side half of the leader-aggregated cluster view),
-        degrading to fit the UDP frame cap: full snapshot -> bucket-
-        stripped (mean/count survive, percentiles drop for this node
-        only) -> counters+gauges only -> an explicit error reply. A
+    def _spawn_bg(self, coro: Awaitable, name: str) -> asyncio.Task:
+        """Background task spawned from a handler: held (never naked),
+        self-pruning, reaped at stop(), exceptions logged."""
+
+        async def guarded() -> None:
+            try:
+                await coro
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("%s: bg task %s failed", self.me, name)
+
+        t = asyncio.create_task(guarded(), name=name)
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return t
+
+    def _send_metrics_tiered(
+        self,
+        to_unique: str,
+        mtype: MsgType,
+        snap: Dict[str, Any],
+        extra: Dict[str, Any],
+    ) -> None:
+        """Send a metrics snapshot, degrading to fit the UDP frame
+        cap: full -> bucket-stripped (mean/count survive, percentiles
+        drop) -> counters+gauges only -> an explicit error reply. A
         reply ALWAYS goes out — a node must degrade visibly, never
-        vanish from the cluster view because its registry grew."""
+        vanish from the cluster view because its registry grew. The
+        one shared form for METRICS_PULL_ACK and METRICS_RELAY_ACK."""
         from .. import observability as obs
 
-        rid = msg.data.get("rid")
-        snap = obs.METRICS.snapshot(node=self.me.unique_name)
         tiers = (
             lambda: snap,
             lambda: obs.strip_buckets(snap),
             lambda: {
-                **{k: snap.get(k) for k in ("v", "proc", "ts", "node")},
+                **{
+                    k: snap.get(k)
+                    for k in ("v", "proc", "procs", "ts", "node",
+                              "merged_from")
+                    if k in snap
+                },
                 "counters": snap.get("counters", {}),
                 "gauges": snap.get("gauges", {}),
                 "histograms": {},
@@ -500,19 +611,17 @@ class Node:
         for i, tier in enumerate(tiers):
             try:
                 self.send_unique(
-                    msg.sender,
-                    MsgType.METRICS_PULL_ACK,
-                    {"rid": rid, "ok": True, "metrics": tier()},
+                    to_unique, mtype, {**extra, "ok": True, "metrics": tier()}
                 )
                 if i:
-                    # msg.sender is already the unique_name string
+                    # to_unique is already the unique_name string
                     # (wire.Message contract) — an attribute access
                     # here raised AttributeError and turned every
                     # degraded reply into a handler-failure traceback
                     log.warning(
                         "%s: metrics snapshot over the frame cap, "
                         "degraded to tier %d for %s",
-                        self.me.unique_name, i, msg.sender,
+                        self.me.unique_name, i, to_unique,
                     )
                 return
             except ValueError:
@@ -522,14 +631,126 @@ class Node:
             self.me.unique_name,
         )
         self.send_unique(
-            msg.sender,
-            MsgType.METRICS_PULL_ACK,
-            {"rid": rid, "ok": False,
+            to_unique, mtype,
+            {**extra, "ok": False,
              "error": "metrics snapshot exceeds datagram cap"},
         )
 
+    async def _h_metrics_pull(self, msg: Message, addr) -> None:
+        """Reply with this process's metrics-registry snapshot (the
+        node-side half of the leader-aggregated cluster view)."""
+        from .. import observability as obs
+
+        self._send_metrics_tiered(
+            msg.sender,
+            MsgType.METRICS_PULL_ACK,
+            obs.METRICS.snapshot(node=self.me.unique_name),
+            {"rid": msg.data.get("rid")},
+        )
+
+    async def _h_metrics_relay(self, msg: Message, addr) -> None:
+        """Relay side of two-level aggregation: pull the assigned peer
+        shard (bounded concurrency), pre-merge with our own snapshot,
+        reply one merged blob. The work runs in a BACKGROUND task —
+        inline it would wedge the dispatch loop this relay needs to
+        receive its own METRICS_PULL_ACKs through."""
+        if self.spec.node_by_unique_name(msg.sender) is None:
+            # a forged out-of-universe datagram must not be able to
+            # trigger an O(shard) METRICS_PULL fan-out (amplification)
+            return
+        peers = msg.data.get("peers")
+        if not isinstance(peers, list):
+            return  # byzantine/garbled shard request
+        try:
+            # parsed BEFORE the coroutine is built: junk here must
+            # drop the request, not orphan a never-awaited coroutine
+            timeout = float(msg.data.get("timeout", 3.0))
+        except (TypeError, ValueError):
+            return
+        if not math.isfinite(timeout):
+            return  # NaN/inf deadlines die here, not in wait_for
+        # clamp: a wire-supplied timeout must not pin request futures
+        # (and this bg task) for the node's remaining lifetime
+        timeout = min(max(timeout, 0.1), 30.0)
+        self._spawn_bg(
+            self._relay_shard(
+                msg.sender,
+                msg.data.get("rid"),
+                [p for p in peers if isinstance(p, str)],
+                timeout,
+            ),
+            name=f"{self.me}-metrics-relay",
+        )
+
+    async def _relay_shard(
+        self,
+        requester: str,
+        rid: Any,
+        peers: List[str],
+        timeout: float,
+    ) -> None:
+        from .. import observability as obs
+
+        t0 = time.monotonic()
+        snaps, failed = await self._pull_peer_snapshots(
+            [
+                n for p in peers
+                if (n := self.spec.node_by_unique_name(p)) is not None
+            ],
+            timeout=timeout,
+        )
+        snaps[self.me.unique_name] = obs.METRICS.snapshot(
+            node=self.me.unique_name
+        )
+        merged = obs.merge_snapshots(list(snaps.values()))
+        _M_RELAY_PULLS.inc(1, role="relay")
+        _M_RELAY_T.observe(time.monotonic() - t0)
+        self._send_metrics_tiered(
+            requester,
+            MsgType.METRICS_RELAY_ACK,
+            merged,
+            {"rid": rid, "covered": sorted(snaps), "failed": sorted(failed)},
+        )
+
+    async def _pull_peer_snapshots(
+        self,
+        peers: List[NodeId],
+        timeout: float,
+        concurrency: int = 8,
+    ) -> Tuple[Dict[str, Dict[str, Any]], List[str]]:
+        """Bounded-concurrency METRICS_PULL fan-out: at most
+        `concurrency` requests in flight, so a straggler (or a dead
+        peer's full timeout) costs one slot-wait, not a serial wall —
+        and an O(100)-node pull doesn't burst O(N) datagrams at once.
+        Returns (snapshots by unique name, unreachable peers)."""
+        snaps: Dict[str, Dict[str, Any]] = {}
+        failed: List[str] = []
+        sem = asyncio.Semaphore(max(1, concurrency))
+
+        async def pull_one(peer: NodeId) -> None:
+            async with sem:
+                try:
+                    reply = await self.request(
+                        peer, MsgType.METRICS_PULL, {}, timeout=timeout
+                    )
+                except (asyncio.TimeoutError, TimeoutError):
+                    failed.append(peer.unique_name)
+                    return
+            snap = reply.get("metrics")
+            if isinstance(snap, dict):
+                snaps[peer.unique_name] = snap
+            else:
+                failed.append(peer.unique_name)
+
+        await asyncio.gather(*(pull_one(n) for n in peers))
+        return snaps, failed
+
     async def pull_cluster_metrics(
-        self, timeout: float = 3.0
+        self,
+        timeout: float = 3.0,
+        concurrency: int = 8,
+        relays: int = 0,
+        peers: Optional[List[NodeId]] = None,
     ) -> Dict[str, Any]:
         """Aggregate every alive node's metrics snapshot into one
         cluster view — the TPU-native analog of the reference
@@ -537,12 +758,31 @@ class Node:
         from the leader for the operator console (any node CAN call
         it; the view is the same).
 
+        Direct mode (``relays=0``): pull every peer with bounded
+        concurrency (``concurrency`` in flight at once — one dead
+        peer costs one timeout slot, never a serial wall).
+
+        Relay mode (``relays=R``): two-level fan-out — R relay nodes
+        each pull a shard of the peers and PRE-MERGE it
+        (``observability.merge_snapshots``, same tier-degradation
+        contract), so leader ingress is O(R·merged) instead of
+        O(N·snapshot). A relay that fails falls back to direct pulls
+        of its shard, visible in ``relay.fallbacks``.
+
         Returns ``{"nodes": {unique_name: snapshot}, "cluster":
-        merged, "summary": C2-style roll-up of the merged view}``.
-        Unreachable peers are skipped (their absence is visible as a
-        missing key under ``nodes``). Totals dedupe by producing
-        process, so an in-process simulation's shared registry is
-        counted once (see observability.merge_snapshots)."""
+        merged, "summary": C2-style roll-up, "unreachable": [...],
+        "relay": {...} (relay mode only)}``. In relay mode ``nodes``
+        holds only the directly-pulled snapshots (shard members are
+        pre-merged inside their relay's blob; their names appear
+        under ``covered``). Totals dedupe by producing process, so an
+        in-process simulation's shared registry is counted once (see
+        observability.merge_snapshots).
+
+        ``peers`` pins the peer set explicitly (default: the current
+        ALIVE view) — the scale probe uses it to measure straggler
+        behavior against a frozen list that includes just-killed
+        nodes, the way a console pulling on a slightly-stale view
+        does."""
         from .. import observability as obs
 
         snaps: Dict[str, Dict[str, Any]] = {
@@ -550,29 +790,118 @@ class Node:
                 node=self.me.unique_name
             )
         }
-
-        async def pull_one(peer: NodeId) -> None:
-            try:
-                reply = await self.request(
-                    peer, MsgType.METRICS_PULL, {}, timeout=timeout
-                )
-            except (asyncio.TimeoutError, TimeoutError):
-                return
-            snap = reply.get("metrics")
-            if isinstance(snap, dict):
-                snaps[peer.unique_name] = snap
-
-        await asyncio.gather(*(
-            pull_one(n)
-            for n in self.membership.alive_nodes()
-            if n.unique_name != self.me.unique_name
-        ))
-        merged = obs.merge_snapshots(list(snaps.values()))
-        return {
+        if peers is None:
+            peers = self.membership.alive_nodes()
+        peers = sorted(
+            (n for n in peers if n.unique_name != self.me.unique_name),
+            key=lambda n: n.unique_name,
+        )
+        failed: List[str] = []
+        relay_info: Optional[Dict[str, Any]] = None
+        blobs: List[Dict[str, Any]] = []
+        if relays > 0 and len(peers) > relays:
+            blobs, snaps2, failed, relay_info = await self._pull_via_relays(
+                peers, relays, timeout, concurrency
+            )
+            snaps.update(snaps2)
+        elif peers:
+            direct, failed = await self._pull_peer_snapshots(
+                peers, timeout=timeout, concurrency=concurrency
+            )
+            snaps.update(direct)
+        merged = obs.merge_snapshots(list(snaps.values()) + blobs)
+        out: Dict[str, Any] = {
             "nodes": snaps,
             "cluster": merged,
             "summary": obs.summarize_snapshot(merged),
+            "unreachable": sorted(failed),
         }
+        if relay_info is not None:
+            out["relay"] = relay_info
+        return out
+
+    async def _pull_via_relays(
+        self,
+        peers: List[NodeId],
+        relays: int,
+        timeout: float,
+        concurrency: int,
+    ) -> Tuple[
+        List[Dict[str, Any]],
+        Dict[str, Dict[str, Any]],
+        List[str],
+        Dict[str, Any],
+    ]:
+        """Two-level fan-out: deterministic relay choice (first R
+        peers by unique name), round-robin shard assignment, one
+        METRICS_RELAY_PULL per relay, direct-pull fallback per failed
+        relay shard. Returns (pre-merged relay blobs, directly-pulled
+        snapshots, unreachable peers, relay stats)."""
+        relay_nodes = peers[:relays]
+        rest = peers[relays:]
+        shards: Dict[str, List[NodeId]] = {
+            r.unique_name: [] for r in relay_nodes
+        }
+        for i, p in enumerate(rest):
+            shards[relay_nodes[i % len(relay_nodes)].unique_name].append(p)
+        blobs: List[Dict[str, Any]] = []
+        direct: Dict[str, Dict[str, Any]] = {}
+        failed: List[str] = []
+        covered: List[str] = []
+        fallbacks = 0
+
+        async def pull_relay(relay: NodeId) -> None:
+            nonlocal fallbacks
+            shard = shards[relay.unique_name]
+            try:
+                # the relay's worst-case shard wall is one `timeout`
+                # wave per concurrency batch (its bounded pull runs 8
+                # at a time) — budget for that plus wire margin, or a
+                # healthy relay on a sickly shard gets misclassified
+                # as failed and its shard double-pulled
+                waves = max(1, -(-len(shard) // 8))
+                reply = await self.request(
+                    relay,
+                    MsgType.METRICS_RELAY_PULL,
+                    {
+                        "peers": [p.unique_name for p in shard],
+                        "timeout": timeout,
+                    },
+                    timeout=timeout * (waves + 1) + 1.0,
+                )
+            except (asyncio.TimeoutError, TimeoutError):
+                reply = {}
+            blob = reply.get("metrics")
+            if isinstance(blob, dict) and reply.get("ok"):
+                blobs.append(blob)
+                covered.extend(
+                    c for c in reply.get("covered", [])
+                    if isinstance(c, str)
+                )
+                failed.extend(
+                    c for c in reply.get("failed", [])
+                    if isinstance(c, str)
+                )
+                return
+            # the relay itself is down/degraded: pull its whole shard
+            # (and the relay) directly so the view loses nothing
+            fallbacks += 1
+            _M_RELAY_FALLBACK.inc()
+            got, bad = await self._pull_peer_snapshots(
+                [relay] + shard, timeout=timeout, concurrency=concurrency
+            )
+            direct.update(got)
+            failed.extend(bad)
+
+        _M_RELAY_PULLS.inc(1, role="leader")
+        await asyncio.gather(*(pull_relay(r) for r in relay_nodes))
+        info = {
+            "relays": len(relay_nodes),
+            "relay_nodes": [r.unique_name for r in relay_nodes],
+            "covered": sorted(set(covered)),
+            "fallbacks": fallbacks,
+        }
+        return blobs, direct, failed, info
 
     async def _h_ping(self, msg: Message, addr) -> None:
         """Merge piggybacked gossip, ACK with our own (reference PING
@@ -590,7 +919,7 @@ class Node:
         self.send_unique(
             msg.sender,
             MsgType.ACK,
-            {"members": self.membership.snapshot(), "leader": self.membership.leader},
+            {"members": self.membership.gossip(), "leader": self.membership.leader},
         )
 
     async def _h_ack(self, msg: Message, addr) -> None:

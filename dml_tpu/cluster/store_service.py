@@ -42,6 +42,7 @@ import logging
 import os
 import time
 import uuid
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..config import ClusterSpec, NodeId, StoreConfig
@@ -76,6 +77,28 @@ _M_REPL_FAIL = METRICS.counter(
 _M_REPL_T = METRICS.histogram(
     "store_replication_seconds",
     "one repair pull (every version of one file from a survivor)")
+# replica re-report accounting: the O(100)-node fan-in story — steady
+# state sends small deltas (or nothing), full tables only at the
+# periodic anti-entropy / after a leader change
+_M_REPORT = METRICS.counter(
+    "store_report_delta_total",
+    "inventory re-reports sent to the leader, by kind (delta|full)")
+_M_REPORT_ENTRIES = METRICS.counter(
+    "store_report_delta_entries_total",
+    "inventory entries carried by re-reports, by kind (delta|full)")
+_M_REPORT_SKIP = METRICS.counter(
+    "store_report_delta_skipped_total",
+    "re-report ticks that sent nothing (inventory unchanged)")
+
+#: every Nth re-report is a FULL table (anti-entropy): deltas assume
+#: the leader still holds our last report, and a leader that silently
+#: lost it (partition cleanup, table pressure) must re-learn within a
+#: bounded number of report periods
+REPORT_FULL_EVERY = 5
+#: re-report period in resend-loop ticks; each node's phase within the
+#: period is jittered by its identity so O(100) replicas don't
+#: synchronize their fan-in at the leader
+REPORT_EVERY_TICKS = 20
 
 # the TCP data plane listens at udp_port + this offset on each node
 DATA_PORT_OFFSET = 10_000
@@ -120,6 +143,20 @@ class StoreService:
         # (file, target) -> ask time for outstanding REPLICATE_FILEs
         # (sweeps must not duplicate in-flight transfers)
         self._repairs_inflight: Dict[Tuple[str, str], float] = {}
+        # replica re-report state: the last inventory we reported (and
+        # to whom), so steady-state ticks send DELTAS — or nothing —
+        # instead of the full table; identity-derived phase jitter
+        # desynchronizes the cluster-wide fan-in
+        self._report_phase = (
+            zlib.crc32(node.me.unique_name.encode()) % REPORT_EVERY_TICKS
+        )
+        self._last_report: Optional[Dict[str, List[int]]] = None
+        self._last_report_leader: Optional[str] = None
+        self._reports_since_full = 0
+        # a NEW leader's table is rebuilt from COORDINATE_ACKs (single
+        # unacked datagrams) — our next report must be a full one, not
+        # a delta against state the new leader never had
+        node.on_new_leader_cbs.append(self._on_new_leader_force_full)
 
     async def start(self) -> None:
         await self.data_plane.start()
@@ -152,7 +189,10 @@ class StoreService:
             tick += 1
             if not self.node.is_leader:
                 leader = self.node.leader_unique
-                if tick % 20 == 0 and self.node.joined and leader:
+                if (
+                    (tick + self._report_phase) % REPORT_EVERY_TICKS == 0
+                    and self.node.joined and leader
+                ):
                     self._send_inventory_report(leader)
                 continue
             if tick % 10 == 0:
@@ -178,13 +218,14 @@ class StoreService:
             except Exception:
                 log.exception("%s: store resend tick failed", self._me)
 
-    def _send_inventory_report(self, leader: str) -> None:
-        """Report the local inventory, chunked to fit the datagram cap
-        — a big store must not lose the metadata-hole protection the
-        periodic re-report exists for. Chunks carry ``partial`` so the
-        leader MERGES them (an authoritative overwrite per chunk would
-        erase the other chunks' entries)."""
-        inv = self.store.inventory()
+    def _on_new_leader_force_full(self, leader: str) -> None:
+        self._last_report = None
+
+    @staticmethod
+    def _chunk_inventory(
+        inv: Dict[str, List[int]]
+    ) -> List[Dict[str, List[int]]]:
+        """Split an inventory into datagram-sized chunks."""
         chunk: Dict[str, List[int]] = {}
         chunks = [chunk]
         budget = 0
@@ -196,7 +237,87 @@ class StoreService:
                 budget = 0
             chunk[f] = vs
             budget += cost
+        return chunks
+
+    def _send_inventory_report(self, leader: str) -> None:
+        """Report the local inventory, chunked to fit the datagram cap
+        — a big store must not lose the metadata-hole protection the
+        periodic re-report exists for.
+
+        Steady state sends DELTAS: only entries that changed since the
+        last report (plus explicit removals), or nothing at all when
+        the inventory is unchanged — at O(100) nodes the synchronized
+        full-table fan-in was the leader's single hottest ingress.
+        Every ``REPORT_FULL_EVERY``-th report — and the first one to a
+        NEW leader — is a full table (anti-entropy): deltas assume the
+        leader still holds our previous report, and one that silently
+        lost it must re-learn within a bounded number of periods.
+        Full-report chunks carry ``partial`` so the leader MERGES them
+        (an authoritative overwrite per chunk would erase the other
+        chunks' entries); delta chunks are merges by construction."""
+        inv = {f: sorted(vs) for f, vs in self.store.inventory().items()}
+        full = (
+            self._last_report is None
+            or leader != self._last_report_leader
+            or self._reports_since_full >= REPORT_FULL_EVERY - 1
+        )
+        if not full:
+            last = self._last_report or {}
+            adds = {f: vs for f, vs in inv.items() if last.get(f) != vs}
+            removed = sorted(f for f in last if f not in inv)
+            if not adds and not removed:
+                self._reports_since_full += 1
+                _M_REPORT_SKIP.inc()
+                return
+            ok = True
+            for i, ch in enumerate(self._chunk_inventory(adds)):
+                payload: Dict[str, Any] = {"files": ch, "delta": True}
+                if i == 0 and removed:
+                    payload["removed"] = removed
+                try:
+                    self.node.send_unique(
+                        leader, MsgType.ALL_LOCAL_FILES, payload
+                    )
+                except ValueError:
+                    ok = False
+            if not ok:
+                # an unsendable delta chunk means the leader's view of
+                # us may now be stale in a way later deltas can't fix:
+                # force the next report to be a full table
+                self._last_report = None
+                log.warning(
+                    "%s: inventory delta exceeds the datagram cap; "
+                    "forcing a full re-report", self._me,
+                )
+                return
+            self._last_report = inv
+            self._reports_since_full += 1
+            _M_REPORT.inc(1, kind="delta")
+            _M_REPORT_ENTRIES.inc(len(adds) + len(removed), kind="delta")
+            return
+        chunks = self._chunk_inventory(inv)
         partial = len(chunks) > 1
+        sent_all = True
+        if partial:
+            # partial chunks MERGE at the leader (add-only), so a
+            # removal whose delta datagram was lost would otherwise
+            # never be repaired for an inventory too big for one
+            # frame: a leading datagram carries the COMPLETE name
+            # list (names alone are ~20 bytes each — thousands fit)
+            # so the leader can prune entries we no longer hold
+            try:
+                self.node.send_unique(
+                    leader, MsgType.ALL_LOCAL_FILES,
+                    {"files": {}, "partial": True,
+                     "all_names": sorted(inv)},
+                )
+            except ValueError:
+                # absurd name count: anti-entropy degrades to
+                # add-only for this report (logged, not fatal)
+                log.warning(
+                    "%s: inventory name list exceeds the datagram "
+                    "cap; full report is add-only", self._me,
+                )
         for ch in chunks:
             try:
                 self.node.send_unique(
@@ -205,10 +326,23 @@ class StoreService:
                     else {"files": ch},
                 )
             except ValueError:  # a single entry beyond the frame cap
+                sent_all = False
                 log.warning(
                     "%s: inventory chunk exceeds the datagram cap; "
                     "re-report incomplete", self._me,
                 )
+        # deltas may only build on a full report that actually went
+        # out whole (best-effort UDP loss is covered by the periodic
+        # full anti-entropy; a locally-failed send is not) — and the
+        # counters/anti-entropy clock only advance for a full report
+        # that actually left whole, or the fan-in accounting would
+        # record deliveries the leader never got
+        self._last_report = inv if sent_all else None
+        self._last_report_leader = leader
+        if sent_all:
+            self._reports_since_full = 0
+            _M_REPORT.inc(1, kind="full")
+            _M_REPORT_ENTRIES.inc(len(inv), kind="full")
 
     # ------------------------------------------------------------------
     # helpers
@@ -222,14 +356,9 @@ class StoreService:
         return [n.unique_name for n in self.node.membership.alive_nodes()]
 
     def standby_node(self) -> Optional[NodeId]:
-        """The hot standby: the would-be election winner if the leader
-        died now (reference hardcodes H2; we compute it)."""
-        alive = [
-            n
-            for n in self.node.membership.alive_nodes()
-            if n.unique_name != self._me
-        ]
-        return self.node.spec.election_winner(alive)
+        """The hot standby (delegates to Node.standby_node — one
+        definition of the would-be election winner)."""
+        return self.node.standby_node()
 
     def _relay_to_standby(self, mtype: MsgType, data: Dict[str, Any]) -> None:
         sb = self.standby_node()
@@ -526,16 +655,48 @@ class StoreService:
                 {"file": f, "rid": self.node.new_rid()},
             )
         cur = self.metadata.files.get(msg.sender)
-        if msg.data.get("partial"):
+        if msg.data.get("delta"):
+            # delta re-report: changed entries + explicit removals,
+            # applied over whatever we hold for the sender. A delta
+            # landing on a leader with NO base (e.g. the table entry
+            # was dropped) still merges its adds; the sender's
+            # periodic full anti-entropy closes any remaining gap.
+            base = dict(cur or {})
+            changed = False
+            removed = msg.data.get("removed") or []
+            for f in removed:
+                if isinstance(f, str) and base.pop(f, None) is not None:
+                    changed = True
+            for f, vs in files.items():
+                svs = sorted(vs)
+                if base.get(f) != svs:
+                    base[f] = svs
+                    changed = True
+            if not changed:
+                return  # duplicate/out-of-date delta: nothing new
+            files = base
+        elif msg.data.get("partial"):
             # one chunk of a multi-datagram report: merge, never
             # overwrite (the other chunks' entries must survive).
-            # Partial reports only ADD/refresh; removals ride the
+            # Chunks only ADD/refresh; removals arrive via the
+            # leading all_names datagram (the sender's complete name
+            # list — anything we hold beyond it is stale) or the
             # delete fan-out and failure paths.
-            if cur is not None and all(
-                cur.get(f) == sorted(vs) for f, vs in files.items()
-            ):
-                return  # chunk already reflected
-            files = {**(cur or {}), **files}
+            names = msg.data.get("all_names")
+            if isinstance(names, list):
+                keep = {n for n in names if isinstance(n, str)}
+                pruned = {
+                    f: vs for f, vs in (cur or {}).items() if f in keep
+                }
+                if pruned == (cur or {}) and not files:
+                    return  # nothing stale, nothing new
+                files = {**pruned, **files}
+            else:
+                if cur is not None and all(
+                    cur.get(f) == sorted(vs) for f, vs in files.items()
+                ):
+                    return  # chunk already reflected
+                files = {**(cur or {}), **files}
         elif files == cur:
             return  # steady-state re-report: nothing changed
         self.metadata.set_node_inventory(msg.sender, files)

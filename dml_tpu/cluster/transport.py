@@ -167,9 +167,15 @@ class UdpTransport(asyncio.DatagramProtocol):
         self._loss = LossInjector(drop_pct if testing else 0.0, seed)
         self._queue: asyncio.Queue[Tuple[Message, Tuple[str, int]]] = asyncio.Queue()
         self._transport: Optional[asyncio.DatagramTransport] = None
-        # accounting (reference protocol.py:72-74; CLI option 9)
+        # accounting (reference protocol.py:72-74; CLI option 9).
+        # Receive-side totals live PER TRANSPORT (not only in the
+        # shared registry): an in-process scale sim runs every node
+        # over one registry, so per-node ingress attribution — e.g.
+        # the leader's METRICS_PULL fan-in bytes — needs these.
         self.bytes_sent = 0
         self.packets_sent = 0
+        self.bytes_received = 0
+        self.packets_received = 0
         self.packets_dropped = 0
         self.first_send_time: Optional[float] = None
         # fault-injection seam: network-partition simulation. When
@@ -216,6 +222,8 @@ class UdpTransport(asyncio.DatagramProtocol):
             self.malformed_dropped += 1
             _M_MALFORMED.inc()
             return
+        self.bytes_received += len(data)
+        self.packets_received += 1
         _M_RECV.inc(1, type=msg.type.name)
         _M_RECV_BYTES.inc(len(data), type=msg.type.name)
         self._queue.put_nowait((msg, addr))

@@ -124,6 +124,18 @@ class MsgType(enum.IntEnum):
     # observability.merge_snapshots.
     METRICS_PULL = 80
     METRICS_PULL_ACK = 81
+    # two-level metrics aggregation (the O(100)-node form of the
+    # cluster view): the leader asks R relay nodes to each pull a
+    # SHARD of peers and pre-merge the snapshots with
+    # observability.merge_snapshots before replying, so leader
+    # ingress drops from O(N·snapshot) to O(R·merged) and a straggler
+    # costs one relay timeout, not a serial wall. The ACK carries the
+    # pre-merged blob (same tier-by-tier degradation as
+    # METRICS_PULL_ACK) + which peers it covers; it is deliberately
+    # unregistered — the dispatcher's rid fallback resolves the
+    # leader's awaiting request future.
+    METRICS_RELAY_PULL = 82
+    METRICS_RELAY_ACK = 83
     # request front door (L9, dml_tpu/ingress/): per-request ingress
     # with SLO classes. SUBMIT carries one request (model, slo class,
     # optional inline payload / store input / session id / stream
@@ -242,6 +254,8 @@ HANDLER_OWNERS: Dict["MsgType", str] = {
     # observability
     MsgType.METRICS_PULL: "Node",
     MsgType.METRICS_PULL_ACK: RID_FALLBACK,
+    MsgType.METRICS_RELAY_PULL: "Node",
+    MsgType.METRICS_RELAY_ACK: RID_FALLBACK,
     # request front door (90-96): the full ingress range audited —
     # SUBMIT/STATUS/DONE/STREAM_READY/RELAY are RequestRouter
     # handlers on every node (the role activates with leadership but

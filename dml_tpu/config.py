@@ -194,6 +194,22 @@ class ClusterSpec:
     mesh: MeshSpec = field(default_factory=MeshSpec)
     testing: bool = False
     packet_drop_pct: float = 0.0  # loss-injection seam (reference protocol.py:10)
+    # ---- gossip piggyback protocol (cluster/membership.py) ----
+    # "delta": every PING/ACK carries a BOUNDED member subset — the
+    # sender's own entry, the `gossip_delta_k` entries with the
+    # highest recent-change priority (fewest piggybacks since their
+    # status last changed, newest timestamp first), and a seeded
+    # random tail of `gossip_delta_tail` others — with the FULL table
+    # exchanged only at join (INTRODUCE_ACK), at the dead-peer
+    # anti-entropy probe, and every `gossip_full_every`-th piggyback.
+    # "full": the reference full-table piggyback (O(N) entries per
+    # datagram — the measured baseline the scale bench scores
+    # against). At small N (≤ 1 + k + tail members) delta mode emits
+    # the full table anyway, so the protocols are bit-identical there.
+    gossip_protocol: str = "delta"
+    gossip_delta_k: int = 8
+    gossip_delta_tail: int = 4
+    gossip_full_every: int = 20
     # >0: the coordinator snapshots scheduler state into the store
     # every N seconds while jobs are in flight (full-restart survival
     # without operator-driven checkpoint-jobs); 0 disables

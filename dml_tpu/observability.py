@@ -88,7 +88,15 @@ continuous-batch-formation quality, and
 ``request_stream_tokens_total`` LM tokens pushed into per-request
 data-plane token streams on workers),
 ``cluster_*`` (SWIM suspicion/failure/false-positive events,
-alive-node gauge), ``transport_*`` (datagram + byte counters by
+alive-node gauge), ``membership_gossip_*`` (the bounded delta-gossip
+piggyback: payloads built and member entries carried, labeled
+``mode=`` delta|full — the O(K)-vs-O(N) per-datagram story the
+``control_plane_scale`` bench scores), ``metrics_relay_*`` (two-level
+METRICS_PULL aggregation: relay-shard pulls by ``role=`` leader|relay,
+per-shard wall, and shards that fell back to direct pulls),
+``store_report_delta_*`` (the replica inventory re-report fan-in:
+reports and entries by ``kind=`` delta|full plus unchanged ticks that
+sent nothing), ``transport_*`` (datagram + byte counters by
 message type), and ``store_*`` (put/get/replication timing and
 counts).
 
@@ -168,6 +176,11 @@ line when you add the metric.
     lm_sharded_batches_total         LM batches on a group engine by mode
     lm_sharded_prefill_slabs_total   KV slabs built by prefill workers
     lm_sharded_tokens_total          tokens from group-sharded serving
+    membership_gossip_entries_total  gossip entries carried by mode
+    membership_gossip_exchanges_total  gossip payloads built by mode
+    metrics_relay_fallback_total     relay shards fallen back to direct
+    metrics_relay_pulls_total        relay-shard aggregations by role
+    metrics_relay_seconds            relay shard pull + pre-merge wall
     request_admitted_total           front-door admissions per SLO class
     request_batch_fill_fraction      formed-batch fill quality
     request_batch_formation_seconds  batch formation wall
@@ -189,6 +202,9 @@ line when you add the metric.
     store_replication_failures_total replication attempts failed
     store_replication_seconds        replication wall
     store_replications_total         replication operations
+    store_report_delta_entries_total re-report entries carried by kind
+    store_report_delta_skipped_total re-report ticks with nothing to say
+    store_report_delta_total         inventory re-reports by kind
     store_write_failures_total       local write failures (ENOSPC etc.)
     transport_bytes_received_total   datagram bytes in by msg type
     transport_bytes_sent_total       datagram bytes out by msg type
@@ -709,7 +725,17 @@ def merge_snapshots(
     ``dedupe_by_proc`` counts each producing PROCESS once: in-process
     simulations run every node over one shared registry, and summing
     N identical copies would report an N× phantom cluster. Real
-    deployments are one process per node, so nothing is dropped."""
+    deployments are one process per node, so nothing is dropped.
+
+    Inputs may themselves be MERGED blobs (the two-level relay
+    aggregation pre-merges each shard): such a blob carries ``procs``
+    (every process it folded) instead of ``proc``, and is skipped
+    only when EVERY one of its processes was already counted — so an
+    in-process sim's relay blobs dedupe against the leader's own
+    snapshot exactly like direct pulls do, while real multi-process
+    shards all count. The output carries ``procs`` and a
+    ``merged_from`` that sums nested counts, keeping the node count
+    honest through both aggregation levels."""
     out: Dict[str, Any] = {
         "v": 1,
         "counters": {},
@@ -719,12 +745,14 @@ def merge_snapshots(
     }
     seen_procs = set()
     for snap in snaps:
-        proc = snap.get("proc")
-        if dedupe_by_proc and proc is not None:
-            if proc in seen_procs:
-                continue
-            seen_procs.add(proc)
-        out["merged_from"] += 1
+        procs = snap.get("procs")
+        if not isinstance(procs, list):
+            proc = snap.get("proc")
+            procs = [proc] if proc is not None else []
+        if dedupe_by_proc and procs and all(p in seen_procs for p in procs):
+            continue
+        seen_procs.update(procs)
+        out["merged_from"] += int(snap.get("merged_from", 1) or 1)
         for k, v in snap.get("counters", {}).items():
             out["counters"][k] = out["counters"].get(k, 0.0) + v
         for k, v in snap.get("gauges", {}).items():
@@ -757,6 +785,7 @@ def merge_snapshots(
             cur["bkt_count"] += h.get("bkt_count", h.get("count", 0))
             for i, c in h.get("bkt", {}).items():
                 cur["bkt"][i] = cur["bkt"].get(i, 0) + c
+    out["procs"] = sorted(seen_procs)
     return out
 
 

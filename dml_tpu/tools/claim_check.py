@@ -352,7 +352,11 @@ CHAOS_SCENARIOS_REQUIRED_FROM_ROUND = 8
 #: the adversarial families the bench must sweep (mirror of
 #: cluster/chaos.py SCENARIO_FAMILIES — kept literal here so this
 #: tool stays importable without the cluster stack)
-CHAOS_SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz")
+CHAOS_SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz", "churn")
+
+#: "churn" (sustained seeded join/leave) landed with the round-12
+#: control-plane scale work; earlier artifacts predate the family
+CHAOS_CHURN_REQUIRED_FROM_ROUND = 12
 
 
 def check_chaos_block(path: str) -> List[str]:
@@ -410,6 +414,12 @@ def check_chaos_block(path: str) -> List[str]:
         )
         return problems
     for fam in CHAOS_SCENARIO_FAMILIES:
+        if (
+            fam == "churn"
+            and rnd is not None
+            and rnd < CHAOS_CHURN_REQUIRED_FROM_ROUND
+        ):
+            continue  # the family predates this artifact
         entry = scenarios.get(fam)
         if not isinstance(entry, dict):
             problems.append(f"{name}: chaos.scenarios[{fam!r}] missing")
@@ -1091,6 +1101,157 @@ def run_lint_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# round-12 control-plane scale: the `control_plane_scale` bench
+# section scores the delta-gossip + relay-metrics protocol against
+# the reference full-table protocol at N in {16, 64, 128} and sweeps
+# a sustained-churn invariant run (bench _bench_control_plane_scale;
+# ISSUE 11 tentpole)
+# ----------------------------------------------------------------------
+
+#: first round whose bench must carry the control_plane_scale section
+SCALE_REQUIRED_FROM_ROUND = 12
+
+#: big-N failure detection may be at most this multiple of small-N
+SCALE_DETECT_RATIO_MAX = 1.5
+
+
+def check_scale_block(path: str) -> List[str]:
+    """Validate the ``control_plane_scale`` section WHEN IT RAN:
+
+    - the scored walls (convergence, failure detection, election at
+      the biggest N under the delta protocol) are finite and
+      positive — a probe that timed out records None and is a
+      violation, not a skip;
+    - the delta protocol's control-plane bytes/node/s is STRICTLY
+      below full-table gossip at every N >= 64 (the tentpole claim);
+    - cluster-wide failure detection at the biggest N is within
+      ``SCALE_DETECT_RATIO_MAX`` of small-N;
+    - the relay metrics-aggregation wall grows sub-linearly in N;
+    - the sustained-churn run swept green (exactly one leader, no
+      lost store files, no dead coroutines, under continuous
+      join/leave).
+
+    Artifacts before round ``SCALE_REQUIRED_FROM_ROUND`` are exempt;
+    summary-only driver captures gate on the compact line's
+    ``scale_*`` keys."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < SCALE_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        problems = []
+        for key in ("scale_converge_s", "scale_detect_s",
+                    "scale_bytes_per_node_s"):
+            v = s.get(key)
+            if v is not None and (
+                not isinstance(v, (int, float))
+                or not math.isfinite(v) or v <= 0
+            ):
+                problems.append(
+                    f"{name}: summary {key} = {v!r} (nonfinite or "
+                    "nonpositive — the scale probe never measured)"
+                )
+        if s.get("scale_ok") is False:
+            problems.append(
+                f"{name}: summary scale_ok is false — a control-plane "
+                "scale verdict (bytes-below-full / detection-ratio / "
+                "metrics-sublinear / churn) failed"
+            )
+        return problems
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "control_plane_scale" in not_run:
+        return []  # honestly recorded as skipped/errored
+    block = matrix.get("control_plane_scale")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `control_plane_scale` section and not "
+                "recorded as skipped (bench lost the scale matrix?)"]
+    problems: List[str] = []
+    for key in ("scale_converge_s", "scale_detect_s",
+                "scale_election_s", "scale_bytes_per_node_s"):
+        v = block.get(key)
+        if not isinstance(v, (int, float)) or not math.isfinite(v) or v <= 0:
+            problems.append(
+                f"{name}: control_plane_scale.{key} = {v!r} (missing, "
+                "nonfinite, or zero — the big-N probe timed out or "
+                "never measured)"
+            )
+    bvf = block.get("bytes_vs_full_by_n")
+    if not isinstance(bvf, dict) or not bvf:
+        problems.append(
+            f"{name}: control_plane_scale.bytes_vs_full_by_n missing — "
+            "the old-vs-new protocol comparison never ran"
+        )
+    else:
+        for n, v in sorted(bvf.items()):
+            try:
+                big_enough = int(n) >= 64
+            except (TypeError, ValueError):
+                continue
+            if big_enough and (
+                not isinstance(v, (int, float)) or not v < 1.0
+            ):
+                problems.append(
+                    f"{name}: control_plane_scale delta/full bytes "
+                    f"ratio at N={n} is {v!r} — the delta protocol "
+                    "must be strictly below full-table gossip"
+                )
+    dr = block.get("detect_ratio_vs_small_n")
+    if not isinstance(dr, (int, float)) or dr > SCALE_DETECT_RATIO_MAX:
+        problems.append(
+            f"{name}: control_plane_scale.detect_ratio_vs_small_n = "
+            f"{dr!r} — big-N failure detection must stay within "
+            f"{SCALE_DETECT_RATIO_MAX}x of small-N"
+        )
+    mr = block.get("metrics_wall_ratio_vs_small_n")
+    ns = block.get("ns") or []
+    n_ratio = (
+        ns[-1] / ns[0]
+        if len(ns) >= 2 and all(isinstance(x, (int, float)) for x in ns)
+        and ns[0] else None
+    )
+    if not isinstance(mr, (int, float)) or (
+        n_ratio is not None and mr >= n_ratio
+    ):
+        problems.append(
+            f"{name}: control_plane_scale.metrics_wall_ratio_vs_small_n"
+            f" = {mr!r} — the relay metrics-pull wall must grow "
+            f"sub-linearly in N (< {n_ratio!r})"
+        )
+    rvs = block.get("straggler_serial_vs_relay")
+    if not isinstance(rvs, (int, float)) or rvs <= 1.5:
+        problems.append(
+            f"{name}: control_plane_scale.straggler_serial_vs_relay = "
+            f"{rvs!r} — with dead peers on the pull list the "
+            "aggregated pull must stay bounded by ~one timeout while "
+            "the serial shape pays one per straggler (> 1.5x)"
+        )
+    churn = block.get("churn") or {}
+    if churn.get("ok") is not True:
+        problems.append(
+            f"{name}: control_plane_scale.churn not green "
+            f"(failures: {churn.get('failures')!r}) — the sustained "
+            "join/leave invariant sweep must pass"
+        )
+    if not churn.get("crash_restart_pairs", 0):
+        problems.append(
+            f"{name}: control_plane_scale.churn ran zero crash/restart "
+            "pairs — sustained churn never actually churned"
+        )
+    return problems
+
+
+def run_scale_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_scale_block(artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
 # exists and parses (ISSUE 4 satellite; VERDICT r5 item 1)
@@ -1162,6 +1323,9 @@ def main() -> None:
     for problem in run_lint_check(art_path):
         total += 1
         print(f"lint block: {problem}")
+    for problem in run_scale_check(art_path):
+        total += 1
+        print(f"scale block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")
