@@ -107,6 +107,14 @@ observability:
   profile spans                     wall-clock span stats (store/job hot paths)
   profile trace start [dir]         capture a jax.profiler (XLA) trace
   profile trace stop                stop + write the trace
+  trace [dump]                      this node's flight recorder: finished
+                                    request spans (bounded ring) + slowest-K
+                                    + deadline-miss/shed/requeue/fallback
+                                    exemplars (dml_tpu/tracing.py)
+  trace pull [relays]               leader-aggregated cluster traces via
+                                    TRACE_PULL (optionally relay-fanned)
+  trace chrome [path]               export cluster traces as Chrome
+                                    chrome://tracing / Perfetto JSON
 other: help, quit
 """
 
@@ -351,6 +359,49 @@ class NodeApp:
                 print("usage: profile metrics [prom|json|cluster] | "
                       "profile spans | profile trace start [dir] | "
                       "profile trace stop")
+        elif cmd == "trace":
+            from . import tracing as trc
+
+            sub = a[0] if a else "dump"
+            if sub == "dump":
+                # this node's flight recorder: ring + slowest-K +
+                # pinned exemplars, newest-last
+                spans = trc.TRACER.dump()
+                print(json.dumps({
+                    "recorder": trc.TRACER.stats(),
+                    "exemplar_traces": trc.TRACER.exemplar_trace_ids(),
+                    "spans": spans,
+                }, indent=2))
+            elif sub == "pull":
+                relays = next(
+                    (int(x) for x in a[1:] if x.isdigit()), 0
+                )
+                view = await n.pull_cluster_traces(relays=relays)
+                print(json.dumps({
+                    "nodes": view["nodes"],
+                    "unreachable": view["unreachable"],
+                    "traces": {
+                        tid: [
+                            {k: sp.get(k) for k in
+                             ("name", "node", "t0", "t1")}
+                            for sp in spans
+                        ]
+                        for tid, spans in sorted(
+                            view["traces"].items()
+                        )
+                    },
+                }, indent=2))
+            elif sub == "chrome":
+                path = a[1] if len(a) > 1 else "/tmp/dml_tpu_trace.json"
+                view = await n.pull_cluster_traces()
+                doc = trc.chrome_trace(view["spans"])
+                with open(path, "w") as f:
+                    json.dump(doc, f)
+                print(f"wrote {len(doc['traceEvents'])} events from "
+                      f"{len(view['traces'])} trace(s) to {path} — "
+                      "load in chrome://tracing or Perfetto")
+            else:
+                print("usage: trace [dump|pull [relays]|chrome [path]]")
         elif cmd == "C1":
             for m, stats in j.c1_stats().items():
                 print(f"{m}: total={stats['total_queries']:.0f} "

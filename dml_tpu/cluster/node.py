@@ -558,6 +558,7 @@ class Node:
         self.register(MsgType.COORDINATE_ACK, self._h_coordinate_ack)
         self.register(MsgType.METRICS_PULL, self._h_metrics_pull)
         self.register(MsgType.METRICS_RELAY_PULL, self._h_metrics_relay)
+        self.register(MsgType.TRACE_PULL, self._h_trace_pull)
 
     def _spawn_bg(self, coro: Awaitable, name: str) -> asyncio.Task:
         """Background task spawned from a handler: held (never naked),
@@ -902,6 +903,251 @@ class Node:
             "fallbacks": fallbacks,
         }
         return blobs, direct, failed, info
+
+    # ------------------------------------------------------------------
+    # distributed tracing collection (dml_tpu/tracing.py)
+    # ------------------------------------------------------------------
+
+    def _send_trace_tiered(
+        self,
+        to_unique: str,
+        spans: list,
+        extra: Dict[str, Any],
+    ) -> None:
+        """Send a span dump, degrading to fit the UDP frame cap: full
+        -> labels/events stripped -> repeatedly halved newest-half ->
+        count-only -> explicit error. The same always-reply discipline
+        as ``_send_metrics_tiered``: a node's recorder must degrade
+        visibly, never vanish from the cluster trace because it grew."""
+        rows = list(spans)
+        stripped = False
+        tier = 0
+        while True:
+            try:
+                self.send_unique(
+                    to_unique, MsgType.TRACE_PULL_ACK,
+                    {**extra, "ok": True, "spans": rows,
+                     "held": len(spans),
+                     **({"stripped": True} if stripped else {})},
+                )
+                if tier:
+                    log.warning(
+                        "%s: span dump over the frame cap, degraded "
+                        "%d tier(s) for %s (%d of %d spans sent)",
+                        self.me.unique_name, tier, to_unique,
+                        len(rows), len(spans),
+                    )
+                return
+            except ValueError:
+                tier += 1
+                if not stripped:
+                    stripped = True
+                    rows = [
+                        {k: v for k, v in d.items()
+                         if k not in ("lb", "ev")}
+                        for d in rows
+                    ]
+                elif len(rows) > 8:
+                    rows = rows[len(rows) // 2:]  # keep the newest half
+                else:
+                    break
+        try:
+            self.send_unique(
+                to_unique, MsgType.TRACE_PULL_ACK,
+                {**extra, "ok": True, "spans": [], "held": len(spans),
+                 "truncated": "spans"},
+            )
+        except ValueError:
+            self.send_unique(
+                to_unique, MsgType.TRACE_PULL_ACK,
+                {**extra, "ok": False,
+                 "error": "span dump exceeds datagram cap"},
+            )
+
+    async def _h_trace_pull(self, msg: Message, addr) -> None:
+        """Reply with this process's flight-recorder dump. A request
+        carrying ``peers`` makes this node a RELAY: it pulls those
+        peers' dumps too (bounded concurrency, in a background task —
+        inline would wedge the dispatch loop its own pulls reply
+        through) and answers one pre-merged span list, the PR-10
+        two-level fan-out shape."""
+        from .. import tracing as trc
+
+        if self.spec.node_by_unique_name(msg.sender) is None:
+            return  # forged out-of-universe datagram: no amplification
+        d = msg.data
+        trace_ids = d.get("trace_ids")
+        if trace_ids is not None and not isinstance(trace_ids, list):
+            return
+        try:
+            max_spans = int(d.get("max_spans", 256))
+        except (TypeError, ValueError):
+            return
+        max_spans = min(max(max_spans, 1), 2048)
+        local = trc.TRACER.dump(
+            trace_ids=[t for t in trace_ids if isinstance(t, str)]
+            if trace_ids is not None else None,
+            max_spans=max_spans,
+        )
+        extra = {"rid": d.get("rid"), "node": self.me.unique_name}
+        peers = d.get("peers")
+        if not isinstance(peers, list) or not peers:
+            self._send_trace_tiered(msg.sender, local, extra)
+            return
+        try:
+            timeout = float(d.get("timeout", 3.0))
+        except (TypeError, ValueError):
+            return
+        if not math.isfinite(timeout):
+            return
+        timeout = min(max(timeout, 0.1), 30.0)
+
+        async def relay() -> None:
+            dumps, failed = await self._pull_peer_spans(
+                [
+                    n for p in peers
+                    if isinstance(p, str)
+                    and (n := self.spec.node_by_unique_name(p)) is not None
+                ],
+                trace_ids=trace_ids, max_spans=max_spans,
+                timeout=timeout,
+            )
+            from .. import tracing as trc2
+
+            merged = trc2.merge_span_dumps([local] + list(dumps.values()))
+            if len(merged) > max_spans:
+                merged = merged[-max_spans:]
+            self._send_trace_tiered(
+                msg.sender, merged,
+                {**extra, "covered": sorted(dumps),
+                 "failed": sorted(failed)},
+            )
+
+        self._spawn_bg(relay(), name=f"{self.me}-trace-relay")
+
+    async def _pull_peer_spans(
+        self,
+        peers: List[NodeId],
+        trace_ids: Optional[list],
+        max_spans: int,
+        timeout: float,
+        concurrency: int = 8,
+    ) -> Tuple[Dict[str, list], List[str]]:
+        """Bounded-concurrency TRACE_PULL fan-out (the span analog of
+        ``_pull_peer_snapshots``): a dead peer costs one slot-wait,
+        never a serial wall."""
+        dumps: Dict[str, list] = {}
+        failed: List[str] = []
+        sem = asyncio.Semaphore(max(1, concurrency))
+        req: Dict[str, Any] = {"max_spans": max_spans}
+        if trace_ids is not None:
+            req["trace_ids"] = trace_ids
+
+        async def pull_one(peer: NodeId) -> None:
+            async with sem:
+                try:
+                    reply = await self.request(
+                        peer, MsgType.TRACE_PULL, req, timeout=timeout
+                    )
+                except (asyncio.TimeoutError, TimeoutError):
+                    failed.append(peer.unique_name)
+                    return
+            spans = reply.get("spans")
+            if reply.get("ok") and isinstance(spans, list):
+                dumps[peer.unique_name] = spans
+            else:
+                failed.append(peer.unique_name)
+
+        await asyncio.gather(*(pull_one(n) for n in peers))
+        return dumps, failed
+
+    async def pull_cluster_traces(
+        self,
+        trace_ids: Optional[List[str]] = None,
+        timeout: float = 3.0,
+        concurrency: int = 8,
+        relays: int = 0,
+        max_spans: int = 1024,
+        peers: Optional[List[NodeId]] = None,
+    ) -> Dict[str, Any]:
+        """Assemble the cluster-wide trace view: every node's flight
+        recorder pulled (bounded concurrency; ``relays=R`` shards the
+        peers over R relay nodes that pre-merge, exactly the
+        pull_cluster_metrics fan-out shape), spans deduped by span id
+        (in-process sims share one recorder) and stitched into
+        per-trace trees.
+
+        Returns ``{"spans": [...], "traces": {trace_id: [spans]},
+        "nodes": {unique_name: span_count}, "unreachable": [...]}``."""
+        from .. import tracing as trc
+
+        per_node = min(max(int(max_spans), 1), 2048)
+        local = trc.TRACER.dump(trace_ids=trace_ids, max_spans=per_node)
+        dumps: Dict[str, list] = {self.me.unique_name: local}
+        failed: List[str] = []
+        if peers is None:
+            peers = self.membership.alive_nodes()
+        others = sorted(
+            (n for n in peers if n.unique_name != self.me.unique_name),
+            key=lambda n: n.unique_name,
+        )
+        if relays > 0 and len(others) > relays:
+            relay_nodes = others[:relays]
+            rest = others[relays:]
+            shards: Dict[str, List[NodeId]] = {
+                r.unique_name: [] for r in relay_nodes
+            }
+            for i, p in enumerate(rest):
+                shards[relay_nodes[i % len(relay_nodes)].unique_name] \
+                    .append(p)
+
+            async def pull_relay(relay: NodeId) -> None:
+                shard = shards[relay.unique_name]
+                req: Dict[str, Any] = {
+                    "max_spans": per_node, "timeout": timeout,
+                    "peers": [p.unique_name for p in shard],
+                }
+                if trace_ids is not None:
+                    req["trace_ids"] = trace_ids
+                waves = max(1, -(-len(shard) // 8))
+                try:
+                    reply = await self.request(
+                        relay, MsgType.TRACE_PULL, req,
+                        timeout=timeout * (waves + 1) + 1.0,
+                    )
+                except (asyncio.TimeoutError, TimeoutError):
+                    reply = {}
+                spans = reply.get("spans")
+                if reply.get("ok") and isinstance(spans, list):
+                    dumps[relay.unique_name] = spans
+                    failed.extend(
+                        c for c in reply.get("failed", [])
+                        if isinstance(c, str)
+                    )
+                    return
+                # relay down/degraded: pull its shard (and it) direct
+                got, bad = await self._pull_peer_spans(
+                    [relay] + shard, trace_ids=trace_ids,
+                    max_spans=per_node, timeout=timeout,
+                    concurrency=concurrency,
+                )
+                dumps.update(got)
+                failed.extend(bad)
+
+            await asyncio.gather(*(pull_relay(r) for r in relay_nodes))
+        elif others:
+            got, failed = await self._pull_peer_spans(
+                others, trace_ids=trace_ids, max_spans=per_node,
+                timeout=timeout, concurrency=concurrency,
+            )
+            dumps.update(got)
+        spans = trc.merge_span_dumps(list(dumps.values()))
+        return {
+            "spans": spans,
+            "traces": trc.assemble_traces(spans),
+            "nodes": {n: len(d) for n, d in sorted(dumps.items())},
+            "unreachable": sorted(failed),
+        }
 
     async def _h_ping(self, msg: Message, addr) -> None:
         """Merge piggybacked gossip, ACK with our own (reference PING

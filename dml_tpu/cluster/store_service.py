@@ -386,6 +386,7 @@ class StoreService:
             raise FileNotFoundError(local_path)
         token = self.data_plane.expose(local_path)
         t0 = time.monotonic()
+        t0_wall = time.time()
         try:
             with span("store.put"):
                 reply = await self._leader_retry(
@@ -399,6 +400,7 @@ class StoreService:
                 )
         finally:
             self.data_plane.unexpose(token)
+            self._trace_store_span("store_put", sdfs_name, t0_wall)
         if not reply.get("ok"):
             raise RuntimeError(f"put {sdfs_name} failed: {reply.get('error')}")
         _M_PUTS.inc()
@@ -418,11 +420,41 @@ class StoreService:
         from ..observability import span
 
         t0 = time.monotonic()
-        with span("store.get"):
-            got = await self._get_impl(sdfs_name, local_path, version, timeout)
+        t0_wall = time.time()
+        try:
+            with span("store.get"):
+                got = await self._get_impl(
+                    sdfs_name, local_path, version, timeout
+                )
+        finally:
+            self._trace_store_span("store_get", sdfs_name, t0_wall)
         _M_GETS.inc()
         _M_GET_T.observe(time.monotonic() - t0)
         return got
+
+    def _trace_store_span(
+        self, name: str, sdfs_name: str, t0_wall: float
+    ) -> None:
+        """Replicated-store detail span under the calling request's
+        propagated trace (dml_tpu/tracing.py CURRENT_CTXS): recorded
+        once per operation under the FIRST sampled context — store ops
+        are batch-level, and N copies of the same interval would only
+        inflate the span budget, not the information."""
+        from ..tracing import TRACER, current_ctxs
+
+        ctxs = current_ctxs()
+        if not ctxs:
+            return
+        kw = dict(
+            ctx=ctxs[0], node=self.node.me.unique_name, t0=t0_wall,
+            labels={"file": sdfs_name, "shared": len(ctxs)},
+        )
+        # literal names per branch: dmllint's drift-span-names rule
+        # checks start_span call sites against the SPAN_NAMES registry
+        if name == "store_put":
+            TRACER.start_span("store_put", **kw).end(time.time())
+        else:
+            TRACER.start_span("store_get", **kw).end(time.time())
 
     async def _get_impl(
         self,

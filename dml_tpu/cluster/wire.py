@@ -162,6 +162,18 @@ class MsgType(enum.IntEnum):
     # in-flight requests either complete or are explicitly rejected
     # across a failover, never silently lost
     INGRESS_RELAY = 96
+    # distributed request tracing (dml_tpu/tracing.py): pull a peer's
+    # flight-recorder span dump (bounded ring + slowest-K + tail
+    # exemplars). The ACK carries the span list, degrading tier by
+    # tier to fit the datagram cap exactly like METRICS_PULL_ACK
+    # (full -> labels/events stripped -> halved newest-half counts ->
+    # count-only -> explicit error); a request carrying "peers" makes
+    # the receiver a RELAY that pre-merges its shard (the PR-10
+    # two-level fan-out shape, folded into the same type). The ACK is
+    # deliberately unregistered — the dispatcher's rid fallback
+    # resolves the awaiting request future, like METRICS_PULL_ACK.
+    TRACE_PULL = 100
+    TRACE_PULL_ACK = 101
 
 
 # ----------------------------------------------------------------------
@@ -269,6 +281,9 @@ HANDLER_OWNERS: Dict["MsgType", str] = {
     MsgType.REQUEST_STATUS_ACK: RID_FALLBACK,
     MsgType.REQUEST_STREAM_READY: "RequestRouter",
     MsgType.INGRESS_RELAY: "RequestRouter",
+    # distributed tracing
+    MsgType.TRACE_PULL: "Node",
+    MsgType.TRACE_PULL_ACK: RID_FALLBACK,
 }
 
 

@@ -70,6 +70,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..observability import METRICS
+from ..tracing import TRACER, TraceContext, current_all_ctxs
 
 log = logging.getLogger(__name__)
 
@@ -1199,10 +1200,13 @@ class DisaggLMBackend:
     async def _prefill_rpc(
         self, peer, model: str, prompts: List[np.ndarray],
         budgets: List[int], stream: bool,
+        traces: Optional[List[Dict[str, Any]]] = None,
     ) -> Dict[str, Any]:
         """LM_PREFILL_REQUEST with one retry (at-most-once UDP): a
         single dropped frame costs half the window, not all of it;
-        a duplicate just mints another token/stream the TTL reaps."""
+        a duplicate just mints another token/stream the TTL reaps.
+        ``traces`` ships the share's per-request trace contexts so the
+        prefill member's span lands in the stitched cross-node tree."""
         from ..cluster.wire import MsgType
 
         reply = None
@@ -1215,6 +1219,7 @@ class DisaggLMBackend:
                         "prompts": [[int(t) for t in p] for p in prompts],
                         "budgets": [int(b) for b in budgets],
                         "stream": bool(stream),
+                        **({"traces": traces} if traces else {}),
                     },
                     timeout=self.prefill_timeout / 2,
                 )
@@ -1232,7 +1237,7 @@ class DisaggLMBackend:
 
     async def _fetch_slabs(
         self, model: str, prompts: List[np.ndarray], budgets: List[int],
-        peer=None,
+        peer=None, traces: Optional[List[Dict[str, Any]]] = None,
     ) -> Optional[List[Dict[str, Any]]]:
         """Whole-slab pull of one peer's share (``handoff="slab"``).
         Returns the share's slab entries, or None when no peer is
@@ -1248,7 +1253,7 @@ class DisaggLMBackend:
             return None
         t0 = time.monotonic()
         reply = await self._prefill_rpc(
-            peer, model, prompts, budgets, stream=False
+            peer, model, prompts, budgets, stream=False, traces=traces
         )
         data = await self.store.data_plane.fetch_token_bytes(
             data_addr(peer), reply["token"],
@@ -1282,9 +1287,40 @@ class DisaggLMBackend:
             start += size
         return shares
 
+    def _share_spans(
+        self, ctxs: Optional[List[Optional[TraceContext]]],
+        idxs: List[int], delivered: Set[int], peer,
+        t0_wall: float, failed: bool,
+    ) -> None:
+        """One `handoff` span per sampled request of a share; a
+        request the share failed to deliver carries the ``fallback``
+        event (a tail exemplar, captured regardless of sampling — the
+        demotion to local prefill is exactly what explains that
+        request's tail latency)."""
+        if not ctxs:
+            return
+        t1_wall = time.time()
+        for gi, c in zip(idxs, ctxs):
+            if c is None:
+                continue
+            s = TRACER.start_span(
+                "handoff", ctx=c, node=self.node.me.unique_name,
+                t0=t0_wall,
+                labels={"peer": getattr(peer, "unique_name", str(peer)),
+                        "group": self.group_name,
+                        "form": self.handoff},
+            )
+            if failed and gi not in delivered:
+                s.event("fallback")
+                s.label(result="fallback")
+            else:
+                s.label(result="ok")
+            s.end(t1_wall)
+
     async def _pull_share_stream(
         self, peer, model: str, idxs: List[int],
         prompts: List[np.ndarray], budgets: List[int], arrivals,
+        ctxs: Optional[List[Optional[TraceContext]]] = None,
     ) -> None:
         """One peer's streamed share: RPC for the stream token, then
         reassemble per-request entries as their chunks land, handing
@@ -1294,6 +1330,7 @@ class DisaggLMBackend:
         from ..cluster.store_service import data_addr
 
         t0 = time.monotonic()
+        t0_wall = time.time()
         delivered: Set[int] = set()
         try:
             if sum(int(prompts[i].size) for i in idxs) \
@@ -1304,6 +1341,7 @@ class DisaggLMBackend:
                 [prompts[i] for i in idxs],
                 [budgets[i] for i in idxs],
                 stream=True,
+                traces=[c.to_wire() for c in (ctxs or []) if c],
             )
             if not reply.get("stream"):
                 # old-form peer: its token is a whole-slab file —
@@ -1320,6 +1358,8 @@ class DisaggLMBackend:
                 for i, entry in zip(idxs, slabs):
                     arrivals.put_nowait((i, entry))
                     delivered.add(i)
+                self._share_spans(ctxs, idxs, delivered, peer,
+                                  t0_wall, failed=False)
                 return
             chunks = self.store.data_plane.fetch_stream(
                 data_addr(peer), reply["token"],
@@ -1341,6 +1381,8 @@ class DisaggLMBackend:
                     "entries"
                 )
             _M_HANDOFF_T.observe(time.monotonic() - t0)
+            self._share_spans(ctxs, idxs, delivered, peer,
+                              t0_wall, failed=False)
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -1349,6 +1391,8 @@ class DisaggLMBackend:
                 "prefill for its %d remaining request(s)",
                 self.group_name, peer, e, len(idxs) - len(delivered),
             )
+            self._share_spans(ctxs, idxs, delivered, peer,
+                              t0_wall, failed=True)
             for i in idxs:
                 if i not in delivered:
                     arrivals.put_nowait((i, None))
@@ -1356,19 +1400,24 @@ class DisaggLMBackend:
     async def _pull_share_slab(
         self, peer, model: str, idxs: List[int],
         prompts: List[np.ndarray], budgets: List[int], arrivals,
+        ctxs: Optional[List[Optional[TraceContext]]] = None,
     ) -> None:
         """One peer's whole-slab share (the comparison form)."""
+        t0_wall = time.time()
         try:
             slabs = await self._fetch_slabs(
                 model,
                 [prompts[i] for i in idxs],
                 [budgets[i] for i in idxs],
                 peer=peer,
+                traces=[c.to_wire() for c in (ctxs or []) if c],
             )
             if slabs is None:
                 raise RuntimeError("no eligible peer/share")
             for i, entry in zip(idxs, slabs):
                 arrivals.put_nowait((i, entry))
+            self._share_spans(ctxs, idxs, set(idxs), peer,
+                              t0_wall, failed=False)
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -1377,6 +1426,8 @@ class DisaggLMBackend:
                 "for its %d request(s)",
                 self.group_name, peer, e, len(idxs),
             )
+            self._share_spans(ctxs, idxs, set(), peer,
+                              t0_wall, failed=True)
             for i in idxs:
                 arrivals.put_nowait((i, None))
 
@@ -1408,11 +1459,28 @@ class DisaggLMBackend:
         arrivals: "_queue.Queue" = _queue.Queue()
         tasks: List[asyncio.Task] = []
         t_batch0 = time.monotonic()
+        # per-request trace contexts, routed by local path (the job
+        # service re-keyed them before the backend call): request i's
+        # prefill/handoff/decode spans land in ITS cross-node trace.
+        # UNFILTERED on purpose: a fallback on an unsampled request
+        # still pins its tail exemplar (the span records with the
+        # context's own sampled flag; the exemplar pin is always-on),
+        # while the decode span below gates on .sampled itself.
+        by_path = {c.key: c for c in current_all_ctxs()}
+        req_ctxs: List[Optional[TraceContext]] = [
+            by_path.get(p) for p in paths
+        ]
         if not peers:
             # no live prefill peer at all: every request is a typed
             # local fallback
             for i in range(len(prompts)):
                 arrivals.put_nowait((i, None))
+                TRACER.note_exemplar(
+                    req_ctxs[i], "fallback",
+                    node=self.node.me.unique_name,
+                    labels={"group": self.group_name,
+                            "reason": "no_prefill_peer"},
+                )
         else:
             shares = self._shares(len(prompts), len(peers))
             pull = (
@@ -1423,7 +1491,8 @@ class DisaggLMBackend:
                 if not idxs:
                     continue
                 tasks.append(asyncio.ensure_future(pull(
-                    peer, model, idxs, prompts, budgets, arrivals
+                    peer, model, idxs, prompts, budgets, arrivals,
+                    ctxs=[req_ctxs[i] for i in idxs],
                 )))
         _member_check(self.group_name, self.members, self.alive_fn)
         ttft_box: List[float] = []
@@ -1431,6 +1500,7 @@ class DisaggLMBackend:
         def on_first() -> None:
             ttft_box.append(time.monotonic() - t_batch0)
 
+        decode_wall0 = time.time()
         try:
             toks, infer_time, stats = await asyncio.to_thread(
                 self.be.serve_prefilled_stream,
@@ -1443,6 +1513,16 @@ class DisaggLMBackend:
             for t in tasks:
                 if not t.done():
                     t.cancel()
+        decode_wall1 = time.time()
+        for c in req_ctxs:
+            if c is not None and c.sampled:
+                TRACER.start_span(
+                    "decode", ctx=c, node=self.node.me.unique_name,
+                    t0=decode_wall0,
+                    labels={"group": self.group_name,
+                            "mode": "disagg",
+                            "shared": len(prompts)},
+                ).end(decode_wall1)
         self.last_ttft_s = ttft_box[0] if ttft_box else None
         self.handoffs += stats["adopted"]
         self.fallbacks += stats["local"]

@@ -156,6 +156,13 @@ class Outcome:
     #: front door types as result_unavailable instead — the failover
     #: bench asserts this never reads False on a completion.
     has_result: bool = False
+    #: distributed-tracing join keys (dml_tpu/tracing.py): the trace
+    #: id minted at admission and the router's terminal-carried
+    #: per-stage seconds — `summarize` joins completions against
+    #: pulled cluster traces by trace_id, with `stages` as the
+    #: fallback when a trace was sampled away or evicted
+    trace_id: Optional[str] = None
+    stages: Optional[Dict[str, float]] = None
 
 
 def percentile(sorted_vals: Sequence[float], p: float) -> float:
@@ -175,7 +182,8 @@ def percentile(sorted_vals: Sequence[float], p: float) -> float:
 
 
 def summarize(
-    outcomes: Sequence[Outcome], wall_s: float
+    outcomes: Sequence[Outcome], wall_s: float,
+    trace_stages: Optional[Dict[str, Dict[str, float]]] = None,
 ) -> Dict[str, Any]:
     """Tail-latency + goodput scorecard over one open-loop run.
 
@@ -185,7 +193,16 @@ def summarize(
     excluded from the latency distribution, because an immediate
     rejection's near-zero "latency" would deflate the percentiles of
     the requests the cluster actually served. Goodput counts only
-    completions that made their deadline."""
+    completions that made their deadline.
+
+    ``trace_stages`` joins completions against collected traces
+    (trace_id -> per-stage seconds, e.g. ``tracing.stage_breakdown``
+    over a ``pull_cluster_traces`` result); each completion falls back
+    to its terminal-carried ``stages`` when its trace was sampled away
+    or evicted. When any join lands, the scorecard gains a
+    ``p99_attribution`` block: the mean per-stage breakdown of the
+    p99 COHORT (completions at/above the p99 latency) — which hop ate
+    the tail, not just how long the tail is."""
     out: Dict[str, Any] = {"n": len(outcomes), "wall_s": round(wall_s, 3)}
     by_class: Dict[str, List[Outcome]] = {}
     for o in outcomes:
@@ -224,7 +241,54 @@ def summarize(
 
     out.update(score(outcomes))
     out["by_class"] = {c: score(rows) for c, rows in sorted(by_class.items())}
+    attrib = _p99_attribution(outcomes, trace_stages)
+    if attrib is not None:
+        out["p99_attribution"] = attrib
     return out
+
+
+def _p99_attribution(
+    outcomes: Sequence[Outcome],
+    trace_stages: Optional[Dict[str, Dict[str, float]]],
+) -> Optional[Dict[str, Any]]:
+    """Join completions against traces and attribute the p99 cohort's
+    time to stages (None when nothing joins — no tracing ran)."""
+    from ..tracing import cohort_attribution
+
+    completed = [
+        o for o in outcomes
+        if o.terminal == TERMINAL_COMPLETED and o.e2e_s is not None
+    ]
+    if not completed:
+        return None
+    joined: List[Tuple[Outcome, Dict[str, float]]] = []
+    for o in completed:
+        stages = None
+        if trace_stages and o.trace_id:
+            stages = trace_stages.get(o.trace_id)
+        if not stages:
+            stages = o.stages
+        if stages:
+            joined.append((o, {
+                k: float(v) for k, v in stages.items()
+                if isinstance(v, (int, float))
+            }))
+    if not joined:
+        return None
+    lats = sorted(o.e2e_s for o in completed)
+    p99v = percentile(lats, 99)
+    cohort = [(o, s) for o, s in joined if o.e2e_s >= p99v]
+    if not cohort:  # every p99-cohort completion failed to join:
+        # report the slowest joined completion rather than nothing
+        cohort = sorted(joined, key=lambda t: t[0].e2e_s)[-1:]
+    attrib = cohort_attribution(
+        [s for _, s in cohort], [o.e2e_s for o, _ in cohort]
+    )
+    attrib["p99_ms"] = round(p99v * 1e3, 1)
+    attrib["joined"] = len(joined)
+    attrib["completed"] = len(completed)
+    attrib["join_fraction"] = round(len(joined) / len(completed), 4)
+    return attrib
 
 
 async def drive_one(
@@ -277,12 +341,16 @@ async def drive_one(
             deadline_met=met, model=a.model, session=a.session,
             worker=term.get("worker"),
             has_result=term.get("result") is not None,
+            trace_id=term.get("trace_id"),
+            stages=(term.get("stages")
+                    if isinstance(term.get("stages"), dict) else None),
         )
     return Outcome(
         slo=a.slo,
         terminal=(TERMINAL_LOST if term.get("terminal") == "lost"
                   else TERMINAL_REJECTED),
         reason=term.get("reason"), model=a.model, session=a.session,
+        trace_id=term.get("trace_id"),
     )
 
 

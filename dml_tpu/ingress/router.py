@@ -57,6 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..cluster.util import BoundedDict, leader_retry, reap_task
 from ..cluster.wire import Message, MsgType
 from ..observability import METRICS
+from ..tracing import CURRENT_CTXS, TRACER, TraceContext
 from .slo import DEFAULT_CLASSES, SLOClass, resolve_class, shed_reason
 
 log = logging.getLogger(__name__)
@@ -134,6 +135,12 @@ class PendingRequest:
     stream: bool
     arrival: float       # monotonic admission time
     deadline: float      # arrival + slo.deadline_s
+    #: wall-clock admission time (spans are wall-clocked so cross-node
+    #: trees align) and the request's trace context (children of the
+    #: root span parent here); ctx is None only for reconstructed
+    #: requests whose relay predates tracing
+    arrival_wall: float = 0.0
+    ctx: Optional[TraceContext] = None
 
 
 @dataclass
@@ -240,6 +247,12 @@ class _RequestState:
     req: PendingRequest
     state: str = "forming"  # forming | dispatched
     job_id: Optional[int] = None
+    #: the live root span (admission -> terminal); ended exactly once
+    #: by whichever terminal path settles the request
+    root: Optional[Any] = None
+    #: wall clock of the batch dispatch (closes the formation stage in
+    #: the terminal's per-stage breakdown)
+    dispatched_wall: Optional[float] = None
 
 
 class RequestRouter:
@@ -491,6 +504,21 @@ class RequestRouter:
             # get their own feed + READY push
             file = files[hash(req_id) % len(files)]
         now = time.monotonic()
+        now_wall = time.time()
+        # trace head decision at admission (dml_tpu/tracing.py): one
+        # seeded-samplable choice per request; the context propagates
+        # through every hop the request takes whether sampled or not
+        # (unsampled spans surface only as tail exemplars)
+        tid = TRACER.new_trace_id()
+        trace_sampled = TRACER.head_sample(tid)
+        root = TRACER.start_span(
+            "request", trace_id=tid, node=self._me,
+            sampled=trace_sampled, t0=now_wall,
+            labels={"slo": slo.name, "model": model, "id": req_id},
+        )
+        adm = TRACER.start_span(
+            "admission", ctx=root.ctx(), node=self._me, t0=now_wall,
+        )
         reason = shed_reason(
             now=now,
             deadline=now + slo.deadline_s,
@@ -507,16 +535,29 @@ class RequestRouter:
         if reason is not None:
             self.shed_count += 1
             _M_SHED.inc(slo=slo.name, reason=reason)
+            # shed requests observe their (zero) queue wait too: the
+            # histogram must describe every request the door saw, not
+            # only the ones that dispatched (the overload regime is
+            # exactly when the difference matters)
+            _M_QWAIT.observe(0.0, slo=slo.name)
             self._done[req_id] = {
                 "terminal": "shed", "reason": reason, "slo": slo.name,
+                "trace_id": tid,
             }
+            adm.end()
+            root.label(terminal="shed", reason=reason)
+            root.event("shed")  # tail exemplar: captured regardless
+            root.end()          # of the sampling decision
             ack({"accepted": False, "reason": reason, "shed": True})
             return
+        adm.end()
         req = PendingRequest(
             id=req_id, client=msg.sender, model=model, slo=slo,
             file=file, payload=payload,
             session=d.get("session"), stream=stream,
             arrival=now, deadline=now + slo.deadline_s,
+            arrival_wall=now_wall,
+            ctx=TraceContext(tid, root.span_id, trace_sampled, key=file),
         )
         affinity = None
         if req.session:
@@ -525,7 +566,7 @@ class RequestRouter:
             # or demoted holder must not pin the batch to a ghost
             if aff and aff in self.jobs.worker_pool():
                 affinity = aff
-        self._active[req_id] = _RequestState(req=req)
+        self._active[req_id] = _RequestState(req=req, root=root)
         self._pending_by_class[slo.name] = (
             self._pending_by_class.get(slo.name, 0) + 1
         )
@@ -597,6 +638,19 @@ class RequestRouter:
             if not sched.queues.get(fb.model)
         }
 
+    async def _traced_put(self, r: PendingRequest):
+        """One request's inline-payload PUT under its trace context
+        (gather wraps this into a Task, so the contextvar set is
+        task-local and the store's store_put span lands in the right
+        trace)."""
+        tok = CURRENT_CTXS.set((r.ctx,) if r.ctx is not None else ())
+        try:
+            return await self.store.put_bytes(
+                r.file, r.payload, timeout=15.0
+            )
+        finally:
+            CURRENT_CTXS.reset(tok)
+
     async def _dispatch_batch(self, fb: FormingBatch) -> None:
         now = time.monotonic()
         reqs = list(fb.reqs)
@@ -605,8 +659,7 @@ class RequestRouter:
         puts = [r for r in reqs if r.payload is not None]
         if puts:
             results = await asyncio.gather(
-                *(self.store.put_bytes(r.file, r.payload, timeout=15.0)
-                  for r in puts),
+                *(self._traced_put(r) for r in puts),
                 return_exceptions=True,
             )
             failed = {
@@ -633,12 +686,22 @@ class RequestRouter:
         # per-request at completion; a duplicated path would double-
         # feed every stream of that input)
         files = list(dict.fromkeys(r.file for r in reqs))
+        # one trace-context wire entry per request rides the batch
+        # (next to slo_class): `q` stamps the dispatch wall so the
+        # coordinator's first WORKER_TASK_REQUEST send can close the
+        # scheduler-side `dispatch` span
+        now_wall = time.time()
+        traces = [
+            {**r.ctx.to_wire(), "q": round(now_wall, 6)}
+            for r in reqs if r.ctx is not None
+        ]
         try:
             self.jobs.ingress_submit(
                 job_id, fb.model, files,
                 requester=self._me, affinity=fb.affinity,
                 streams=streams or None,
                 slo_class=fb.slo.name,
+                traces=traces or None,
             )
         except Exception as e:
             log.exception("%s: ingress dispatch of %d reqs failed",
@@ -652,8 +715,17 @@ class RequestRouter:
             if st is not None:
                 st.state = "dispatched"
                 st.job_id = job_id
+                st.dispatched_wall = now_wall
             ids.append(r.id)
             _M_QWAIT.observe(now - r.arrival, slo=r.slo.name)
+            if r.ctx is not None:
+                # formation span: admission -> this dispatch (the
+                # front-door queue wait, wall-clocked)
+                TRACER.start_span(
+                    "formation", ctx=r.ctx, node=self._me,
+                    t0=r.arrival_wall,
+                    labels={"job": job_id, "slo": r.slo.name},
+                ).end(now_wall)
         self._by_job[job_id] = ids
         _M_FILL.observe(len(reqs) / self._batch_size_of(fb.model))
         _M_FORMATION.observe(now - fb.opened_at)
@@ -668,7 +740,15 @@ class RequestRouter:
                     {"job": job_id, "reqs": [
                         [r.id, r.client, r.slo.name, r.file,
                          round(r.deadline - now, 3), r.session or "",
-                         int(r.stream)]
+                         int(r.stream),
+                         # trace continuity across failover: the
+                         # promoted router re-roots the adopted
+                         # request under the ORIGINAL trace + root
+                         # span id, so its completion carries the same
+                         # trace_id and earlier spans keep a parent
+                         r.ctx.trace_id if r.ctx else "",
+                         r.ctx.span_id if r.ctx else "",
+                         int(bool(r.ctx and r.ctx.sampled))]
                         for r in reqs
                     ]},
                 )
@@ -721,23 +801,33 @@ class RequestRouter:
                 log.exception("%s: ingress output fetch for job %d "
                               "failed", self._me, st.job_id)
         now = time.monotonic()
+        now_wall = time.time()
         for req_id in ids:
             state = self._active.pop(req_id, None)
             if state is None:
                 continue
             r = state.req
+            stages = self._request_stages(state, st, now_wall)
+            trace_extra = (
+                {"trace_id": r.ctx.trace_id, "stages": stages}
+                if r.ctx is not None else {}
+            )
             self._dec_pending(r.slo.name)
             if st.error:
                 self._done[req_id] = {
                     "terminal": "rejected",
                     "reason": f"job_failed: {st.error}", "slo": r.slo.name,
+                    **trace_extra,
                 }
                 _M_REJECTED.inc(slo=r.slo.name, reason="job_failed")
+                self._end_root(state, "rejected", now_wall,
+                               reason="job_failed")
                 try:
                     self.node.send_unique(
                         r.client, MsgType.REQUEST_DONE,
                         {"id": req_id, "ok": False,
-                         "reason": f"job_failed: {st.error}"},
+                         "reason": f"job_failed: {st.error}",
+                         **trace_extra},
                     )
                 except Exception:
                     log.exception("%s: ingress job-failed push for %s "
@@ -753,14 +843,17 @@ class RequestRouter:
                 self._done[req_id] = {
                     "terminal": "rejected",
                     "reason": "result_unavailable", "slo": r.slo.name,
+                    **trace_extra,
                 }
                 _M_REJECTED.inc(slo=r.slo.name,
                                 reason="result_unavailable")
+                self._end_root(state, "rejected", now_wall,
+                               reason="result_unavailable")
                 try:
                     self.node.send_unique(
                         r.client, MsgType.REQUEST_DONE,
                         {"id": req_id, "ok": False,
-                         "reason": "result_unavailable"},
+                         "reason": "result_unavailable", **trace_extra},
                     )
                 except Exception:
                     log.exception("%s: ingress unavailable push for %s "
@@ -775,6 +868,7 @@ class RequestRouter:
                 "result": merged.get(r.file),
                 "worker": worker, "e2e_ms": round(e2e * 1e3, 2),
                 "deadline_met": met,
+                **trace_extra,
             }
             try:
                 self.node.send_unique(
@@ -795,14 +889,17 @@ class RequestRouter:
                 self._done[req_id] = {
                     "terminal": "rejected",
                     "reason": "result_too_large", "slo": r.slo.name,
+                    **trace_extra,
                 }
                 _M_REJECTED.inc(slo=r.slo.name,
                                 reason="result_too_large")
+                self._end_root(state, "rejected", now_wall,
+                               reason="result_too_large")
                 try:
                     self.node.send_unique(
                         r.client, MsgType.REQUEST_DONE,
                         {"id": req_id, "ok": False,
-                         "reason": "result_too_large"},
+                         "reason": "result_too_large", **trace_extra},
                     )
                 except Exception:
                     log.exception("%s: ingress rejection push for %s "
@@ -810,20 +907,104 @@ class RequestRouter:
                 continue
             _M_COMPLETED.inc(slo=r.slo.name)
             _M_E2E.observe(e2e, slo=r.slo.name)
+            if r.ctx is not None:
+                # result-return stage: job completion -> DONE push
+                TRACER.start_span(
+                    "result", ctx=r.ctx, node=self._me, t0=now_wall,
+                ).end(time.time())
             if not met:
-                _M_DEADLINE_MISS.inc(slo=r.slo.name)
+                # deadline-miss attribution: the counter family's
+                # stage= label carries the miss's DOMINANT stage (the
+                # one that ate the most wall time), so the metric
+                # alone says WHERE the tail is being lost; the miss
+                # exemplar trace carries the full breakdown
+                dominant = (
+                    max(stages, key=lambda k: stages[k])
+                    if stages else "unattributed"
+                )
+                _M_DEADLINE_MISS.inc(slo=r.slo.name, stage=dominant)
+                if state.root is not None:
+                    state.root.event("deadline_miss")
+                    state.root.label(miss_stage=dominant)
+            self._end_root(state, "completed", now_wall,
+                           deadline_met=met)
             self._done[req_id] = terminal
 
+    def _request_stages(
+        self, state: _RequestState, st, now_wall: float
+    ) -> Dict[str, float]:
+        """Per-stage seconds for one request's terminal, from what the
+        coordinator knows synchronously: the router's own admission/
+        dispatch walls plus the batch ACK's carried stage timings
+        (``JobState.stage_timing``) — available on a real multi-
+        process cluster too, where the worker's spans live on the
+        worker. ``dispatch`` is the residual between dispatch and
+        completion not explained by the worker's measured exec
+        (scheduler queue + wire + ACK latency), floored at zero."""
+        r = state.req
+        stages: Dict[str, float] = {}
+        if state.dispatched_wall and r.arrival_wall:
+            stages["formation"] = max(
+                0.0, state.dispatched_wall - r.arrival_wall
+            )
+        timing = getattr(st, "stage_timing", None) or {}
+        fetch = float(timing.get("fetch", 0.0))
+        backend = float(timing.get("backend", 0.0))
+        infer = float(timing.get("infer", 0.0))
+        put = float(timing.get("put", 0.0))
+        exec_ = float(timing.get("exec", 0.0))
+        if timing:
+            stages["fetch"] = fetch + max(0.0, backend - infer)
+            stages["infer"] = infer
+            stages["put"] = put
+        if state.dispatched_wall:
+            stages["dispatch"] = max(
+                0.0, (now_wall - state.dispatched_wall) - max(
+                    exec_, fetch + backend + put
+                )
+            )
+        return {k: round(v, 6) for k, v in stages.items()}
+
+    def _end_root(
+        self, state: _RequestState, terminal: str, now_wall: float,
+        reason: Optional[str] = None, deadline_met: Optional[bool] = None,
+    ) -> None:
+        """Close a request's root span exactly once with its terminal
+        labels (idempotent via Span.end)."""
+        root = state.root
+        if root is None:
+            return
+        root.label(terminal=terminal)
+        if reason is not None:
+            root.label(reason=reason)
+        if deadline_met is not None:
+            root.label(deadline_met=deadline_met)
+        root.end()
+
     def _terminal_reject(self, r: PendingRequest, reason: str) -> None:
-        self._active.pop(r.id, None)
+        state = self._active.pop(r.id, None)
         self._dec_pending(r.slo.name)
+        # never-dispatched terminals record the queue wait they DID
+        # experience: only-completions-observe left the histogram
+        # blind to exactly the requests that waited longest and died
+        # waiting (optimistic bias under overload)
+        _M_QWAIT.observe(
+            max(0.0, time.monotonic() - r.arrival), slo=r.slo.name
+        )
         self._done[r.id] = {
             "terminal": "rejected", "reason": reason, "slo": r.slo.name,
+            **({"trace_id": r.ctx.trace_id} if r.ctx else {}),
         }
         _M_REJECTED.inc(slo=r.slo.name, reason=reason.split(":")[0])
+        if state is not None:
+            self._end_root(
+                state, "rejected", time.time(),
+                reason=reason.split(":")[0],
+            )
         self.node.send_unique(
             r.client, MsgType.REQUEST_DONE,
-            {"id": r.id, "ok": False, "reason": reason},
+            {"id": r.id, "ok": False, "reason": reason,
+             **({"trace_id": r.ctx.trace_id} if r.ctx else {})},
         )
 
     def _dec_pending(self, slo_name: str) -> None:
@@ -877,7 +1058,11 @@ class RequestRouter:
                 continue
             ids = []
             for row in entry["reqs"]:
-                rid_, client, slo_name, file, remaining, session, stream = row
+                (rid_, client, slo_name, file, remaining, session,
+                 stream) = row[:7]
+                tid, root_sid, tr_sampled = (
+                    list(row[7:10]) + ["", "", 0]
+                )[:3]
                 if rid_ in self._active:
                     continue
                 try:
@@ -885,16 +1070,40 @@ class RequestRouter:
                 except KeyError:
                     slo = SLOClass(slo_name, deadline_s=30.0)
                 elapsed = now - entry["at"]
+                arrival = (
+                    now - max(0.0, slo.deadline_s - float(remaining))
+                    - elapsed
+                )
+                root = None
+                ctx = None
+                if tid:
+                    # re-root the adopted request under the ORIGINAL
+                    # trace + root span id: the completion's trace_id
+                    # survives the failover, and spans the dead leader
+                    # already recorded keep a resolvable parent
+                    root = TRACER.start_span(
+                        "request", trace_id=str(tid), node=self._me,
+                        sampled=bool(tr_sampled),
+                        t0=time.time() - max(0.0, now - arrival),
+                        labels={"slo": slo.name, "id": rid_,
+                                "adopted": 1},
+                        span_id=str(root_sid) or None,
+                    )
+                    ctx = TraceContext(
+                        str(tid), root.span_id, bool(tr_sampled),
+                        key=file,
+                    )
                 r = PendingRequest(
                     id=rid_, client=client, model="", slo=slo,
                     file=file, payload=None,
                     session=session or None, stream=bool(stream),
-                    arrival=now - max(0.0, slo.deadline_s - float(remaining))
-                    - elapsed,
+                    arrival=arrival,
                     deadline=now + float(remaining) - elapsed,
+                    arrival_wall=time.time() - max(0.0, now - arrival),
+                    ctx=ctx,
                 )
                 self._active[rid_] = _RequestState(
-                    req=r, state="dispatched", job_id=job_id
+                    req=r, state="dispatched", job_id=job_id, root=root,
                 )
                 self._pending_by_class[slo.name] = (
                     self._pending_by_class.get(slo.name, 0) + 1

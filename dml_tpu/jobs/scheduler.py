@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..observability import METRICS
+from ..tracing import TRACER, TraceContext
 from .cost_model import (
     ModelCost,
     class_split,
@@ -442,10 +443,26 @@ class Batch:
     # WEIGHTED fair shares of its free workers (`class_weights` /
     # `_take_batches`) instead of one FIFO.
     slo_class: Optional[str] = None
+    # per-request trace contexts (dml_tpu/tracing.py wire dicts, one
+    # per request, keyed to its input file via "f"): ride next to
+    # slo_class through intake -> relay -> WORKER_TASK_REQUEST so the
+    # executing worker's fetch/infer/put spans land in each request's
+    # cross-node trace. Empty for operator jobs.
+    traces: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def key(self) -> Tuple[int, int]:
         return (self.job_id, self.batch_id)
+
+    def trace_ctxs(self) -> List[TraceContext]:
+        """Decoded SAMPLED contexts (the gate every instrumentation
+        site wants); garbled entries drop silently."""
+        out = []
+        for e in self.traces:
+            c = TraceContext.from_wire(e)
+            if c is not None and c.sampled:
+                out.append(c)
+        return out
 
 
 @dataclass
@@ -467,6 +484,10 @@ class JobState:
     # across the job's batches; transient — NOT snapshotted (a
     # restored job's batches re-execute and re-deliver)
     inline_results: Optional[Dict[str, Any]] = None
+    # last batch ACK's carried stage walls (fetch/backend/infer/put/
+    # exec seconds): the router's per-request terminal attribution
+    # source. Transient like inline_results.
+    stage_timing: Optional[Dict[str, float]] = None
 
 
 @dataclass
@@ -626,14 +647,17 @@ class Scheduler:
         streams: Optional[Dict[str, List[Any]]] = None,
         inline_results: bool = False,
         slo_class: Optional[str] = None,
+        traces: Optional[List[Dict[str, Any]]] = None,
     ) -> JobState:
         """Wrap-around sample `n_queries` inputs from `files`, slice
         into batches of the model's current batch size, queue them.
 
         `batch_size` pins the slicing explicitly — the standby replays
         the primary's relayed value so shadow batch ids always match
-        even if a C3 fanout datagram was lost. `affinity`/`streams`
-        are ingress metadata (see Batch) carried on every batch."""
+        even if a C3 fanout datagram was lost. `affinity`/`streams`/
+        `traces` are ingress metadata (see Batch) carried on every
+        batch; trace entries follow their request's input file into
+        its slice."""
         if not files:
             raise ValueError("no input files to sample from")
         if n_queries <= 0:
@@ -649,6 +673,7 @@ class Scheduler:
         batches: List[Batch] = []
         for b, start in enumerate(range(0, n_queries, bs)):
             chunk = inputs[start : start + bs]
+            chunk_set = set(chunk)
             batches.append(
                 Batch(
                     job_id=job_id,
@@ -665,6 +690,11 @@ class Scheduler:
                     },
                     inline_results=inline_results,
                     slo_class=slo_class,
+                    traces=[
+                        dict(e) for e in (traces or [])
+                        if isinstance(e, dict)
+                        and e.get("f") in chunk_set
+                    ],
                 )
             )
         q = self._queue(model)
@@ -1096,6 +1126,7 @@ class Scheduler:
             # worker but never requeue (a deterministically-failing
             # orphan batch would loop forever)
             return None
+        self._note_requeue(cur, worker)
         cur.failures += 1
         if cur.failures >= self.max_batch_failures:
             # deterministic failure: fail the JOB loudly; an infinite
@@ -1146,6 +1177,7 @@ class Scheduler:
             self._queue(staged.model).appendleft(staged)
             self.requeue_count += 1
             _M_REQUEUES.inc()
+            self._note_requeue(staged, worker)
         batch = self.in_progress.pop(worker, None)
         if batch is not None:
             # primary requeued after the staged batch so it lands at
@@ -1153,8 +1185,21 @@ class Scheduler:
             self._queue(batch.model).appendleft(batch)
             self.requeue_count += 1
             _M_REQUEUES.inc()
+            self._note_requeue(batch, worker)
         self._refresh_gauges()
         return batch
+
+    @staticmethod
+    def _note_requeue(batch: Batch, worker: str) -> None:
+        """Tail-exemplar marker per affected request trace: a requeue
+        is exactly the event that explains a later deadline miss, so
+        it is captured regardless of the head sampling decision."""
+        for e in batch.traces:
+            TRACER.note_exemplar(
+                TraceContext.from_wire(e), "requeue",
+                labels={"worker": worker, "job": batch.job_id,
+                        "batch": batch.batch_id},
+            )
 
     def drop_worker(self, worker: str) -> None:
         """Forget a worker without requeueing (voluntary leave after
@@ -1276,6 +1321,7 @@ class Scheduler:
                 "affinity": b.affinity,
                 "streams": {f: list(v) for f, v in b.streams.items()},
                 "slo_class": b.slo_class,
+                "traces": [dict(e) for e in b.traces],
             }
 
         queues: Dict[str, List[Dict[str, Any]]] = {

@@ -44,6 +44,7 @@ from ..cluster.util import BoundedDict, leader_retry, reap_task
 from ..cluster.wire import Message, MsgType
 from ..models.registry import MODEL_REGISTRY, get_model
 from ..observability import METRICS
+from ..tracing import CURRENT_CTXS, TRACER, TraceContext
 from .cost_model import ModelCost, overlap_headroom
 from .groups import GroupDirectory, note_group_requeue
 from .scheduler import Assignment, Batch, DepthController, Scheduler
@@ -929,6 +930,25 @@ class JobService:
             self._staged_at[worker] = (b.key, time.monotonic())
         else:
             self._assigned_at[worker] = (b.key, time.monotonic())
+            if b.traces:
+                # close the scheduler-side `dispatch` span on the
+                # FIRST real send: `q` (stamped by the router at
+                # ingress_submit) -> now covers scheduler queue wait +
+                # assignment. Popping `q` keeps resends from minting
+                # duplicate spans.
+                now_wall = time.time()
+                for e in b.traces:
+                    q = e.pop("q", None) if isinstance(e, dict) else None
+                    if q is None:
+                        continue
+                    ctx = TraceContext.from_wire(e)
+                    if ctx is not None and ctx.sampled:
+                        TRACER.start_span(
+                            "dispatch", ctx=ctx, node=self._me,
+                            t0=float(q),
+                            labels={"worker": worker, "job": b.job_id,
+                                    "batch": b.batch_id},
+                        ).end(now_wall)
         try:
             self.node.send_unique(
                 worker,
@@ -943,6 +963,7 @@ class JobService:
                     "staged": staged,
                     "streams": b.streams,
                     "inline": b.inline_results,
+                    "traces": b.traces,
                     "seq": next(self._task_seq),
                     "inc": self._incarnation,
                 },
@@ -1057,6 +1078,7 @@ class JobService:
         affinity: Optional[str] = None,
         streams: Optional[Dict[str, List[Any]]] = None,
         slo_class: Optional[str] = None,
+        traces: Optional[List[Dict[str, Any]]] = None,
     ) -> Any:
         """Leader-side direct intake for the request front door
         (dml_tpu/ingress/router.py): a batch the router FORMED from
@@ -1082,7 +1104,7 @@ class JobService:
         st = self.scheduler.submit_job(
             job_id, model, list(files), len(files), requester, replicas,
             batch_size=len(files), affinity=affinity, streams=streams,
-            inline_results=True, slo_class=slo_class,
+            inline_results=True, slo_class=slo_class, traces=traces,
         )
         self._relay_submit(
             job_id,
@@ -1090,7 +1112,8 @@ class JobService:
              "files": list(files), "batch_size": len(files),
              "requester": requester, "gen": self._relay_gen,
              "affinity": affinity, "streams": streams or {},
-             "inline": True, "slo": slo_class},
+             "inline": True, "slo": slo_class,
+             "traces": traces or []},
         )
         self._run_schedule()
         return st
@@ -1128,6 +1151,20 @@ class JobService:
             # the completion observers can fan them out per request
             st_pre.inline_results = {
                 **(st_pre.inline_results or {}), **d["results"],
+            }
+        if fresh_ack and "fetch_time" in d:
+            # ACK-carried stage walls, kept on the job state: the
+            # request front door's terminal attribution (per-request
+            # `stages` + the deadline-miss stage= counter) reads these
+            # synchronously at completion — available on a real
+            # multi-process cluster where the worker's spans are not
+            st_pre.stage_timing = {
+                "fetch": float(d.get("fetch_time", 0.0)),
+                "backend": float(d.get("backend_time", 0.0)),
+                "infer": float(d.get("infer_time", 0.0)),
+                "put": float(d.get("put_time", 0.0)),
+                "exec": float(d.get("exec_time", 0.0)),
+                "stage_wait": float(d.get("stage_wait_time", 0.0)),
             }
         if fresh_ack:
             # group-served ACKs advertise membership + capacity: this
@@ -1232,6 +1269,23 @@ class JobService:
             return
         prompts = d.get("prompts") or []
         budgets = d.get("budgets") or []
+        # per-request trace contexts shipped by the decode primary:
+        # the prefill member records its own `prefill` span per
+        # sampled request so the stitched trace shows where the
+        # disaggregated context phase ran
+        pf_ctxs = [
+            c for e in (d.get("traces") or [])
+            if (c := TraceContext.from_wire(e)) is not None and c.sampled
+        ]
+
+        def _prefill_spans(t0_wall: float) -> None:
+            t1_wall = time.time()
+            for c in pf_ctxs:
+                TRACER.start_span(
+                    "prefill", ctx=c, node=self._me, t0=t0_wall,
+                    labels={"model": model, "shared": len(prompts)},
+                ).end(t1_wall)
+
         if d.get("stream") and hasattr(pf, "stream_slabs"):
             dp = self.store.data_plane
             # small buffer bound: the slab producer pushes via the
@@ -1240,8 +1294,10 @@ class JobService:
             token, feed = dp.expose_stream(maxsize=64)
 
             async def serve_stream() -> None:
+                t0_wall = time.time()
                 try:
                     await pf.stream_slabs(prompts, budgets, feed)
+                    _prefill_spans(t0_wall)
                 finally:
                     # unexpose the moment the puller drains to EOF;
                     # the TTL only bounds leakage when the puller
@@ -1263,19 +1319,25 @@ class JobService:
             )
             return
         self._spawn_bg(
-            self._serve_prefill(pf, prompts, budgets, msg.sender, rid),
+            self._serve_prefill(
+                pf, prompts, budgets, msg.sender, rid, _prefill_spans
+            ),
             f"lm prefill {model} x{len(prompts)}",
         )
 
     async def _serve_prefill(
-        self, pf, prompts, budgets, reply_to: str, rid
+        self, pf, prompts, budgets, reply_to: str, rid,
+        prefill_spans=None,
     ) -> None:
         import tempfile
 
         try:
+            t0_wall = time.time()
             data = await asyncio.to_thread(
                 pf.slabs_bytes, prompts, budgets
             )
+            if prefill_spans is not None:
+                prefill_spans(t0_wall)
             tmpdir = self.store.cfg.download_path()
             os.makedirs(tmpdir, exist_ok=True)
             fd, path = tempfile.mkstemp(prefix="kvslab_", dir=tmpdir)
@@ -1559,6 +1621,7 @@ class JobService:
             streams=d.get("streams") or None,
             inline_results=bool(d.get("inline")),
             slo_class=d.get("slo"),
+            traces=d.get("traces") or None,
         )
 
     async def _h_ack_relay(self, msg: Message, addr) -> None:
@@ -1747,6 +1810,9 @@ class JobService:
                 f: list(v) for f, v in (d.get("streams") or {}).items()
             },
             inline_results=bool(d.get("inline")),
+            traces=[
+                e for e in (d.get("traces") or []) if isinstance(e, dict)
+            ],
         )
         if key in self._running:
             return  # duplicate/re-sent delivery of a running batch
@@ -1978,9 +2044,13 @@ class JobService:
         coordinator: str,
         prep: Optional[asyncio.Task] = None,
     ) -> None:
+        import dataclasses as _dc
+
         from ..observability import span
 
         fanout: Optional[_StreamFanout] = None
+        ctx_token = None
+        trace_ctxs: List[TraceContext] = []
         try:
             with span("worker.fetch_inputs"):
                 if prep is None:
@@ -1990,6 +2060,44 @@ class JobService:
                     paths, imgs, t_fetch, t_decode, t0, t_prep_end = await prep
             _M_FETCH.observe(t_fetch)
             t1 = time.monotonic()
+            if batch.traces:
+                # per-request trace contexts, re-keyed from sdfs name
+                # to the LOCAL input path so backend internals (the
+                # disagg LM prefill/handoff spans) can route contexts
+                # per request without a side table. ALL contexts ride
+                # the contextvar (the fallback-exemplar paths must see
+                # unsampled requests too); the ordinary span loops
+                # below gate on .sampled themselves.
+                by_file = {}
+                for e in batch.traces:
+                    c = TraceContext.from_wire(e)
+                    if c is not None:
+                        by_file[c.key] = c
+                all_ctxs = [
+                    _dc.replace(c, key=p)
+                    for p, f in zip(paths, batch.files)
+                    if (c := by_file.get(f)) is not None
+                ]
+                trace_ctxs = [c for c in all_ctxs if c.sampled]
+                # the fetch span is wall-positioned at the PREPARE
+                # window (a staged batch's prepare ran long before
+                # this dispatch)
+                prep_end_wall = time.time() - max(
+                    0.0, time.monotonic() - t_prep_end
+                )
+                for c in trace_ctxs:
+                    TRACER.start_span(
+                        "fetch", ctx=c, node=self._me,
+                        t0=prep_end_wall - t_fetch - t_decode,
+                        labels={"job": batch.job_id,
+                                "batch": batch.batch_id,
+                                "shared": len(batch.files)},
+                    ).end(prep_end_wall)
+                # batch-scoped contexts for instrumentation that
+                # cannot thread them through its signature (store
+                # put/get, the LM group backends); task-local via
+                # contextvars, inherited by to_thread and subtasks
+                ctx_token = CURRENT_CTXS.set(tuple(all_ctxs))
             # staged batches park between prepare finishing and
             # promotion (waiting out the previous batch's inference) —
             # a real, named stage of exec, not "other"
@@ -2020,6 +2128,7 @@ class JobService:
             if batch.streams and token_aware:
                 fanout = _StreamFanout(self, batch, paths)
             stream_kw = {"on_token": fanout.on_token} if fanout else {}
+            infer_wall0 = time.time()
             with span("worker.inference"):
                 if group_serving:
                     # formed-group PRIMARY: serve on the group's
@@ -2076,6 +2185,19 @@ class JobService:
                 fanout.close()
             t_backend = (time.monotonic() - t1) + t_decode
             _M_INFER.observe(infer_time)
+            infer_wall1 = time.time()
+            for c in trace_ctxs:
+                # the span covers the backend CALL wall (the request
+                # sat in this stage that long); the device-only
+                # portion rides as a label
+                TRACER.start_span(
+                    "infer", ctx=c, node=self._me, t0=infer_wall0,
+                    labels={"job": batch.job_id,
+                            "batch": batch.batch_id,
+                            "model": batch.model,
+                            "infer_s": round(infer_time, 6),
+                            "shared": len(batch.files)},
+                ).end(infer_wall1)
             # backends key results by the LOCAL path (the engine uses
             # the full path, others may use the basename), which
             # differs by how the input materialized (store-replica hit
@@ -2127,6 +2249,14 @@ class JobService:
                                 self._me, out_name, e)
             t_put = time.monotonic() - t_put0
             _M_PUT.observe(t_put)
+            put_wall1 = time.time()
+            for c in trace_ctxs:
+                TRACER.start_span(
+                    "put", ctx=c, node=self._me, t0=put_wall1 - t_put,
+                    labels={"job": batch.job_id,
+                            "batch": batch.batch_id,
+                            "inline": int(inline_payload is not None)},
+                ).end(put_wall1)
             _M_BATCHES.inc(model=batch.model)
             self.node.send_unique(
                 coordinator if self.node.leader_unique is None else self.node.leader_unique,
@@ -2174,6 +2304,8 @@ class JobService:
             # coordinator's on_batch_failed does the same promotion)
             self._promote_staged()
         finally:
+            if ctx_token is not None:
+                CURRENT_CTXS.reset(ctx_token)
             if fanout is not None:
                 # idempotent: normal completion already closed; this
                 # covers failure/preemption — a stream always EOFs

@@ -78,7 +78,10 @@ slab pull wall),
 class, ``request_shed_total`` admission sheds labeled
 ``slo=``/``reason=`` (queue_full | deadline_unmeetable),
 ``request_rejected_total`` post-admission typed rejections,
-``request_deadline_miss_total`` completions past their deadline,
+``request_deadline_miss_total`` completions past their deadline —
+labeled ``stage=`` with the miss's DOMINANT stage from its trace
+attribution (formation | dispatch | fetch | infer | put |
+unattributed), so the counter alone says WHERE the tail is lost,
 ``request_queue_wait_seconds`` admission->dispatch wait and
 ``request_e2e_latency_seconds`` admission->completion latency
 histograms per class — the p50/p95/p99 source of the
@@ -206,6 +209,9 @@ line when you add the metric.
     store_report_delta_skipped_total re-report ticks with nothing to say
     store_report_delta_total         inventory re-reports by kind
     store_write_failures_total       local write failures (ENOSPC etc.)
+    tracing_exemplars_total          tail-exemplar span captures by kind
+    tracing_spans_dropped_total      flight-recorder ring evictions
+    tracing_spans_total              finished spans observed by sampled=
     transport_bytes_received_total   datagram bytes in by msg type
     transport_bytes_sent_total       datagram bytes out by msg type
     transport_malformed_dropped_total  frames dying in Message.unpack

@@ -1029,6 +1029,115 @@ def run_request_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# round-14 distributed request tracing: the request_serving section
+# embeds a `tracing` block (dml_tpu/tracing.py — per-request span
+# collection, p99 cohort attribution, deadline-miss exemplars, flight
+# recorder budget, sampling-off overhead rerun)
+# ----------------------------------------------------------------------
+
+#: first round whose request_serving section must carry the tracing
+#: block (cross-node span collection + tail attribution)
+TRACING_REQUIRED_FROM_ROUND = 14
+
+
+def check_tracing_block(path: str) -> List[str]:
+    """Validate the ``request_serving.tracing`` block WHEN the section
+    ran:
+
+    - ``p99_attrib_ok`` True with ``attributed_fraction`` >= 0.9 — the
+      p99 cohort's per-stage breakdown explains at least 90% of its
+      measured e2e latency (an attribution that explains less is a
+      broken stitch, not an observability layer);
+    - ``miss_exemplar_coverage`` == 1.0 — every deadline miss has an
+      exemplar trace regardless of the sampling rate (the misses ARE
+      the requests that need explaining);
+    - the flight recorder stayed within its configured span budget
+      (``recorder.within_budget``);
+    - the sampling=0 overhead rerun was recorded and its p99 sits
+      within noise of the traced run (ratio <= 2.0 — a tracer that
+      doubles the tail is measuring itself).
+
+    Artifacts before round ``TRACING_REQUIRED_FROM_ROUND`` are exempt;
+    summary-only driver captures gate on the compact line's
+    ``trace_p99_attrib_ok`` key."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < TRACING_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        if s.get("trace_p99_attrib_ok") is False:
+            return [f"{name}: summary trace_p99_attrib_ok is false — "
+                    "the p99 cohort's stage attribution did not explain "
+                    ">= 90% of its e2e latency"]
+        return []
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "request_serving" in not_run:
+        return []
+    block = matrix.get("request_serving")
+    if block is None or block.get("skipped"):
+        return []  # the request gate already flags a missing section
+    tb = block.get("tracing")
+    if not isinstance(tb, dict):
+        if rnd is None:
+            return []  # partial/preview artifact
+        return [f"{name}: request_serving ran without a `tracing` "
+                "block — per-request tracing is required from round "
+                f"{TRACING_REQUIRED_FROM_ROUND}"]
+    problems: List[str] = []
+    if tb.get("p99_attrib_ok") is not True:
+        problems.append(
+            f"{name}: tracing.p99_attrib_ok = "
+            f"{tb.get('p99_attrib_ok')!r} — the p99 cohort's stage "
+            "attribution must explain >= 90% of its measured e2e"
+        )
+    af = (tb.get("p99_attribution") or {}).get("attributed_fraction")
+    if not isinstance(af, (int, float)) or not math.isfinite(af) \
+            or af < 0.9:
+        problems.append(
+            f"{name}: tracing attributed_fraction = {af!r} (< 0.9 or "
+            "missing)"
+        )
+    cov = tb.get("miss_exemplar_coverage")
+    if not isinstance(cov, (int, float)) or cov < 0.999:
+        problems.append(
+            f"{name}: tracing.miss_exemplar_coverage = {cov!r} — every "
+            "deadline miss must have an exemplar trace (sampling must "
+            "not hide the tail)"
+        )
+    rec = tb.get("recorder") or {}
+    if rec.get("within_budget") is not True:
+        problems.append(
+            f"{name}: tracing.recorder.within_budget = "
+            f"{rec.get('within_budget')!r} — the flight recorder "
+            "exceeded its configured span budget"
+        )
+    ov = tb.get("overhead") or {}
+    ratio = ov.get("p99_traced_vs_untraced")
+    if not isinstance(ratio, (int, float)) or not math.isfinite(ratio) \
+            or ratio <= 0:
+        problems.append(
+            f"{name}: tracing.overhead.p99_traced_vs_untraced = "
+            f"{ratio!r} — the sampling=0 overhead rerun was never "
+            "measured"
+        )
+    elif ratio > 2.0:
+        problems.append(
+            f"{name}: tracing overhead ratio {ratio!r} > 2.0 — tracing "
+            "is perturbing the tail it claims to measure"
+        )
+    return problems
+
+
+def run_tracing_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_tracing_block(artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # static-analysis verdict: the bench preamble runs tools/dmllint.py and
 # records the result; from round 11 on an artifact must say the tree
 # is lint-clean (zero un-baselined async-hazard/drift findings) with a
@@ -1320,6 +1429,9 @@ def main() -> None:
     for problem in run_request_check(art_path):
         total += 1
         print(f"request block: {problem}")
+    for problem in run_tracing_check(art_path):
+        total += 1
+        print(f"tracing block: {problem}")
     for problem in run_lint_check(art_path):
         total += 1
         print(f"lint block: {problem}")

@@ -77,6 +77,14 @@ so fixture trees exercise them selectively):
   ``tests/conftest.py`` mirror must be identical sets; a registered
   marker no test uses is flagged (the mirror only stays honest while
   every entry is load-bearing).
+- ``drift-span-names`` — every literal ``start_span("<name>", ...)``
+  call site in the tree must use a name declared in
+  ``dml_tpu/tracing.py``'s ``SPAN_NAMES`` registry (the stage
+  vocabulary the tail-attribution table reports); a registered name no
+  call site emits is flagged, and a NON-literal span name in
+  ``dml_tpu/`` (outside tracing.py itself) is flagged as unverifiable
+  — stage names in the attribution table must not be able to drift
+  from the instrumentation.
 
 Baseline
 --------
@@ -111,11 +119,12 @@ R_WIRE = "drift-wire-handlers"
 R_METRICS = "drift-metrics-map"
 R_SUMMARY = "drift-summary-keys"
 R_MARKERS = "drift-pytest-markers"
+R_SPANS = "drift-span-names"
 R_STALE = "baseline-stale"
 
 ALL_RULES = (
     R_NAKED, R_SILENT, R_BLOCKING, R_UNSEEDED,
-    R_WIRE, R_METRICS, R_SUMMARY, R_MARKERS, R_STALE,
+    R_WIRE, R_METRICS, R_SUMMARY, R_MARKERS, R_SPANS, R_STALE,
 )
 
 #: blocking calls flagged inside ``async def`` (module attr, call name)
@@ -783,6 +792,127 @@ def rule_summary(root: str, trees: Dict[str, ast.Module]) -> List[Finding]:
 
 
 # ----------------------------------------------------------------------
+# drift-span-names
+# ----------------------------------------------------------------------
+
+TRACING_REL = "dml_tpu/tracing.py"
+
+
+def collect_span_call_sites(
+    trees: Dict[str, ast.Module],
+) -> Tuple[Dict[str, List[Tuple[str, int]]], List[Tuple[str, int]]]:
+    """-> (span name -> [(path, line), ...] for every LITERAL
+    ``start_span("<name>", ...)`` call, [(path, line), ...] of
+    non-literal call sites). tracing.py itself is excluded — its
+    generic machinery passes names through variables by design."""
+    literal: Dict[str, List[Tuple[str, int]]] = {}
+    dynamic: List[Tuple[str, int]] = []
+    for rel, tree in sorted(trees.items()):
+        if rel == TRACING_REL:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node.func) != "start_span":
+                continue
+            name_arg: Optional[ast.AST] = (
+                node.args[0] if node.args else None
+            )
+            if name_arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_arg = kw.value
+            if isinstance(name_arg, ast.Constant) and isinstance(
+                name_arg.value, str
+            ):
+                literal.setdefault(name_arg.value, []).append(
+                    (rel, node.lineno)
+                )
+            else:
+                dynamic.append((rel, node.lineno))
+    return literal, dynamic
+
+
+def collect_tracing_literals(tree: ast.Module) -> Set[str]:
+    """Span names the tracer's OWN machinery emits, counting as used
+    without a start_span call site. Deliberately narrow — only (a)
+    module-level ``NAME = "str"`` aliases (``SPAN_ROOT``) and (b)
+    string literals passed positionally to a ``Span(...)``
+    construction (``note_exemplar``'s marker). Any broader net (e.g.
+    every string constant in the module) would let incidental
+    literals — the attribution code's stage sets, docstring fragments
+    — permanently mask the registered-but-never-emitted check."""
+    out: Set[str] = set()
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out.add(node.value.value)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and _call_name(node.func) == "Span"):
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    out.add(arg.value)
+    return out
+
+
+def check_span_names(
+    registry: Optional[Dict[str, int]],
+    literal: Dict[str, List[Tuple[str, int]]],
+    dynamic: List[Tuple[str, int]],
+    tracing_literals: Set[str],
+    tracing_rel: str,
+) -> List[Finding]:
+    fs: List[Finding] = []
+
+    def f(path: str, line: int, subject: str, msg: str) -> None:
+        fs.append(Finding(path=path, line=line, rule=R_SPANS, msg=msg,
+                          key=f"{R_SPANS}:{subject}"))
+
+    if registry is None:
+        f(tracing_rel, 1, "no-registry",
+          "tracing.py has no module-level SPAN_NAMES tuple — the span "
+          "vocabulary must be declared where the linter (and the "
+          "attribution table) can see it")
+        return fs
+    for name, sites in sorted(literal.items()):
+        if name not in registry:
+            path, line = sites[0]
+            f(path, line, f"unregistered:{name}",
+              f"start_span({name!r}) uses a span name not declared in "
+              "tracing.SPAN_NAMES — add it to the registry first, or "
+              "the attribution table silently drops this stage")
+    for name, line in sorted(registry.items()):
+        if name not in literal and name not in tracing_literals:
+            f(tracing_rel, line, f"unused:{name}",
+              f"SPAN_NAMES entry {name!r} has no start_span call site "
+              "— a stage the table reports but nothing ever emits")
+    for path, line in dynamic:
+        if path.startswith("dml_tpu/"):
+            f(path, line, f"dynamic:{path}:{line}",
+              "start_span with a non-literal name cannot be checked "
+              "against SPAN_NAMES — pass the registry constant "
+              "directly so the stage vocabulary stays closed")
+    return fs
+
+
+def rule_spans(root: str, trees: Dict[str, ast.Module]) -> List[Finding]:
+    if TRACING_REL not in trees:
+        return []
+    tracing_tree = trees[TRACING_REL]
+    literal, dynamic = collect_span_call_sites(trees)
+    return check_span_names(
+        _module_const_strs(tracing_tree, "SPAN_NAMES"),
+        literal, dynamic,
+        collect_tracing_literals(tracing_tree),
+        TRACING_REL,
+    )
+
+
+# ----------------------------------------------------------------------
 # drift-pytest-markers
 # ----------------------------------------------------------------------
 
@@ -1003,7 +1133,8 @@ def run_lint(
         rel = _rel(root, path)
         trees[rel] = _parse(path, rel)  # raises LintInternalError
         findings.extend(analyze_tree(trees[rel], rel))
-    for rule_fn in (rule_wire, rule_metrics, rule_summary, rule_markers):
+    for rule_fn in (rule_wire, rule_metrics, rule_summary, rule_markers,
+                    rule_spans):
         findings.extend(rule_fn(root, trees))
     baseline = load_baseline(baseline_path)
     new, suppressed = apply_baseline(

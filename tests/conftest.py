@@ -71,5 +71,6 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "ingress: request front-door coverage (SLO admission/shedding, continuous batch formation, open-loop load, token streaming)")
     config.addinivalue_line("markers", "pp: pipeline-parallel LM serving coverage (layer-stack stage sharding over the pp mesh axis, microbatched stage handoff)")
     config.addinivalue_line("markers", "lint: static-analysis coverage (tools/dmllint.py rule fixtures and the tier-1 zero-unbaselined-findings enforcement)")
+    config.addinivalue_line("markers", "tracing: distributed request-tracing coverage (span propagation, flight recorder, cluster trace collection, tail attribution)")
     config.addinivalue_line("markers", "scale: control-plane scale coverage (bounded delta gossip, relay metrics aggregation, O(100)-node sims, sustained churn)")
 

@@ -23,9 +23,12 @@ from dml_tpu.tools.dmllint import (
     apply_baseline,
     check_markers,
     check_metrics,
+    check_span_names,
     check_summary,
     check_wire,
     collect_metric_registrations,
+    collect_span_call_sites,
+    collect_tracing_literals,
     extract_bench_summary_keys,
     extract_claim_gate_keys,
     extract_handler_owners,
@@ -385,6 +388,87 @@ def test_summary_drift_detected():
     # and the missing-keep-list degradation is itself a finding
     fs2 = check_summary({"a": 1}, None, None, {}, "bench.py", "c.py")
     assert any("no module-level _COMPACT_KEEP_KEYS" in f.msg for f in fs2)
+
+
+# ----------------------------------------------------------------------
+# drift-span-names
+# ----------------------------------------------------------------------
+
+TRACING_FIXTURE = textwrap.dedent("""
+    SPAN_ROOT = "request"
+
+    SPAN_NAMES = (
+        "request",   # root
+        "fetch",     # worker fetch
+        "marker",    # exemplar marker (tracer-internal)
+        "ghost",     # registered, never emitted anywhere
+    )
+
+    def _note(tracer):
+        # direct Span construction counts as tracer-internal usage;
+        # the set below must NOT (incidental literal, not an emit)
+        _detail = {"ghost"}
+        return Span(tracer, "marker")
+""")
+
+SPAN_USER_FIXTURE = textwrap.dedent("""
+    from ..tracing import TRACER
+
+    def ok(ctx):
+        TRACER.start_span("fetch", ctx=ctx).end()
+
+    def bad(ctx):
+        TRACER.start_span("not_a_stage", ctx=ctx).end()
+
+    def dynamic(ctx, name):
+        TRACER.start_span(name, ctx=ctx).end()
+""")
+
+
+def test_span_name_extractors():
+    trees = {
+        "dml_tpu/tracing.py": ast.parse(TRACING_FIXTURE),
+        "dml_tpu/jobs/x.py": ast.parse(SPAN_USER_FIXTURE),
+    }
+    literal, dynamic = collect_span_call_sites(trees)
+    assert set(literal) == {"fetch", "not_a_stage"}
+    assert len(dynamic) == 1 and dynamic[0][0] == "dml_tpu/jobs/x.py"
+    lits = collect_tracing_literals(ast.parse(TRACING_FIXTURE))
+    assert {"request", "marker"} <= lits
+
+
+def test_span_name_drift_detected():
+    tr = ast.parse(TRACING_FIXTURE)
+    trees = {
+        "dml_tpu/tracing.py": tr,
+        "dml_tpu/jobs/x.py": ast.parse(SPAN_USER_FIXTURE),
+    }
+    literal, dynamic = collect_span_call_sites(trees)
+    fs = check_span_names(
+        dmllint._module_const_strs(tr, "SPAN_NAMES"),
+        literal, dynamic, collect_tracing_literals(tr),
+        "dml_tpu/tracing.py",
+    )
+    msgs = " | ".join(f.msg for f in fs)
+    # unknown literal name at a call site
+    assert "'not_a_stage'" in msgs
+    # registered name nothing ever emits
+    assert "'ghost'" in msgs
+    # names referenced only inside tracing.py count as used
+    assert "'request'" not in msgs and "'marker'" not in msgs
+    # non-literal call sites in dml_tpu/ are unverifiable
+    assert "non-literal" in msgs
+    # missing registry degrades to its own finding
+    fs2 = check_span_names(None, literal, dynamic, set(),
+                           "dml_tpu/tracing.py")
+    assert any("no module-level SPAN_NAMES" in f.msg for f in fs2)
+    # tests/ may pass computed names (only dml_tpu/ is gated)
+    fs3 = check_span_names(
+        dmllint._module_const_strs(tr, "SPAN_NAMES"),
+        {"fetch": [("tests/t.py", 3)]}, [("tests/t.py", 9)],
+        collect_tracing_literals(tr), "dml_tpu/tracing.py",
+    )
+    assert not any("non-literal" in f.msg for f in fs3)
 
 
 # ----------------------------------------------------------------------

@@ -627,7 +627,8 @@ def test_disagg_adoption_failure_falls_back(tmp_path, parts, monkeypatch):
     def fake_peers():
         return ["peer0"]
 
-    async def bad_share(peer, model, idxs, ps, budgets, arrivals):
+    async def bad_share(peer, model, idxs, ps, budgets, arrivals,
+                        ctxs=None):
         # right count, wrong shapes: first slab's T axis lies
         slabs = [pf.prefill_one(ps[i], budgets[i]) for i in idxs]
         import numpy as _np
