@@ -2566,7 +2566,8 @@ def _probe_parity_weights():
 
 def _probe_lint():
     """Static-analysis verdict for the bench preamble: dmllint's
-    un-baselined finding count + baseline size (tools/dmllint.py).
+    un-baselined finding count + baseline size (tools/dmllint.py),
+    plus the flow-aware pass counts (tools/dmlflow.py) from round 16.
     The artifact records the tree's hazard/drift state mechanically —
     claim_check.check_lint_block holds round-11+ artifacts to
     lint_clean=true."""
@@ -2898,10 +2899,15 @@ def main() -> None:
             "control_plane_scale", "scale_metrics_wall_s"),
         "scale_ok": g("control_plane_scale", "scale_ok"),
         "scale_churn_ok": g("control_plane_scale", "churn", "ok"),
-        # static-analysis verdict (tools/dmllint.py, round-11 gate)
+        # static-analysis verdict (tools/dmllint.py, round-11 gate);
+        # the flow-aware pass counts (tools/dmlflow.py: race-yield-
+        # hazard / drift-wire-payloads, baselined findings included)
+        # are the round-16 gate
         "lint_clean": g("lint", "lint_clean"),
         "lint_findings": g("lint", "findings"),
         "lint_baseline": g("lint", "baseline_size"),
+        "lint_race": g("lint", "race_findings"),
+        "lint_payload": g("lint", "payload_findings"),
         "chaos_ok": g("chaos", "all_invariants_ok"),
         "chaos_failover_s": g("chaos", "failover_recovery_s"),
         "chaos_repair_s": g("chaos", "store_repair_s"),
@@ -3014,8 +3020,9 @@ COMPACT_SUMMARY_BUDGET = 1500
 #: lm_sharded_equal the round-8 sharded-LM gate; lm_pp_toks /
 #: lm_stream_ttft_ms / lm_stream_vs_slab the round-10 pipeline+
 #: streamed-handoff gate; req_* the round-9 request-serving gate;
-#: lint_clean the round-11 static-analysis gate; scale_* the
-#: round-12 control-plane-scale gate.
+#: lint_clean the round-11 static-analysis gate (lint_race /
+#: lint_payload extend it to the round-16 flow-aware rules); scale_*
+#: the round-12 control-plane-scale gate.
 _COMPACT_KEEP_KEYS = (
     "headline_qps", "cluster_qps", "cluster_pipelining",
     "cluster_lm_tok_s", "cluster_lm_steady_tok_s",
@@ -3027,7 +3034,7 @@ _COMPACT_KEEP_KEYS = (
     "req_p99_ms", "req_goodput_qps",
     "req_shed_ratio", "req_failover_ok",
     "trace_p99_attrib_ok",
-    "lint_clean",
+    "lint_clean", "lint_race", "lint_payload",
     "scale_converge_s", "scale_detect_s",
     "scale_bytes_per_node_s", "scale_ok",
     "section_errors", "sections_skipped",

@@ -682,6 +682,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "dml_tpu/tools/dmllint_baseline.json)")
     pl.add_argument("--json", action="store_true",
                     help="machine-readable output")
+    pl.add_argument("--rules", default=None, metavar="R1,R2",
+                    help="only report these rules (comma-separated), "
+                         "e.g. race-yield-hazard,drift-wire-payloads")
+    pl.add_argument("--paths", default=None, metavar="GLOB[,GLOB]",
+                    help="only report findings under these path globs")
 
     pc = sub.add_parser(
         "chaos",
@@ -755,6 +760,10 @@ def main(argv: Optional[List[str]] = None) -> None:
             lint_argv += ["--baseline", args.baseline]
         if args.json:
             lint_argv.append("--json")
+        if args.rules:
+            lint_argv += ["--rules", args.rules]
+        if args.paths:
+            lint_argv += ["--paths", args.paths]
         raise SystemExit(dmllint.main(lint_argv))
     if args.command == "localspec":
         spec = ClusterSpec.localhost(args.n, base_port=args.base_port)

@@ -60,13 +60,16 @@ class IntroducerService:
                  self.me.unique_name, self.current_introducer)
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
+        # snapshot-before-await (dmllint race-yield-hazard): clear the
+        # attribute before the join yields, so a concurrent
+        # start()/stop() pair can't null a freshly-created serve task
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
             try:
-                await self._task
+                await task
             except asyncio.CancelledError:
                 pass
-            self._task = None
         if self.transport is not None:
             self.transport.close()
             self.transport = None

@@ -1003,7 +1003,7 @@ class Node:
         timeout = min(max(timeout, 0.1), 30.0)
 
         async def relay() -> None:
-            dumps, failed = await self._pull_peer_spans(
+            dumps, failed, degraded = await self._pull_peer_spans(
                 [
                     n for p in peers
                     if isinstance(p, str)
@@ -1020,10 +1020,33 @@ class Node:
             self._send_trace_tiered(
                 msg.sender, merged,
                 {**extra, "covered": sorted(dumps),
-                 "failed": sorted(failed)},
+                 "failed": sorted(failed),
+                 # per-peer degradation must survive the hop: without
+                 # it the leader's relay-mode coverage claim is blind
+                 # to truncated shard members
+                 "degraded": degraded},
             )
 
         self._spawn_bg(relay(), name=f"{self.me}-trace-relay")
+
+    @staticmethod
+    def _trace_reply_degradation(
+        reply: Dict[str, Any], got: int
+    ) -> Optional[Dict[str, Any]]:
+        """Did this TRACE_PULL_ACK fit the frame only by degrading?
+        The count-only tier sets ``truncated``, the halved-newest-half
+        tiers are detectable as got < held, and label/event stripping
+        ships ``stripped``. None = a full reply."""
+        held = reply.get("held")
+        partial = isinstance(held, int) and got < held
+        if not (reply.get("truncated") or reply.get("stripped") or partial):
+            return None
+        out: Dict[str, Any] = {"held": held, "got": got}
+        if reply.get("truncated"):
+            out["truncated"] = reply.get("truncated")
+        if reply.get("stripped"):
+            out["stripped"] = True
+        return out
 
     async def _pull_peer_spans(
         self,
@@ -1032,12 +1055,18 @@ class Node:
         max_spans: int,
         timeout: float,
         concurrency: int = 8,
-    ) -> Tuple[Dict[str, list], List[str]]:
+    ) -> Tuple[Dict[str, list], List[str], Dict[str, Dict[str, Any]]]:
         """Bounded-concurrency TRACE_PULL fan-out (the span analog of
         ``_pull_peer_snapshots``): a dead peer costs one slot-wait,
-        never a serial wall."""
+        never a serial wall. The third return maps peers whose reply
+        DEGRADED (``truncated`` tier marker, ``held`` recorder size) —
+        the ACK ships those fields so the aggregated view can say
+        "this node's recorder outgrew the frame", and until
+        drift-wire-payloads flagged them as sent-never-read they were
+        silently dropped here."""
         dumps: Dict[str, list] = {}
         failed: List[str] = []
+        degraded: Dict[str, Dict[str, Any]] = {}
         sem = asyncio.Semaphore(max(1, concurrency))
         req: Dict[str, Any] = {"max_spans": max_spans}
         if trace_ids is not None:
@@ -1055,11 +1084,20 @@ class Node:
             spans = reply.get("spans")
             if reply.get("ok") and isinstance(spans, list):
                 dumps[peer.unique_name] = spans
+                deg = self._trace_reply_degradation(reply, len(spans))
+                if deg is not None:
+                    degraded[peer.unique_name] = deg
             else:
+                if reply.get("error"):
+                    log.warning(
+                        "%s: TRACE_PULL from %s failed explicitly: %s",
+                        self.me.unique_name, peer.unique_name,
+                        reply.get("error"),
+                    )
                 failed.append(peer.unique_name)
 
         await asyncio.gather(*(pull_one(n) for n in peers))
-        return dumps, failed
+        return dumps, failed, degraded
 
     async def pull_cluster_traces(
         self,
@@ -1078,13 +1116,15 @@ class Node:
         per-trace trees.
 
         Returns ``{"spans": [...], "traces": {trace_id: [spans]},
-        "nodes": {unique_name: span_count}, "unreachable": [...]}``."""
+        "nodes": {unique_name: span_count}, "unreachable": [...],
+        "degraded": {unique_name: {"truncated": ..., "held": n}}}``."""
         from .. import tracing as trc
 
         per_node = min(max(int(max_spans), 1), 2048)
         local = trc.TRACER.dump(trace_ids=trace_ids, max_spans=per_node)
         dumps: Dict[str, list] = {self.me.unique_name: local}
         failed: List[str] = []
+        degraded: Dict[str, Dict[str, Any]] = {}
         if peers is None:
             peers = self.membership.alive_nodes()
         others = sorted(
@@ -1124,19 +1164,33 @@ class Node:
                         c for c in reply.get("failed", [])
                         if isinstance(c, str)
                     )
+                    # shard members whose reply degraded at the relay,
+                    # plus the relay's own merged reply if IT hit the
+                    # frame cap (the pre-merged shard is the likeliest
+                    # frame to truncate)
+                    deg = reply.get("degraded")
+                    if isinstance(deg, dict):
+                        degraded.update({
+                            k: v for k, v in deg.items()
+                            if isinstance(k, str) and isinstance(v, dict)
+                        })
+                    own = self._trace_reply_degradation(reply, len(spans))
+                    if own is not None:
+                        degraded[relay.unique_name] = own
                     return
                 # relay down/degraded: pull its shard (and it) direct
-                got, bad = await self._pull_peer_spans(
+                got, bad, deg = await self._pull_peer_spans(
                     [relay] + shard, trace_ids=trace_ids,
                     max_spans=per_node, timeout=timeout,
                     concurrency=concurrency,
                 )
                 dumps.update(got)
                 failed.extend(bad)
+                degraded.update(deg)
 
             await asyncio.gather(*(pull_relay(r) for r in relay_nodes))
         elif others:
-            got, failed = await self._pull_peer_spans(
+            got, failed, degraded = await self._pull_peer_spans(
                 others, trace_ids=trace_ids, max_spans=per_node,
                 timeout=timeout, concurrency=concurrency,
             )
@@ -1147,6 +1201,10 @@ class Node:
             "traces": trc.assemble_traces(spans),
             "nodes": {n: len(d) for n, d in sorted(dumps.items())},
             "unreachable": sorted(failed),
+            # peers whose reply hit the datagram cap: the trace view is
+            # INCOMPLETE for them (count-only tier) — surfaced so the
+            # attribution caller can qualify its coverage claim
+            "degraded": dict(sorted(degraded.items())),
         }
 
     async def _h_ping(self, msg: Message, addr) -> None:

@@ -167,10 +167,14 @@ class DataPlane:
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # snapshot-before-await (dmllint race-yield-hazard): clear the
+        # attribute BEFORE awaiting, so a start() racing this stop
+        # can't have its fresh server overwritten with None after the
+        # wait_closed yield
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+            await server.wait_closed()
 
     # ---- client-side path exposure (PUT source) ----
 

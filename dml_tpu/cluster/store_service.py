@@ -468,6 +468,16 @@ class StoreService:
         )
         if not reply.get("ok"):
             raise FileNotFoundError(f"{sdfs_name}: {reply.get('error')}")
+        # the ACK echoes which file it answers for — validate it
+        # (drift-wire-payloads flagged the echo as dead bytes: unread,
+        # a mis-correlated or byzantine reply would fetch the wrong
+        # file's replica set without anyone noticing)
+        echo = reply.get("file")
+        if echo is not None and echo != sdfs_name:
+            raise RuntimeError(
+                f"GET {sdfs_name}: leader answered for {echo!r} — "
+                "mis-correlated reply dropped"
+            )
         want = version if version is not None else int(reply["version"])
         last_err: Optional[Exception] = None
         for uname in reply.get("replicas", []):
@@ -578,6 +588,11 @@ class StoreService:
         reply = await self._leader_retry(
             MsgType.LIST_FILE_REQUEST, {"file": sdfs_name}, timeout=15.0
         )
+        # ok gates the read (drift-wire-payloads: the flag was shipped
+        # but never checked, so a garbled rid-resolved reply was
+        # indistinguishable from "no replicas")
+        if not reply.get("ok"):
+            raise RuntimeError(f"ls {sdfs_name} failed: {reply.get('error')}")
         return reply.get("replicas", [])
 
     async def ls_all(self, pattern: str = "*") -> Dict[str, List[int]]:
@@ -586,6 +601,11 @@ class StoreService:
         reply = await self._leader_retry(
             MsgType.GET_ALL_MATCHING_FILES, {"pattern": pattern}, timeout=15.0
         )
+        if not reply.get("ok"):
+            # callers treat a failed listing as an exception, never as
+            # an empty store (the staged-weights mirror prune depends
+            # on that distinction)
+            raise RuntimeError(f"ls-all {pattern} failed: {reply.get('error')}")
         return {f: [int(v) for v in vs] for f, vs in reply.get("files", {}).items()}
 
     def local_files(self) -> Dict[str, List[int]]:
@@ -599,6 +619,8 @@ class StoreService:
         reply = await self._leader_retry(
             MsgType.FILES_PER_NODE_REQUEST, {}, timeout=15.0
         )
+        if not reply.get("ok"):
+            raise RuntimeError(f"files-per-node failed: {reply.get('error')}")
         return {
             node: {f: [int(v) for v in vs] for f, vs in inv.items()}
             for node, inv in reply.get("nodes", {}).items()
@@ -867,6 +889,27 @@ class StoreService:
         st = self.metadata.get_request(req_id)
         if st is None:
             return
+        # the ACK echoes file (+ version on success) — cross-check them
+        # against the request they claim to resolve (drift-wire-payloads
+        # flagged the echo as dead bytes: un-validated, a garbled or
+        # byzantine ACK carrying a real req id could flip a replica
+        # slot for the WRONG file/version)
+        echo_file = msg.data.get("file")
+        if echo_file is not None and echo_file != st.file:
+            log.warning(
+                "%s: PUT result for req %s echoes file %r but the "
+                "request is for %r — dropped",
+                self._me, req_id, echo_file, st.file,
+            )
+            return
+        echo_version = msg.data.get("version")
+        if echo_version is not None and int(echo_version) != st.version:
+            log.warning(
+                "%s: PUT result for req %s echoes version %s but the "
+                "request pinned v%s — dropped",
+                self._me, req_id, echo_version, st.version,
+            )
+            return
         ok = msg.type == MsgType.DOWNLOAD_FILE_SUCCESS
         st.set_status(msg.sender, "ok" if ok else "fail")
         if ok:
@@ -965,6 +1008,16 @@ class StoreService:
         req_id = msg.data.get("req", "")
         st = self.metadata.get_request(req_id)
         if st is None:
+            return
+        # same echo cross-check as the PUT path: the carried file must
+        # name the request's file or the ACK resolves nothing
+        echo_file = msg.data.get("file")
+        if echo_file is not None and echo_file != st.file:
+            log.warning(
+                "%s: DELETE result for req %s echoes file %r but the "
+                "request is for %r — dropped",
+                self._me, req_id, echo_file, st.file,
+            )
             return
         ok = msg.type == MsgType.DELETE_FILE_ACK
         st.set_status(msg.sender, "ok" if ok else "fail")
@@ -1124,6 +1177,16 @@ class StoreService:
             return
         file = msg.data.get("file", "")
         self._repairs_inflight.pop((file, msg.sender), None)
+        if msg.type == MsgType.REPLICATE_FILE_FAIL:
+            # the holder ships WHY it failed; until drift-wire-payloads
+            # flagged the key as sent-never-read, a failed repair was
+            # invisible at the leader (the holder logged locally, the
+            # repair sweep just retried blind)
+            log.warning(
+                "%s: repair of %s on %s failed: %s",
+                self._me, file, msg.sender,
+                msg.data.get("error", "unknown"),
+            )
         if msg.type == MsgType.REPLICATE_FILE_SUCCESS:
             if file not in self.metadata.all_files():
                 # the file was DELETEd while the repair was in flight:

@@ -8,6 +8,96 @@ frame: a 10-byte header + UTF-8 JSON payload. Message taxonomy mirrors
 the reference's 50-value PacketType enum (packets.py:9-60), organized
 by subsystem (types that existed only for dead code paths are folded
 into their live equivalents).
+
+Payload map (lint-enforced)
+---------------------------
+
+One line per MsgType member, machine-read by tools/dmlflow.py (rule
+``drift-wire-payloads``, mirroring observability.py's metric map) and
+cross-checked against every send site and handler/await-site read in
+``dml_tpu/`` in BOTH directions on every tier-1 run: a key listed here
+that nothing sends or reads, and a key on the wire this map doesn't
+declare, are findings. Grammar: a bare ``key`` is REQUIRED (the
+owning reader indexes it unconditionally — every sender must ship
+it); ``key?`` is OPTIONAL (shipped by some senders or read via
+``.get``/presence probe); ``-`` declares an empty payload; ``*``
+marks an OPEN payload (a sender or reader the inference cannot fully
+resolve — tiered/composed frames); ``<- REQUEST`` marks a reply type
+whose payload is read at the await site of that request (rid-fallback
+types). The ``rid`` correlation key is universal and implicit.
+Refresh the key lists with ``python -m dml_tpu.tools.dmlflow``.
+
+    PING: leader? members?
+    ACK: leader? members?
+    INTRODUCE: -
+    INTRODUCE_ACK: leader? members? <- INTRODUCE
+    FETCH_INTRODUCER: -
+    FETCH_INTRODUCER_ACK: introducer? <- FETCH_INTRODUCER
+    UPDATE_INTRODUCER: introducer?
+    UPDATE_INTRODUCER_ACK: - <- UPDATE_INTRODUCER
+    ELECTION: -
+    COORDINATE: -
+    COORDINATE_ACK: files?
+    ALL_LOCAL_FILES: all_names? delta? files? partial? removed? *
+    ALL_LOCAL_FILES_RELAY: files? node?
+    PUT_REQUEST: data_addr file token?
+    PUT_REQUEST_SUCCESS: error? ok? * <- PUT_REQUEST
+    PUT_REQUEST_FAIL: error? ok? * <- PUT_REQUEST
+    DOWNLOAD_FILE: data_addr token version file? req? *
+    DOWNLOAD_FILE_SUCCESS: error? file? req? version?
+    DOWNLOAD_FILE_FAIL: error? file? req? version?
+    GET_FILE_REQUEST: file
+    GET_FILE_REQUEST_ACK: error? file? ok? replicas? version? versions? <- GET_FILE_REQUEST
+    GET_FILE_REQUEST_FAIL: error? file? ok? replicas? version? versions? <- GET_FILE_REQUEST
+    DELETE_FILE_REQUEST: file
+    DELETE_FILE_REQUEST_SUCCESS: error? file? ok? replicas? version? * <- DELETE_FILE_REQUEST
+    DELETE_FILE_REQUEST_FAIL: error? file? ok? * <- DELETE_FILE_REQUEST
+    DELETE_FILE: file req? *
+    DELETE_FILE_ACK: file? req?
+    DELETE_FILE_NAK: file? req? *
+    REPLICATE_FILE: file source
+    REPLICATE_FILE_SUCCESS: error? file? versions?
+    REPLICATE_FILE_FAIL: error? file? versions?
+    LIST_FILE_REQUEST: file
+    LIST_FILE_REQUEST_ACK: error? ok? replicas? <- LIST_FILE_REQUEST
+    GET_ALL_MATCHING_FILES: pattern?
+    GET_ALL_MATCHING_FILES_ACK: error? files? ok? <- GET_ALL_MATCHING_FILES
+    FILES_PER_NODE_REQUEST: -
+    FILES_PER_NODE_ACK: error? nodes? ok? <- FILES_PER_NODE_REQUEST
+    STORE_IDEMPOTENCY_RELAY: file? kind? ok? reply? token?
+    SUBMIT_JOB_REQUEST: model? n? token?
+    SUBMIT_JOB_REQUEST_ACK: error? job_id? ok? <- SUBMIT_JOB_REQUEST
+    SUBMIT_JOB_REQUEST_SUCCESS: error? job_id? model? total_queries? *
+    SUBMIT_JOB_RELAY: files job model n requester affinity? batch_size? gen? inline? slo? streams? traces? *
+    WORKER_TASK_REQUEST: batch files job model inc? inline? replicas? seq? staged? streams? traces? versions?
+    WORKER_TASK_REQUEST_ACK: batch job backend_time? cost? exec_time? fetch_time? infer_time? model? n_images? put_time? results? stage_wait_time? *
+    WORKER_TASK_ACK_RELAY: batch job gen? n_images? *
+    SET_BATCH_SIZE: batch_size model fanout?
+    GET_C2_COMMAND: model?
+    GET_C2_COMMAND_ACK: ok? stats? <- GET_C2_COMMAND
+    SET_BATCH_SIZE_ACK: ok? <- SET_BATCH_SIZE
+    WORKER_TASK_FAIL: batch job error?
+    JOB_STATUS_REQUEST: job?
+    JOB_STATUS_ACK: done? error? job_id? model? ok? total_queries? * <- JOB_STATUS_REQUEST
+    JOBS_RESTORE_RELAY: version gen?
+    JOBS_RESTORE_RELAY_ACK: ok? <- JOBS_RESTORE_RELAY
+    JOB_FAILED_RELAY: job error? gen? *
+    WORKER_STAGE_CANCEL: batch job inc? seq?
+    LM_PREFILL_REQUEST: budgets? model? prompts? stream? traces? *
+    LM_PREFILL_ACK: error? n? ok? size? stream? token? * <- LM_PREFILL_REQUEST
+    METRICS_PULL: -
+    METRICS_PULL_ACK: metrics? * <- METRICS_PULL
+    METRICS_RELAY_PULL: peers? timeout?
+    METRICS_RELAY_ACK: covered? failed? metrics? ok? * <- METRICS_RELAY_PULL
+    REQUEST_SUBMIT: id? model? payload? session? slo? store_name? stream?
+    REQUEST_SUBMIT_ACK: accepted? id? reason? shed? * <- REQUEST_SUBMIT
+    REQUEST_DONE: id? ok? reason? *
+    REQUEST_STATUS: id?
+    REQUEST_STATUS_ACK: done? known? terminal? * <- REQUEST_STATUS
+    REQUEST_STREAM_READY: host? id? port? token?
+    INGRESS_RELAY: job reqs?
+    TRACE_PULL: max_spans? peers? timeout? trace_ids? *
+    TRACE_PULL_ACK: degraded? error? failed? held? ok? spans? stripped? truncated? * <- TRACE_PULL
 """
 
 from __future__ import annotations

@@ -337,9 +337,12 @@ class RequestRouter:
         )
 
     async def stop(self) -> None:
-        if self._form_task is not None:
-            await reap_task(self._form_task, self._me, "ingress formation")
-            self._form_task = None
+        # snapshot-before-await (dmllint race-yield-hazard): a start()
+        # racing this stop must not have its fresh formation task
+        # nulled out after the reap yields
+        form, self._form_task = self._form_task, None
+        if form is not None:
+            await reap_task(form, self._me, "ingress formation")
         for t in list(self._bg):
             t.cancel()
 
@@ -1183,30 +1186,39 @@ class RequestRouter:
             data["payload"] = payload
         if store_name is not None:
             data["store_name"] = store_name
+        # the finally owns the cleanup (dmllint race-yield-hazard): a
+        # CANCELLED submit — wait_for timeout around submit(), client
+        # teardown — skips `except Exception`, and the future + stream
+        # queue registered above would leak in _futs/_streams forever
+        admitted = rejected = False
         try:
             reply = await leader_retry(
                 self.node, MsgType.REQUEST_SUBMIT, data,
                 timeout=timeout, retries=retries,
             )
-        except Exception:
-            self._futs.pop(req_id, None)
-            self._streams.pop(req_id, None)
-            # the submit may have been ADMITTED with only its ACK lost
-            # — record the client's lost classification so a later
-            # completed push registers as a terminal conflict (work
-            # delivered after the client declared the request dead)
-            # instead of silently evading the exactly-once verdict
-            if req_id not in self._client_terminal:
-                self._client_terminal[req_id] = "lost"
-            raise
-        if not reply.get("accepted"):
-            self._futs.pop(req_id, None)
-            self._streams.pop(req_id, None)
-            raise RequestRejected(
-                str(reply.get("reason", "rejected")), slo=slo,
-                shed=bool(reply.get("shed")),
-            )
-        return req_id
+            if not reply.get("accepted"):
+                rejected = True  # typed shed: settled, never completes
+                raise RequestRejected(
+                    str(reply.get("reason", "rejected")), slo=slo,
+                    shed=bool(reply.get("shed")),
+                )
+            admitted = True
+            return req_id
+        finally:
+            if not admitted:
+                self._futs.pop(req_id, None)
+                self._streams.pop(req_id, None)
+                if not rejected and req_id not in self._client_terminal:
+                    # the submit may have been ADMITTED with only its
+                    # ACK lost — on ANY non-rejection exit (timeout,
+                    # no-leader, CANCELLATION — which `except
+                    # Exception` never sees) record the client's lost
+                    # classification so a later completed push
+                    # registers as a terminal conflict (work delivered
+                    # after the client declared the request dead)
+                    # instead of silently evading the exactly-once
+                    # verdict
+                    self._client_terminal[req_id] = "lost"
 
     async def wait(
         self, req_id: str, timeout: Optional[float] = None

@@ -724,10 +724,15 @@ class JobService:
     async def set_batch_size(self, model: str, batch_size: int) -> None:
         """C3 verb: cluster-wide batch size change (reference
         SET_BATCH_SIZE, worker.py:1028-1037)."""
-        await self.node.leader_request(
+        reply = await self.node.leader_request(
             MsgType.SET_BATCH_SIZE,
             {"model": self._canon(model), "batch_size": int(batch_size)},
         )
+        # the ACK's ok flag gates success (drift-wire-payloads: it was
+        # shipped but never checked — a garbled rid-resolved reply
+        # passed as a silent success)
+        if not reply.get("ok"):
+            raise RuntimeError(f"set-batch-size {model} not acknowledged")
 
     async def c2_stats(self, model: str) -> Dict[str, float]:
         """C2: processing-time stats, computed on the coordinator,
@@ -736,6 +741,8 @@ class JobService:
         reply = await self.node.leader_request(
             MsgType.GET_C2_COMMAND, {"model": self._canon(model)}
         )
+        if not reply.get("ok"):
+            raise RuntimeError(f"c2-stats {model} not acknowledged")
         return reply.get("stats", {})
 
     def c1_stats(self) -> Dict[str, Dict[str, float]]:

@@ -1147,9 +1147,17 @@ def run_tracing_check(artifact_path: Optional[str] = None) -> List[str]:
 #: first round whose bench carries the dmllint verdict block
 LINT_REQUIRED_FROM_ROUND = 11
 
+#: first round whose lint block must ALSO carry the flow-aware pass
+#: counts (tools/dmlflow.py: race-yield-hazard + drift-wire-payloads,
+#: landed with the round-16 build) — their presence proves both passes
+#: ran, and lint_clean covers their findings from that round on
+FLOW_LINT_REQUIRED_FROM_ROUND = 16
+
 #: the baseline may only shrink; tests/test_dmllint.py enforces the
 #: same bound at tier-1 time, this enforces it on the artifact record
-LINT_BASELINE_MAX = 10
+#: (raised 10 -> 25 with the flow-aware rules: justified benign
+#: interleavings/echo keys are grandfathered per ISSUE 13)
+LINT_BASELINE_MAX = 25
 
 
 def check_lint_block(path: str) -> List[str]:
@@ -1159,22 +1167,39 @@ def check_lint_block(path: str) -> List[str]:
     recorded, and the grandfather baseline must stay within
     ``LINT_BASELINE_MAX`` entries.
 
+    From round ``FLOW_LINT_REQUIRED_FROM_ROUND`` the block must also
+    carry integer ``race_findings`` / ``payload_findings`` counts —
+    the proof that the flow-aware passes (race-yield-hazard,
+    drift-wire-payloads) ran under lint_clean.
+
     Artifacts before round ``LINT_REQUIRED_FROM_ROUND`` are exempt;
     summary-only driver captures gate on the compact line's
-    ``lint_clean`` key."""
+    ``lint_clean`` key (plus ``lint_race`` / ``lint_payload`` from the
+    flow round on)."""
     from .parity_table import load_bench
 
     name = os.path.basename(path)
     rnd = artifact_round(path)
     if rnd is not None and rnd < LINT_REQUIRED_FROM_ROUND:
         return []
+    flow_required = rnd is not None and rnd >= FLOW_LINT_REQUIRED_FROM_ROUND
     data = load_bench(path)
     if data.get("_summary_only"):
         s = data.get("summary") or {}
         if s.get("lint_clean") is False:
             return [f"{name}: summary lint_clean is false — the round "
                     "ran on a tree with un-baselined dmllint findings"]
-        return []
+        problems: List[str] = []
+        if flow_required:
+            for key in ("lint_race", "lint_payload"):
+                if not isinstance(s.get(key), int):
+                    problems.append(
+                        f"{name}: summary {key} = {s.get(key)!r} — the "
+                        "flow-aware lint pass counts must ride the "
+                        "compact line from round "
+                        f"{FLOW_LINT_REQUIRED_FROM_ROUND} on"
+                    )
+        return problems
     matrix = data.get("matrix", {})
     block = matrix.get("lint")
     if block is None:
@@ -1202,6 +1227,23 @@ def check_lint_block(path: str) -> List[str]:
             f"baseline must hold <= {LINT_BASELINE_MAX} justified "
             "entries (it only ever shrinks)"
         )
+    if flow_required:
+        for key in ("race_findings", "payload_findings"):
+            if not isinstance(block.get(key), int):
+                problems.append(
+                    f"{name}: lint.{key} = {block.get(key)!r} — the "
+                    "flow-aware passes (race-yield-hazard / "
+                    "drift-wire-payloads) must record their counts "
+                    f"from round {FLOW_LINT_REQUIRED_FROM_ROUND} on"
+                )
+        rules = block.get("rules")
+        if isinstance(rules, list) and not (
+                {"race-yield-hazard", "drift-wire-payloads"} <= set(rules)):
+            problems.append(
+                f"{name}: lint.rules is missing the flow-aware rules — "
+                "the verdict does not cover race-yield-hazard / "
+                "drift-wire-payloads"
+            )
     return problems
 
 

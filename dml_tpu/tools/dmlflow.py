@@ -1,0 +1,1508 @@
+"""dmlflow — flow-aware passes for dmllint: async-race windows and
+wire-payload schema drift.
+
+dmllint (PR 9) is lexical: it sees a naked ``create_task`` or a
+pass-only ``except`` but cannot see ORDER. Every review pass since
+PR 3 has hand-found the same two ordered bug classes the lexical rules
+miss: check-then-act races on shared coordinator/router state that
+span an ``await`` (the ACK-freshness, dedup-map and promoted-leader
+adoption bugs), and wire-payload drift where a handler reads a field a
+sender stopped (or never started) shipping. This module catches both
+mechanically. It is pure AST + static introspection — no jax import,
+both passes together cost about a second over the whole tree — and is
+driven by ``dmllint.run_lint`` (same
+Finding/baseline/exit-code machinery, same tier-1 zero-unbaselined
+gate).
+
+race-yield-hazard
+-----------------
+
+Per ``async def``, a statement-ordered model of reads/writes to
+``self.*`` attributes and module-global mutable containers in
+``dml_tpu/``. Two hazard shapes:
+
+1. *check-then-act across a yield point*: a branch test reads
+   ``self.x``, an ``await`` yields the event loop, and the code then
+   mutates ``self.x`` without looking again — every other task gets a
+   window between the check and the act. Recognized await-safe idioms
+   (NOT flagged):
+
+   - *re-check-after-await*: the same attribute appears in another
+     branch test after the last await and before the mutation;
+   - *lock-held window*: test and mutation sit inside the same
+     ``async with self.<lock-ish>`` block (attribute names matching
+     lock/mutex/sem/cond) — contenders serialize on the lock. Note the
+     acquire itself is a yield point: testing BEFORE the ``async
+     with`` and mutating inside it is still flagged (re-check inside
+     the lock);
+   - *snapshot-into-local*: copying ``self.x`` into a local before the
+     await and testing/iterating the local — invisible to the rule by
+     construction, because locals are never tracked.
+
+2. *unrestored window marker*: an acquire-like mutation
+   (``.add/.append/[k] =/= True/+= 1``) followed by an ``await`` and a
+   release-like mutation (``.discard/.pop/del/.remove/= False/-= 1``)
+   of the same attribute, where some await between the two is NOT
+   inside the body of a ``try`` whose ``finally`` performs the
+   release: a cancelled await skips the release and the marker leaks
+   forever (the PR-3 wedge class, but for state instead of tasks).
+
+drift-wire-payloads
+-------------------
+
+Infers each ``MsgType``'s payload schema from the whole package and
+cross-checks it three ways:
+
+- *send sites*: any call carrying a literal ``MsgType.X`` plus a
+  resolvable payload dict (inline literal, or a local built up with
+  ``d = {...}`` / ``d["k"] = v`` / ``d.update({...})``) — conditional
+  assignments make a key *conditionally* sent; ``**``-spreads and
+  computed keys make the site *opaque* (inference stops claiming
+  completeness for that type). ``request/leader_request/leader_retry``
+  sites implicitly ship ``rid``; ``rid`` is the universal correlation
+  key and is excluded from all checks.
+- *reads*: in the type's registered ``_h_*`` handler (via wire.py's
+  HANDLER_OWNERS + the actual registrations), ``msg.data["k"]`` is a
+  REQUIRED read, ``msg.data.get("k")`` / ``"k" in msg.data`` is
+  OPTIONAL; ``d = msg.data`` aliases are followed, and one-call-deep
+  delegation into same-class methods / same-module functions is
+  resolved. For rid-fallback reply types, reads are collected at the
+  *await site* of the owning request (``reply = await
+  self.request(..., MsgType.Q, ...)``) and attributed through the
+  payload map's ``<- Q`` reply annotations. Unresolvable flows mark
+  the reader *open* (dead-byte claims stop for that type).
+- *the payload map*: wire.py's module docstring carries a
+  machine-readable "Payload map (lint-enforced)" section (one line per
+  member: bare key = required, ``key?`` = optional, ``-`` = empty,
+  ``*`` = open/unresolvable payload, ``<- REQUEST`` = reply-of
+  annotation). Both directions are enforced: a key in the map nothing
+  sends or reads, and a key on the wire the map doesn't declare, are
+  findings — as are a missing member line, a ghost line, a wrong
+  required/optional marking, and a ``*`` on a fully-resolved type.
+
+Findings:
+
+- ``required-never-sent`` — a handler (or await site) indexes a key NO
+  sender of that type ever ships: a latent KeyError on the wire.
+- ``required-not-always`` — a sender ships a required key only
+  conditionally, or one sender of the type ships it and another never
+  does (the conditional-send vs required-read disagreement).
+- ``sent-never-read`` — a key every reader ignores: dead wire bytes.
+- the map-sync findings described above.
+
+Send sites inside the chaos byzantine fuzzer (``fuzz_datagrams``) are
+deliberately adversarial and excluded via ``OFF_WIRE``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .dmllint import (
+    Finding,
+    R_PAYLOAD,
+    R_RACE,
+    extract_handler_owners,
+    extract_msgtype_members,
+    extract_registrations,
+)
+
+# ----------------------------------------------------------------------
+# race-yield-hazard
+# ----------------------------------------------------------------------
+
+_LOCKISH = re.compile(r"lock|mutex|sem|cond", re.I)
+
+#: container-mutating method names, split by window-marker polarity
+ACQUIRE_METHODS = {
+    "add", "append", "appendleft", "insert", "extend", "update",
+    "setdefault",
+}
+RELEASE_METHODS = {"pop", "popleft", "remove", "discard", "clear"}
+_MUTATORS = ACQUIRE_METHODS | RELEASE_METHODS
+
+#: module-level constructors whose result is shared mutable state
+_MUTABLE_CTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "Counter",
+    "OrderedDict",
+}
+
+
+def module_mutable_globals(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to a mutable container literal/ctor."""
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            v = node.value
+            name = node.targets[0].id
+            if isinstance(v, (ast.Dict, ast.List, ast.Set)):
+                out.add(name)
+            elif isinstance(v, ast.Call):
+                f = v.func
+                fname = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", None)
+                if fname in _MUTABLE_CTORS:
+                    out.add(name)
+    return out
+
+
+class _RaceScan:
+    """Statement-ordered event stream for ONE async function.
+
+    Events: ``(kind, attr, line, lock_stack, try_stack, mkind)`` with
+    kind in {test, read, mut, await}; attr is ``self.<name>`` or a
+    module-global name; lock_stack is the tuple of lock-region ids
+    held; try_stack is a tuple of (try_id, section) frames."""
+
+    def __init__(self, mutable_globals: Set[str]) -> None:
+        self.g = mutable_globals
+        self.ev: List[Tuple[str, Optional[str], int, tuple, tuple, Optional[str]]] = []
+        self.lock: tuple = ()
+        self.tries: tuple = ()
+        self._region = 0
+        self._tryid = 0
+        self._globaldecl: Set[str] = set()
+
+    def emit(self, kind: str, attr: Optional[str], line: int,
+             mkind: Optional[str] = None) -> None:
+        self.ev.append((kind, attr, line, self.lock, self.tries, mkind))
+
+    # -- base-attribute resolution -------------------------------------
+    def _base(self, node: ast.AST) -> Optional[str]:
+        while True:
+            if isinstance(node, ast.Attribute):
+                v = node.value
+                if isinstance(v, ast.Name):
+                    return f"self.{node.attr}" if v.id == "self" else None
+                node = v
+            elif isinstance(node, ast.Subscript):
+                node = node.value
+            elif isinstance(node, ast.Call):
+                node = node.func
+            elif isinstance(node, ast.Name):
+                return node.id if node.id in self.g else None
+            else:
+                return None
+
+    def _lockish(self, e: ast.AST) -> bool:
+        n: ast.AST = e
+        if isinstance(n, ast.Call):
+            n = n.func
+        if isinstance(n, ast.Subscript):
+            n = n.value
+        if isinstance(n, ast.Attribute):
+            return bool(_LOCKISH.search(n.attr))
+        if isinstance(n, ast.Name):
+            return bool(_LOCKISH.search(n.id))
+        return False
+
+    def _mut_call(self, node: ast.Call) -> Optional[Tuple[str, Optional[str]]]:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            base = self._base(f.value)
+            if base is not None:
+                kind = "acq" if f.attr in ACQUIRE_METHODS else "rel"
+                return base, kind
+        return None
+
+    # -- expressions ---------------------------------------------------
+    def expr(self, node: Optional[ast.AST], test: bool = False) -> None:
+        if node is None:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # separate execution context
+        if isinstance(node, ast.Await):
+            self.expr(node.value, test)
+            self.emit("await", None, node.lineno)
+            return
+        if isinstance(node, ast.IfExp):
+            self.expr(node.test, test=True)
+            self.expr(node.body, test)
+            self.expr(node.orelse, test)
+            return
+        if isinstance(node, ast.Call):
+            mt = self._mut_call(node)
+            for a in node.args:
+                self.expr(a, test)
+            for kw in node.keywords:
+                self.expr(kw.value, test)
+            if mt is not None:
+                self.emit("mut", mt[0], node.lineno, mt[1])
+            else:
+                self.expr(node.func, test)
+            return
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            base = self._base(node)
+            if base is not None:
+                self.emit("test" if test else "read", base, node.lineno)
+            if isinstance(node, ast.Subscript):
+                self.expr(node.slice, test)
+                if base is None:
+                    self.expr(node.value, test)
+            elif base is None:
+                self.expr(node.value, test)
+            return
+        if isinstance(node, ast.Name):
+            if node.id in self.g and isinstance(node.ctx, ast.Load):
+                self.emit("test" if test else "read", node.id, node.lineno)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.expr(child, test)
+
+    # -- assignment targets --------------------------------------------
+    def target(self, t: ast.AST, mkind: Optional[str]) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self.target(e, None)
+        elif isinstance(t, ast.Starred):
+            self.target(t.value, None)
+        elif isinstance(t, ast.Attribute):
+            base = self._base(t)
+            if base is not None:
+                direct = isinstance(t.value, ast.Name)
+                self.emit("mut", base, t.lineno, mkind if direct else None)
+        elif isinstance(t, ast.Subscript):
+            self.expr(t.slice)
+            base = self._base(t)
+            if base is not None:
+                self.emit("mut", base, t.lineno, "acq")
+        elif isinstance(t, ast.Name):
+            if t.id in self.g and t.id in self._globaldecl:
+                self.emit("mut", t.id, t.lineno, mkind)
+
+    # -- statements ----------------------------------------------------
+    def stmts(self, body: Sequence[ast.stmt]) -> None:
+        for s in body:
+            self.stmt(s)
+
+    @staticmethod
+    def _assign_kind(value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Constant):
+            if value.value is True:
+                return "acq"
+            if value.value is False or value.value is None:
+                return "rel"
+        return None
+
+    def stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, ast.Expr):
+            self.expr(s.value)
+        elif isinstance(s, ast.Assign):
+            self.expr(s.value)
+            k = self._assign_kind(s.value)
+            for t in s.targets:
+                self.target(t, k)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self.expr(s.value)
+                self.target(s.target, self._assign_kind(s.value))
+        elif isinstance(s, ast.AugAssign):
+            self.expr(s.value)
+            k = "acq" if isinstance(s.op, ast.Add) else (
+                "rel" if isinstance(s.op, ast.Sub) else None)
+            self.target(s.target, k)
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                if isinstance(t, ast.Subscript):
+                    self.expr(t.slice)
+                    base = self._base(t)
+                    if base is not None:
+                        self.emit("mut", base, t.lineno, "rel")
+        elif isinstance(s, ast.Return):
+            self.expr(s.value)
+        elif isinstance(s, (ast.If, ast.While)):
+            self.expr(s.test, test=True)
+            self.stmts(s.body)
+            self.stmts(s.orelse)
+        elif isinstance(s, ast.For):
+            self.expr(s.iter)
+            self.stmts(s.body)
+            self.stmts(s.orelse)
+        elif isinstance(s, ast.AsyncFor):
+            self.expr(s.iter)
+            self.emit("await", None, s.lineno)
+            self.stmts(s.body)
+            self.stmts(s.orelse)
+        elif isinstance(s, ast.With):
+            for it in s.items:
+                self.expr(it.context_expr)
+            self.stmts(s.body)
+        elif isinstance(s, ast.AsyncWith):
+            lockish = False
+            for it in s.items:
+                self.expr(it.context_expr)
+                lockish = lockish or self._lockish(it.context_expr)
+            # __aenter__ awaits BEFORE the lock is held: a test made
+            # before this line is stale by the time the body runs
+            self.emit("await", None, s.lineno)
+            if lockish:
+                self._region += 1
+                self.lock = self.lock + (self._region,)
+            self.stmts(s.body)
+            if lockish:
+                self.lock = self.lock[:-1]
+        elif isinstance(s, ast.Try):
+            self._tryid += 1
+            tid = self._tryid
+            self.tries = self.tries + ((tid, "body"),)
+            self.stmts(s.body)
+            self.tries = self.tries[:-1]
+            for h in s.handlers:
+                self.tries = self.tries + ((tid, "handler"),)
+                self.stmts(h.body)
+                self.tries = self.tries[:-1]
+            self.tries = self.tries + ((tid, "orelse"),)
+            self.stmts(s.orelse)
+            self.tries = self.tries[:-1]
+            self.tries = self.tries + ((tid, "finally"),)
+            self.stmts(s.finalbody)
+            self.tries = self.tries[:-1]
+        elif isinstance(s, ast.Assert):
+            self.expr(s.test, test=True)
+            self.expr(s.msg)
+        elif isinstance(s, ast.Raise):
+            self.expr(s.exc)
+            self.expr(s.cause)
+        elif isinstance(s, ast.Global):
+            self._globaldecl.update(s.names)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            pass  # nested defs run in their own timeline
+        else:
+            # Pass/Break/Continue/Import/Nonlocal/Match fallback: walk
+            # any expression children for reads, any stmt lists in order
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.stmt):
+                    self.stmt(child)
+                elif isinstance(child, ast.expr):
+                    self.expr(child)
+
+
+def _detect_check_then_act(
+    ev: List[tuple], rel: str, qual: str
+) -> List[Tuple[str, str, int, str]]:
+    """-> [(attr, tag, line, msg)] for hazard shape 1."""
+    out = []
+    last: Dict[str, Optional[dict]] = {}
+    fired: Set[str] = set()
+    for kind, attr, line, lock, _tries, _mk in ev:
+        if kind == "await":
+            for t in last.values():
+                if t is None or t["awaited"]:
+                    continue
+                held = t["lock"]
+                # still holding the lock the test was made under?
+                if held and lock[: len(held)] == held:
+                    continue
+                t["awaited"] = True
+                t["await_line"] = line
+        elif kind == "test" and attr is not None:
+            last[attr] = {"line": line, "lock": lock, "awaited": False,
+                          "await_line": 0}
+        elif kind == "mut" and attr is not None:
+            t = last.get(attr)
+            if t is not None and t["awaited"] and attr not in fired:
+                fired.add(attr)
+                out.append((
+                    attr, "ctw", line,
+                    f"check-then-act on {attr} spans a yield point: "
+                    f"tested at line {t['line']}, awaited at line "
+                    f"{t['await_line']}, mutated here — another task "
+                    f"can mutate {attr} inside the window. Re-check "
+                    "after the await, hold one lock across the whole "
+                    "window, or snapshot into a local before awaiting",
+                ))
+                last[attr] = None
+    return out
+
+
+def _detect_marker_leak(
+    ev: List[tuple], rel: str, qual: str
+) -> List[Tuple[str, str, int, str]]:
+    """-> [(attr, tag, line, msg)] for hazard shape 2."""
+    out = []
+    acq: Dict[str, List[tuple]] = {}
+    rel_: Dict[str, List[tuple]] = {}
+    aws: List[tuple] = []
+    for i, (kind, attr, line, _lock, tries, mk) in enumerate(ev):
+        if kind == "await":
+            aws.append((i, line, tries))
+        elif kind == "mut" and attr is not None:
+            if mk == "acq":
+                acq.setdefault(attr, []).append((i, line, tries))
+            elif mk == "rel":
+                rel_.setdefault(attr, []).append((i, line, tries))
+    for attr in sorted(set(acq) & set(rel_)):
+        # tries whose finally releases this attr put the release on the
+        # cancellation path for every await inside their body
+        protected = {
+            tid for _i, _l, tries in rel_[attr]
+            for tid, sec in tries if sec == "finally"
+        }
+        found = False
+        for ai, aline, a_tries in acq[attr]:
+            if found:
+                break
+            if any(sec == "finally" for _t, sec in a_tries):
+                continue  # acquire on a teardown path: not a marker
+            for ri, rline, _r_tries in rel_[attr]:
+                if ri <= ai:
+                    continue
+                between = [w for w in aws if ai < w[0] < ri]
+                if not between:
+                    continue
+                unprot = [
+                    w for w in between
+                    if not any(tid in protected and sec != "finally"
+                               for tid, sec in w[2])
+                ]
+                if unprot:
+                    out.append((
+                        attr, "leak", aline,
+                        f"window marker on {attr} can leak on "
+                        f"cancellation: acquired here, awaited at line "
+                        f"{unprot[0][1]}, released at line {rline} "
+                        "with no try/finally putting the release on "
+                        "the cancellation path — a cancelled await "
+                        "leaks the marker forever",
+                    ))
+                    found = True
+                break  # only pair with the FIRST release after acquire
+    return out
+
+
+def _async_functions(tree: ast.Module):
+    """Yield (qualname, AsyncFunctionDef) for every async def,
+    including nested ones (each analyzed as its own timeline)."""
+
+    def walk(node: ast.AST, scope: List[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, scope + [child.name])
+            elif isinstance(child, ast.AsyncFunctionDef):
+                q = ".".join(scope + [child.name])
+                yield q, child
+                yield from walk(child, scope + [child.name])
+            elif isinstance(child, ast.FunctionDef):
+                yield from walk(child, scope + [child.name])
+            else:
+                yield from walk(child, scope)
+
+    yield from walk(tree, [])
+
+
+def analyze_race_tree(tree: ast.Module, rel: str) -> List[Finding]:
+    mutable_globals = module_mutable_globals(tree)
+    findings: List[Finding] = []
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for qual, fn in _async_functions(tree):
+        scan = _RaceScan(mutable_globals)
+        scan.stmts(fn.body)
+        raws = _detect_check_then_act(scan.ev, rel, qual)
+        raws += _detect_marker_leak(scan.ev, rel, qual)
+        for attr, tag, line, msg in raws:
+            n = counts.get((qual, attr, tag), 0)
+            counts[(qual, attr, tag)] = n + 1
+            findings.append(Finding(
+                path=rel, line=line, rule=R_RACE, msg=f"[{qual}] {msg}",
+                key=f"{R_RACE}:{rel}:{qual}:{attr}:{tag}{n}",
+            ))
+    return findings
+
+
+def analyze_race_source(src: str, rel: str) -> List[Finding]:
+    return analyze_race_tree(ast.parse(src, filename=rel), rel)
+
+
+def rule_race(root: str, trees: Dict[str, ast.Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for rel in sorted(trees):
+        if rel.startswith("dml_tpu/"):
+            out.extend(analyze_race_tree(trees[rel], rel))
+    return out
+
+
+# ----------------------------------------------------------------------
+# drift-wire-payloads
+# ----------------------------------------------------------------------
+
+WIRE_REL = "dml_tpu/cluster/wire.py"
+INTRODUCER_REL = "dml_tpu/cluster/introducer.py"
+
+#: call names whose awaited result is the reply payload dict
+REQUEST_FNS = {"request", "leader_request", "leader_retry", "_leader_retry"}
+#: call names that are definitely sends even with an unresolvable payload
+SEND_FNS = REQUEST_FNS | {"send", "send_unique", "Message"}
+#: wrapper senders whose real payload is composed INSIDE the wrapper
+#: (tiered degradation): their call sites are always opaque sends —
+#: the dict literal at the call site is only a fragment of the frame
+OPAQUE_SEND_FNS = {"_send_metrics_tiered", "_send_trace_tiered"}
+#: (rel, top-level qualname) whose send sites are deliberately
+#: adversarial and excluded from schema inference
+OFF_WIRE = {("dml_tpu/cluster/chaos.py", "fuzz_datagrams")}
+#: the universal correlation key, excluded from every check
+_RID = "rid"
+#: success-discriminator keys: a reader probing one of these via .get
+#: reads the rest of the payload conditionally (see assemble_contracts)
+_DISCRIMINATORS = {"ok", "accepted", "done", "known"}
+
+#: callee bases/names through which a payload dict cannot "escape"
+#: into unseen reads (rendering/printing, builtins)
+_BENIGN_CALLEES = {
+    "print", "repr", "str", "len", "id", "type", "isinstance", "bool",
+    "format", "sorted",
+}
+_BENIGN_CALL_BASES = {"log", "logging"}
+
+
+@dataclass
+class SendSite:
+    rel: str
+    line: int
+    keys: Dict[str, bool]  # key -> always-sent
+    open: bool
+
+
+@dataclass
+class ReadSet:
+    required: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    optional: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    open: bool = False
+    readers: int = 0
+
+    def merge(self, other: "ReadSet") -> None:
+        for k, loc in other.required.items():
+            self.required.setdefault(k, loc)
+        for k, loc in other.optional.items():
+            self.optional.setdefault(k, loc)
+        self.open = self.open or other.open
+        self.readers += other.readers
+
+
+@dataclass
+class PayloadUsage:
+    """Everything inference learned about the wire, pre-check."""
+
+    sends: Dict[str, List[SendSite]] = field(default_factory=dict)
+    handler_reads: Dict[str, ReadSet] = field(default_factory=dict)
+    #: await-site reads keyed by the REQUEST member (resolved to its
+    #: reply types through the payload map's `<- Q` annotations)
+    await_reads: Dict[str, ReadSet] = field(default_factory=dict)
+    aux_findings: List[Finding] = field(default_factory=list)
+
+
+@dataclass
+class MapEntry:
+    required: Set[str]
+    optional: Set[str]
+    open: bool
+    reply_to: Optional[str]
+    line: int
+
+
+_PAYLOAD_HEADER = "Payload map (lint-enforced)"
+_PMAP_LINE = re.compile(r"^ {4}([A-Z][A-Z0-9_]*):\s*(.*)$")
+_PMAP_CONT = re.compile(r"^ {6,}(\S.*)$")
+_PMAP_KEY = re.compile(r"^[a-z_][a-z0-9_]*\??$")
+
+
+def parse_payload_map(
+    docstring: str, base_line: int = 1
+) -> Optional[Tuple[Dict[str, MapEntry], List[Tuple[int, str]]]]:
+    """-> ({member: MapEntry}, [(line, bad-token)]) or None when the
+    section is absent. Token grammar per entry line: bare ``key`` =
+    required, ``key?`` = optional, ``-`` = declared-empty, ``*`` =
+    open payload, ``<- REQUEST`` = reply-of annotation."""
+    lines = docstring.splitlines()
+    try:
+        start = next(i for i, ln in enumerate(lines)
+                     if ln.strip() == _PAYLOAD_HEADER)
+    except StopIteration:
+        return None
+    entries: Dict[str, MapEntry] = {}
+    bad: List[Tuple[int, str]] = []
+    current: Optional[str] = None
+    in_list = False
+    for i in range(start + 1, len(lines)):
+        ln = lines[i]
+        line_no = base_line + i
+        m = _PMAP_LINE.match(ln)
+        if m:
+            in_list = True
+            current = m.group(1)
+            entries[current] = MapEntry(set(), set(), False, None, line_no)
+            rest = m.group(2)
+        elif in_list and current and _PMAP_CONT.match(ln):
+            rest = _PMAP_CONT.match(ln).group(1)  # type: ignore[union-attr]
+        else:
+            if in_list and ln.strip() and not ln.startswith(" "):
+                break  # next unindented section
+            continue
+        toks = rest.split()
+        j = 0
+        while j < len(toks):
+            tok = toks[j]
+            if tok == "<-" and j + 1 < len(toks):
+                entries[current].reply_to = toks[j + 1]
+                j += 2
+                continue
+            if tok == "-":
+                pass
+            elif tok == "*":
+                entries[current].open = True
+            elif _PMAP_KEY.match(tok):
+                if tok.endswith("?"):
+                    entries[current].optional.add(tok[:-1])
+                else:
+                    entries[current].required.add(tok)
+            else:
+                bad.append((line_no, tok))
+            j += 1
+    return entries, bad
+
+
+# -- send-site / await-site collection ---------------------------------
+
+
+class _DictState:
+    __slots__ = ("keys", "open", "depth")
+
+    def __init__(self, keys: Dict[str, bool], open_: bool, depth: int):
+        self.keys = keys
+        self.open = open_
+        self.depth = depth
+
+
+def _literal_dict_keys(node: ast.Dict) -> Tuple[Dict[str, bool], bool]:
+    keys: Dict[str, bool] = {}
+    open_ = False
+    for k in node.keys:
+        if k is None:  # **spread
+            open_ = True
+        elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys[k.value] = True
+        else:
+            open_ = True
+    return keys, open_
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _as_msgtype(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "MsgType":
+        return node.attr
+    return None
+
+
+def _msgtype_literals(call: ast.Call) -> List[str]:
+    """MsgType members a call site can send: a direct ``MsgType.X``
+    argument, or both arms of a ``MsgType.X if ok else MsgType.Y``
+    conditional (the success/fail reply idiom)."""
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        m = _as_msgtype(a)
+        if m is not None:
+            return [m]
+        if isinstance(a, ast.IfExp):
+            arms = [_as_msgtype(a.body), _as_msgtype(a.orelse)]
+            if all(arms):
+                return [m for m in arms if m]
+    return []
+
+
+def _msgtype_literal(call: ast.Call) -> Optional[str]:
+    ms = _msgtype_literals(call)
+    return ms[0] if len(ms) == 1 else None
+
+
+class _SendScan:
+    """Per-function ordered scan: resolves local payload dicts, records
+    send sites, and records await-request sites (for reply reads)."""
+
+    def __init__(self, rel: str, usage: PayloadUsage) -> None:
+        self.rel = rel
+        self.usage = usage
+        self.dicts: Dict[str, _DictState] = {}
+        self.depth = 0
+        #: [(request_member, bound var name | None, await node)]
+        self.req_sites: List[Tuple[str, Optional[str], ast.Await]] = []
+
+    # -- helpers -------------------------------------------------------
+    def _payload_of(self, call: ast.Call) -> Optional[Tuple[Dict[str, bool], bool]]:
+        cands = list(call.args) + [
+            kw.value for kw in call.keywords if kw.arg == "data"
+        ]
+        for a in cands:
+            if isinstance(a, ast.Dict):
+                return _literal_dict_keys(a)
+            if isinstance(a, ast.Name) and a.id in self.dicts:
+                st = self.dicts[a.id]
+                return dict(st.keys), st.open
+        return None
+
+    def _record_send(self, call: ast.Call, member: str) -> None:
+        fname = _call_name(call.func)
+        if fname == "register":
+            return
+        payload = None if fname in OPAQUE_SEND_FNS else self._payload_of(call)
+        if payload is None:
+            if fname not in SEND_FNS | OPAQUE_SEND_FNS:
+                return  # MsgType used as a value, not a send
+            keys: Dict[str, bool] = {}
+            open_ = True
+        else:
+            keys, open_ = payload
+        keys.pop(_RID, None)
+        self.usage.sends.setdefault(member, []).append(
+            SendSite(self.rel, call.lineno, keys, open_))
+
+    def _maybe_send(self, call: ast.Call) -> None:
+        for member in _msgtype_literals(call):
+            self._record_send(call, member)
+
+    def _dict_mutation(self, node: ast.AST) -> None:
+        """Track ``d["k"] = v`` / ``d.update({...})`` / ``d.pop`` on
+        locals bound to dict literals."""
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Subscript):
+            t = node.targets[0]
+            if isinstance(t.value, ast.Name) and t.value.id in self.dicts:
+                st = self.dicts[t.value.id]
+                sl = t.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    always = self.depth == st.depth
+                    st.keys[sl.value] = st.keys.get(sl.value, False) or always
+                else:
+                    st.open = True
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            f = node.func
+            if isinstance(f.value, ast.Name) and f.value.id in self.dicts:
+                st = self.dicts[f.value.id]
+                if f.attr == "update":
+                    if node.args and isinstance(node.args[0], ast.Dict):
+                        ks, op = _literal_dict_keys(node.args[0])
+                        always = self.depth == st.depth
+                        for k in ks:
+                            st.keys[k] = st.keys.get(k, False) or always
+                        st.open = st.open or op
+                    elif node.args:
+                        st.open = True
+                    for kw in node.keywords:
+                        if kw.arg:
+                            st.keys[kw.arg] = st.keys.get(kw.arg, False) or \
+                                (self.depth == st.depth)
+                        else:
+                            st.open = True
+                elif f.attr == "pop" and node.args and \
+                        isinstance(node.args[0], ast.Constant):
+                    k = node.args[0].value
+                    if isinstance(k, str) and k in st.keys:
+                        st.keys[k] = False
+
+    # -- traversal -----------------------------------------------------
+    def expr(self, node: Optional[ast.AST]) -> None:
+        if node is None:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Call):
+            self._dict_mutation(node)
+            self._maybe_send(node)
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            member = _msgtype_literal(node.value)
+            if member is not None and \
+                    _call_name(node.value.func) in REQUEST_FNS:
+                self.req_sites.append((member, None, node))
+        for child in ast.iter_child_nodes(node):
+            self.expr(child)
+
+    def stmts(self, body: Sequence[ast.stmt]) -> None:
+        for s in body:
+            self.stmt(s)
+
+    def _nested(self, *groups: Sequence[ast.stmt]) -> None:
+        self.depth += 1
+        for g in groups:
+            self.stmts(g)
+        self.depth -= 1
+
+    def stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, ast.Assign):
+            self.expr(s.value)
+            self._dict_mutation(s)
+            if len(s.targets) == 1 and isinstance(s.targets[0], ast.Name):
+                name = s.targets[0].id
+                v = s.value
+                if isinstance(v, ast.Dict):
+                    ks, op = _literal_dict_keys(v)
+                    self.dicts[name] = _DictState(ks, op, self.depth)
+                elif isinstance(v, ast.Call) and _call_name(v.func) == "dict":
+                    ks = {kw.arg: True for kw in v.keywords if kw.arg}
+                    op = bool(v.args) or any(kw.arg is None for kw in v.keywords)
+                    self.dicts[name] = _DictState(ks, op, self.depth)
+                elif isinstance(v, ast.Await) and isinstance(v.value, ast.Call) \
+                        and _msgtype_literal(v.value) is not None \
+                        and _call_name(v.value.func) in REQUEST_FNS:
+                    # bind the reply var: drop the anonymous site just
+                    # recorded by expr() and re-record with the name
+                    if self.req_sites and self.req_sites[-1][2] is v:
+                        member = self.req_sites[-1][0]
+                        self.req_sites[-1] = (member, name, v)
+                    self.dicts.pop(name, None)
+                else:
+                    self.dicts.pop(name, None)
+        elif isinstance(s, (ast.If, ast.While)):
+            self.expr(s.test)
+            self._nested(s.body, s.orelse)
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            self.expr(s.iter)
+            self._nested(s.body, s.orelse)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            for it in s.items:
+                self.expr(it.context_expr)
+            self.stmts(s.body)  # with-bodies always run: same depth
+        elif isinstance(s, ast.Try):
+            self._nested(s.body, s.orelse)
+            for h in s.handlers:
+                self._nested(h.body)
+            self.stmts(s.finalbody)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            pass
+        else:
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.stmt):
+                    self.stmt(child)
+                elif isinstance(child, ast.expr):
+                    self.expr(child)
+
+
+# -- read collection ---------------------------------------------------
+
+
+class _FnIndex:
+    """Where to find a callee for one-call-deep delegation."""
+
+    def __init__(self, trees: Dict[str, ast.Module]) -> None:
+        self.methods: Dict[Tuple[str, str], Tuple[str, ast.AST]] = {}
+        self.module_fns: Dict[Tuple[str, str], ast.AST] = {}
+        for rel in sorted(trees):
+            if not rel.startswith("dml_tpu/"):
+                continue
+            tree = trees[rel]
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.module_fns[(rel, node.name)] = node
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self.methods.setdefault(
+                                (node.name, sub.name), (rel, sub))
+
+
+def _parent_map(fn: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+class _ReadCollector:
+    """Classify every use of a message/payload variable inside one
+    function into required/optional reads, following ``d = msg.data``
+    aliases and one level of resolvable delegation."""
+
+    MAX_DEPTH = 4
+
+    def __init__(self, index: _FnIndex) -> None:
+        self.index = index
+        # one collector serves the whole package scan: handlers that
+        # delegate into shared helpers (and functions hosting several
+        # await sites) would otherwise rebuild the same parent map
+        self._pmaps: Dict[int, Dict[ast.AST, ast.AST]] = {}
+
+    def _parents_of(self, fn: ast.AST) -> Dict[ast.AST, ast.AST]:
+        pm = self._pmaps.get(id(fn))
+        if pm is None:
+            pm = self._pmaps[id(fn)] = _parent_map(fn)
+        return pm
+
+    def collect(
+        self,
+        rel: str,
+        fn: ast.AST,
+        params: Dict[str, str],  # name -> "msg" | "data"
+        class_name: Optional[str],
+        depth: int = 0,
+        visited: Optional[Set[Tuple[int, str]]] = None,
+    ) -> ReadSet:
+        rs = ReadSet(readers=1 if depth == 0 else 0)
+        if depth > self.MAX_DEPTH:
+            rs.open = True
+            return rs
+        visited = visited or set()
+        parents = self._parents_of(fn)
+        # follow aliases to fixpoint: d = msg.data; d2 = d
+        kinds = dict(params)
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                tname = node.targets[0].id
+                if tname in kinds:
+                    continue
+                v = node.value
+                if isinstance(v, ast.Name) and kinds.get(v.id):
+                    kinds[tname] = kinds[v.id]
+                    changed = True
+                elif (isinstance(v, ast.Attribute) and v.attr == "data"
+                        and isinstance(v.value, ast.Name)
+                        and kinds.get(v.value.id) == "msg"):
+                    kinds[tname] = "data"
+                    changed = True
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name) and node.id in kinds
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            kind = kinds[node.id]
+            target: ast.AST = node
+            if kind == "msg":
+                p = parents.get(node)
+                if isinstance(p, ast.Attribute):
+                    if p.attr == "data":
+                        target = p  # classify the msg.data node below
+                    else:
+                        continue  # .sender/.type: not payload
+                else:
+                    self._classify_obj(rel, fn, node, parents, rs, kinds,
+                                       class_name, depth, visited, is_msg=True)
+                    continue
+            self._classify_obj(rel, fn, target, parents, rs, kinds,
+                               class_name, depth, visited, is_msg=False)
+        return rs
+
+    # -- classification of one payload-dict expression node ------------
+    def _classify_obj(
+        self, rel, fn, node, parents, rs: ReadSet, kinds, class_name,
+        depth, visited, is_msg: bool,
+    ) -> None:
+        p = parents.get(node)
+        loc = (rel, getattr(node, "lineno", 1))
+        if isinstance(p, ast.Subscript) and p.value is node:
+            if isinstance(p.ctx, (ast.Store, ast.Del)):
+                return  # handler writes into the dict: not a read
+            sl = p.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                if sl.value != _RID:
+                    rs.required.setdefault(sl.value, loc)
+            else:
+                rs.open = True
+            return
+        if isinstance(p, ast.Attribute) and p.value is node:
+            meth = p.attr
+            call = parents.get(p)
+            if isinstance(call, ast.Call) and call.func is p:
+                if meth in ("get", "pop", "setdefault"):
+                    a0 = call.args[0] if call.args else None
+                    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                        if a0.value != _RID:
+                            rs.optional.setdefault(a0.value, loc)
+                    else:
+                        rs.open = True
+                elif meth in ("items", "keys", "values", "copy", "update"):
+                    rs.open = True  # iterates/clones everything
+                else:
+                    rs.open = True
+                return
+            rs.open = True
+            return
+        if isinstance(p, ast.Compare) and node in p.comparators:
+            # "k" in d  (presence probe: an optional read)
+            if len(p.ops) == 1 and isinstance(p.ops[0], (ast.In, ast.NotIn)) \
+                    and isinstance(p.left, ast.Constant) \
+                    and isinstance(p.left.value, str):
+                if p.left.value != _RID:
+                    rs.optional.setdefault(p.left.value, loc)
+            return
+        if isinstance(p, (ast.BoolOp, ast.UnaryOp, ast.IfExp)):
+            return  # truthiness only
+        if isinstance(p, (ast.If, ast.While, ast.Assert)):
+            return  # bare `if d:` truthiness
+        if isinstance(p, (ast.FormattedValue, ast.JoinedStr)):
+            return  # rendered into a string
+        if isinstance(p, ast.Call) and (node in p.args or any(
+                kw.value is node for kw in p.keywords)):
+            self._delegate(rel, fn, p, node, rs, class_name, depth,
+                           visited, is_msg)
+            return
+        if (isinstance(p, ast.Assign) and len(p.targets) == 1
+                and isinstance(p.targets[0], ast.Name)
+                and p.targets[0].id in kinds):
+            return  # the tracked-alias binding itself (d = msg.data)
+        rs.open = True  # stored/returned/iterated: flows out of sight
+
+    def _delegate(
+        self, rel, fn, call: ast.Call, arg_node, rs: ReadSet, class_name,
+        depth, visited, is_msg: bool,
+    ) -> None:
+        f = call.func
+        fname = _call_name(f)
+        if fname in _BENIGN_CALLEES:
+            return
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in _BENIGN_CALL_BASES:
+            return
+        callee: Optional[Tuple[str, ast.AST]] = None
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and class_name:
+            callee = self.index.methods.get((class_name, f.attr))
+            callee_class = class_name
+        elif isinstance(f, ast.Name):
+            target = self.index.module_fns.get((rel, f.id))
+            callee = (rel, target) if target is not None else None
+            callee_class = None
+        else:
+            callee_class = None
+        if callee is None:
+            rs.open = True
+            return
+        crel, cfn = callee
+        # map the argument position/keyword onto the callee parameter
+        args = cfn.args.args  # type: ignore[attr-defined]
+        names = [a.arg for a in args]
+        if names and names[0] == "self":
+            names = names[1:]
+        pname: Optional[str] = None
+        for i, a in enumerate(call.args):
+            if a is arg_node and i < len(names):
+                pname = names[i]
+        for kw in call.keywords:
+            if kw.value is arg_node and kw.arg:
+                pname = kw.arg
+        if pname is None:
+            rs.open = True
+            return
+        vkey = (id(cfn), pname)
+        if vkey in visited:
+            return
+        visited.add(vkey)
+        sub = self.collect(
+            crel, cfn, {pname: "msg" if is_msg else "data"},
+            callee_class, depth + 1, visited,
+        )
+        rs.merge(sub)
+
+
+# -- whole-package usage collection ------------------------------------
+
+
+def _functions_with_quals(tree: ast.Module):
+    """(top-level qualname, class name or None, fn) for every def."""
+
+    def walk(node, top, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, top, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                t = top if top is not None else child.name
+                yield t, cls, child
+                yield from walk(child, t, cls)
+            else:
+                yield from walk(child, top, cls)
+
+    yield from walk(tree, None, None)
+
+
+def collect_payload_usage(
+    trees: Dict[str, ast.Module],
+    members: Dict[str, int],
+    reply_map: Dict[str, List[str]],
+) -> PayloadUsage:
+    usage = PayloadUsage()
+    index = _FnIndex(trees)
+    collector = _ReadCollector(index)
+
+    # 1) send sites + await-request sites
+    for rel in sorted(trees):
+        if not rel.startswith("dml_tpu/"):
+            continue
+        for top_qual, cls, fn in _functions_with_quals(trees[rel]):
+            if (rel, top_qual) in OFF_WIRE:
+                continue
+            # nested defs are skipped by the scanner and arrive as
+            # their own (top_qual, fn) pairs from _functions_with_quals
+            scan = _SendScan(rel, usage)
+            scan.stmts(fn.body)
+            # reply reads per await-request site
+            for member, var, await_node in scan.req_sites:
+                rs = ReadSet(readers=1)
+                if var is not None:
+                    rs.merge(collector.collect(rel, fn, {var: "data"}, cls))
+                    rs.readers = 1
+                else:
+                    parents = collector._parents_of(fn)
+                    p = parents.get(await_node)
+                    loc = (rel, await_node.lineno)
+                    if isinstance(p, ast.Expr):
+                        pass  # reply discarded: reads nothing
+                    elif isinstance(p, ast.Subscript) and isinstance(
+                            p.slice, ast.Constant) and isinstance(
+                            p.slice.value, str):
+                        if p.slice.value != _RID:
+                            rs.required.setdefault(p.slice.value, loc)
+                    elif isinstance(p, ast.Attribute) and p.attr == "get":
+                        call = parents.get(p)
+                        if isinstance(call, ast.Call) and call.args and \
+                                isinstance(call.args[0], ast.Constant) and \
+                                isinstance(call.args[0].value, str):
+                            if call.args[0].value != _RID:
+                                rs.optional.setdefault(call.args[0].value, loc)
+                        else:
+                            rs.open = True
+                    else:
+                        rs.open = True  # returned / forwarded
+                if member not in reply_map and (
+                        rs.required or rs.optional or rs.open):
+                    usage.aux_findings.append(Finding(
+                        path=rel, line=await_node.lineno, rule=R_PAYLOAD,
+                        msg=f"await-site reads MsgType.{member}'s reply "
+                            "payload but the wire.py payload map declares "
+                            f"no reply type for it (missing `<- {member}` "
+                            "annotation) — the reply schema cannot be "
+                            "checked",
+                        key=f"{R_PAYLOAD}:unannotated-reply:{member}",
+                    ))
+                if member not in usage.await_reads:
+                    usage.await_reads[member] = rs
+                else:
+                    usage.await_reads[member].merge(rs)
+
+    # 2) handler reads via registrations + HANDLER_OWNERS
+    regs: List[Tuple[str, str, str, int, str]] = []
+    for rel in sorted(trees):
+        if rel.startswith("dml_tpu/"):
+            for member, cls, handler, line in extract_registrations(
+                    trees[rel], rel):
+                regs.append((member, cls, handler, line, rel))
+    for member, cls, handler, _line, rel in regs:
+        if member not in members:
+            continue
+        found = index.methods.get((cls, handler))
+        if found is None:
+            continue
+        hrel, hfn = found
+        args = [a.arg for a in hfn.args.args]
+        if len(args) < 2:
+            continue
+        msg_param = args[1]  # (self, msg, addr)
+        rs = collector.collect(hrel, hfn, {msg_param: "msg"}, cls)
+        if member not in usage.handler_reads:
+            usage.handler_reads[member] = rs
+        else:
+            usage.handler_reads[member].merge(rs)
+    return usage
+
+
+# -- the check ---------------------------------------------------------
+
+
+def _reply_map_from_pmap(
+    pmap: Optional[Dict[str, MapEntry]]
+) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    for member, e in (pmap or {}).items():
+        if e.reply_to:
+            out.setdefault(e.reply_to, []).append(member)
+    for v in out.values():
+        v.sort()
+    return out
+
+
+@dataclass
+class Contract:
+    """The inferred wire contract for one MsgType member."""
+
+    sends: List[SendSite]
+    ever: Set[str]       # keys any sender ships
+    opaque: bool         # some send site is unresolvable
+    rs: ReadSet          # merged reads (handler + routed await sites)
+    soft: Set[str]       # await-required keys of multi-reply requests
+    required: Set[str]   # the contract: reader indexes unconditionally
+    optional: Set[str]   # everything else on the wire
+    open: bool           # inference cannot claim completeness
+
+
+def assemble_contracts(
+    members: Dict[str, int],
+    usage: PayloadUsage,
+    reply_map: Dict[str, List[str]],
+) -> Dict[str, Contract]:
+    """One inference result per member — shared by the checker and the
+    map dumper so the enforced contract and the documented one can
+    never diverge in derivation."""
+    reads: Dict[str, ReadSet] = {}
+    for member, rs in usage.handler_reads.items():
+        reads.setdefault(member, ReadSet()).merge(rs)
+    multi: Dict[str, Set[str]] = {}
+    for req, rs in usage.await_reads.items():
+        targets = reply_map.get(req, [])
+        for t in targets:
+            reads.setdefault(t, ReadSet()).merge(rs)
+            if len(targets) > 1:
+                multi.setdefault(t, set()).update(rs.required)
+    out: Dict[str, Contract] = {}
+    for member in members:
+        sends = usage.sends.get(member, [])
+        rs = reads.get(member, ReadSet())
+        # discriminated-union demotion: a key the reader ALSO probes
+        # via .get()/`in` is guarded somewhere — the bare index is not
+        # an unconditional contract on every sender
+        for k in list(rs.required):
+            if k in rs.optional:
+                del rs.required[k]
+        # discriminator-gated reader: a reader that consults a success
+        # flag (`if not reply.get("ok"): ...`) indexes the rest of the
+        # payload conditionally — an error-shaped reply legitimately
+        # omits the success fields, so nothing stays REQUIRED of every
+        # sender
+        if set(rs.optional) & _DISCRIMINATORS:
+            for k in list(rs.required):
+                rs.optional.setdefault(k, rs.required.pop(k))
+        ever: Set[str] = set()
+        for site in sends:
+            ever.update(site.keys)
+        opaque = any(site.open for site in sends)
+        soft = multi.get(member, set())
+        required = set(rs.required) - soft
+        optional = (ever | set(rs.optional) | soft) - required - {_RID}
+        # no visible sender at all = open too: the keys an unseen
+        # sender ships cannot be enumerated
+        open_ = opaque or rs.open or not sends
+        out[member] = Contract(sends, ever, opaque, rs, soft,
+                               required, optional, open_)
+    return out
+
+
+def check_payloads(
+    members: Dict[str, int],
+    usage: PayloadUsage,
+    pmap: Optional[Dict[str, MapEntry]],
+    map_errors: List[Tuple[int, str]],
+    wire_rel: str = WIRE_REL,
+) -> List[Finding]:
+    fs: List[Finding] = list(usage.aux_findings)
+
+    def f(path: str, line: int, subject: str, msg: str) -> None:
+        fs.append(Finding(path=path, line=line, rule=R_PAYLOAD, msg=msg,
+                          key=f"{R_PAYLOAD}:{subject}"))
+
+    if pmap is None:
+        f(wire_rel, 1, "no-map",
+          f"wire.py's module docstring has no '{_PAYLOAD_HEADER}' "
+          "section — the per-MsgType payload contracts must be declared "
+          "where the linter (and the reader) can see them")
+        pmap = {}
+    for line, tok in map_errors:
+        f(wire_rel, line, f"map-syntax:{tok}",
+          f"payload map token {tok!r} is neither a key, 'key?', '-', "
+          "'*', nor a '<- REQUEST' annotation")
+    reply_map = _reply_map_from_pmap(pmap)
+    contracts = assemble_contracts(members, usage, reply_map)
+
+    for member in sorted(members):
+        c = contracts[member]
+        sends, rs, ever, soft = c.sends, c.rs, c.ever, c.soft
+
+        # required-read-but-never-sent: the latent KeyError
+        if sends and not c.opaque:
+            for k in sorted(c.required - ever):
+                loc = rs.required[k]
+                f(loc[0], loc[1], f"required-never-sent:{member}:{k}",
+                  f"MsgType.{member}'s reader indexes payload key {k!r} "
+                  "unconditionally but no sender of the type ever ships "
+                  "it — a latent KeyError on the wire")
+        # conditional-send / sender disagreement vs a required read
+        for k in sorted(c.required):
+            for site in sends:
+                if site.open:
+                    continue
+                if k not in site.keys:
+                    if k in ever:  # another sender ships it: disagreement
+                        f(site.rel, site.line,
+                          f"required-not-always:{member}:{k}:{site.rel}:{site.line}",
+                          f"this sender of MsgType.{member} never ships "
+                          f"{k!r} but the type's reader indexes it "
+                          "unconditionally (other senders do ship it) — "
+                          "senders disagree on the contract")
+                elif not site.keys[k]:
+                    f(site.rel, site.line,
+                      f"required-not-always:{member}:{k}:{site.rel}:{site.line}",
+                      f"this sender of MsgType.{member} ships {k!r} only "
+                      "conditionally but the type's reader indexes it "
+                      "unconditionally — a skipped branch is a KeyError "
+                      "at the reader")
+        # sent-but-never-read: dead wire bytes
+        if rs.readers and not rs.open:
+            for k in sorted(ever - set(rs.required) - set(rs.optional)
+                            - soft):
+                site = next(s for s in sends if k in s.keys)
+                f(site.rel, site.line, f"sent-never-read:{member}:{k}",
+                  f"MsgType.{member} ships payload key {k!r} but no "
+                  "reader of the type ever looks at it — dead wire "
+                  "bytes (drop it, or the reader lost a field)")
+
+        # map cross-check (both directions)
+        entry = pmap.get(member)
+        if not pmap and member not in pmap:
+            continue  # no map at all: already reported
+        if entry is None:
+            f(wire_rel, members[member], f"unmapped:{member}",
+              f"MsgType.{member} has no payload-map line — every member "
+              "must declare its payload contract (use '-' for empty, "
+              "'*' for open)")
+            continue
+        contract_required = c.required
+        contract_optional = c.optional
+        analysis_open = c.open
+        known = contract_required | contract_optional
+        mapped = entry.required | entry.optional
+        if entry.open:
+            if not analysis_open:
+                f(wire_rel, entry.line, f"map-open-resolved:{member}",
+                  f"payload map marks MsgType.{member} open ('*') but "
+                  "inference fully resolves every sender and reader — "
+                  "declare the real contract")
+            for k in sorted(known - mapped):
+                f(wire_rel, entry.line, f"map-missing-key:{member}:{k}",
+                  f"payload key {k!r} of MsgType.{member} is on the wire "
+                  "but missing from the payload map")
+            for k in sorted(contract_required - entry.required):
+                if k in mapped:
+                    f(wire_rel, entry.line, f"map-requiredness:{member}:{k}",
+                      f"payload key {k!r} of MsgType.{member} is read "
+                      "unconditionally (required) but the map marks it "
+                      "optional")
+        else:
+            if analysis_open:
+                f(wire_rel, entry.line, f"map-not-open:{member}",
+                  f"MsgType.{member}'s payload cannot be fully resolved "
+                  "(opaque sender or open reader) but the map does not "
+                  "mark it '*' — the declared contract overclaims")
+                continue
+            for k in sorted(mapped - known):
+                f(wire_rel, entry.line, f"map-key-unknown:{member}:{k}",
+                  f"payload map lists key {k!r} for MsgType.{member} but "
+                  "nothing on the wire sends or reads it — stale map "
+                  "entry")
+            for k in sorted(known - mapped):
+                f(wire_rel, entry.line, f"map-missing-key:{member}:{k}",
+                  f"payload key {k!r} of MsgType.{member} is on the wire "
+                  "but missing from the payload map")
+            for k in sorted((contract_required & mapped) - entry.required):
+                f(wire_rel, entry.line, f"map-requiredness:{member}:{k}",
+                  f"payload key {k!r} of MsgType.{member} is read "
+                  "unconditionally (required) but the map marks it "
+                  "optional")
+            for k in sorted((contract_optional & mapped) & entry.required):
+                f(wire_rel, entry.line, f"map-requiredness:{member}:{k}",
+                  f"payload key {k!r} of MsgType.{member} is marked "
+                  "required in the map but no reader indexes it "
+                  "unconditionally")
+    for member, entry in sorted(pmap.items()):
+        if member not in members:
+            f(wire_rel, entry.line, f"map-ghost:{member}",
+              f"payload map declares MsgType.{member} which is not an "
+              "enum member")
+        if entry.reply_to and entry.reply_to not in members:
+            f(wire_rel, entry.line, f"map-ghost-reply:{member}",
+              f"payload map annotates MsgType.{member} as the reply of "
+              f"{entry.reply_to}, which is not an enum member")
+    return fs
+
+
+def run_payload_check(
+    trees: Dict[str, ast.Module], wire_rel: str = WIRE_REL
+) -> List[Finding]:
+    """Pure driver over parsed trees (fixture-friendly)."""
+    if wire_rel not in trees:
+        return []
+    wire_tree = trees[wire_rel]
+    members = extract_msgtype_members(wire_tree)
+    if not members:
+        return []
+    doc = ast.get_docstring(wire_tree) or ""
+    parsed = parse_payload_map(doc)
+    if parsed is None:
+        pmap, map_errors = None, []
+    else:
+        pmap, map_errors = parsed
+    usage = collect_payload_usage(
+        trees, members, _reply_map_from_pmap(pmap))
+    return check_payloads(members, usage, pmap, map_errors, wire_rel)
+
+
+def rule_payloads(root: str, trees: Dict[str, ast.Module]) -> List[Finding]:
+    return run_payload_check(trees)
+
+
+# ----------------------------------------------------------------------
+# map bootstrap helper (contributor tool, not part of the lint run)
+# ----------------------------------------------------------------------
+
+
+def dump_inferred_map(trees: Dict[str, ast.Module]) -> List[str]:
+    """Render the inferred contract as payload-map lines — the seed for
+    (and the way to refresh) wire.py's docstring section."""
+    wire_tree = trees.get(WIRE_REL)
+    if wire_tree is None:
+        return []
+    members = extract_msgtype_members(wire_tree)
+    doc = ast.get_docstring(wire_tree) or ""
+    parsed = parse_payload_map(doc)
+    pmap = parsed[0] if parsed else {}
+    reply_map = _reply_map_from_pmap(pmap)
+    usage = collect_payload_usage(trees, members, reply_map)
+    contracts = assemble_contracts(members, usage, reply_map)
+    lines = []
+    for member in sorted(members, key=lambda m: members[m]):
+        c = contracts[member]
+        toks = sorted(c.required) + [f"{k}?" for k in sorted(c.optional)]
+        if c.open:
+            toks.append("*")
+        if not toks:
+            toks = ["-"]
+        entry = pmap.get(member)
+        if entry is not None and entry.reply_to:
+            toks += ["<-", entry.reply_to]
+        lines.append(f"    {member}: " + " ".join(toks))
+    return lines
+
+
+if __name__ == "__main__":  # pragma: no cover - contributor helper
+    import os
+    import sys
+
+    from .dmllint import repo_root, scan_paths, _parse, _rel
+
+    root = sys.argv[1] if len(sys.argv) > 1 else repo_root()
+    trees = {}
+    for path in scan_paths(root):
+        rel = _rel(root, path)
+        trees[rel] = _parse(path, rel)
+    print("\n".join(dump_inferred_map(trees)))

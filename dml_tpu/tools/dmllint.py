@@ -14,7 +14,13 @@ chaos soak at a time.
 Run it::
 
     python -m dml_tpu.tools.dmllint [--json] [--root DIR] [--baseline F]
+                                    [--rules R1,R2] [--paths GLOB,GLOB]
     python -m dml_tpu lint            # same, as a CLI verb
+
+``--rules``/``--paths`` narrow what is REPORTED (iterate on one rule
+or one file without the full-repo noise); the whole tree is always
+scanned and stale-baseline reporting pauses while filtering. ``--json``
+output carries a ``schema_version`` field.
 
 Exit codes (CI contract): 0 = clean, 1 = un-baselined findings,
 2 = internal error (unparseable source, malformed baseline).
@@ -86,6 +92,26 @@ so fixture trees exercise them selectively):
   — stage names in the attribution table must not be able to drift
   from the instrumentation.
 
+Flow-aware rules (implemented in the sibling ``dmlflow`` module — see
+its docstring for the full semantics and recognized suppressions):
+
+- ``race-yield-hazard`` — per ``async def`` in ``dml_tpu/``, a
+  statement-ordered model of ``self.*`` / module-global mutable state:
+  flags check-then-act sequences whose branch test and mutation of the
+  same attribute straddle an ``await`` (the interleaving window), and
+  acquire/release window markers whose release is not on the
+  ``try/finally`` cancellation path. Recognized await-safe idioms —
+  re-check-after-await, one ``async with <lock>`` across the whole
+  window, snapshot-into-local — are not flagged.
+- ``drift-wire-payloads`` — infers each ``MsgType``'s payload schema
+  from every send site (dict literals and locally-built dicts) vs the
+  keys its registered handler / reply-await sites read
+  (``msg.data["k"]`` = required, ``.get("k")`` = optional), and
+  cross-checks wire.py's docstring "Payload map (lint-enforced)"
+  section in both directions: required-read-but-never-sent,
+  conditionally-sent-but-required, sent-but-never-read, and any
+  map/wire disagreement are findings.
+
 Baseline
 --------
 
@@ -120,12 +146,20 @@ R_METRICS = "drift-metrics-map"
 R_SUMMARY = "drift-summary-keys"
 R_MARKERS = "drift-pytest-markers"
 R_SPANS = "drift-span-names"
+# flow-aware passes (implemented in the sibling dmlflow module)
+R_RACE = "race-yield-hazard"
+R_PAYLOAD = "drift-wire-payloads"
 R_STALE = "baseline-stale"
 
 ALL_RULES = (
     R_NAKED, R_SILENT, R_BLOCKING, R_UNSEEDED,
-    R_WIRE, R_METRICS, R_SUMMARY, R_MARKERS, R_SPANS, R_STALE,
+    R_WIRE, R_METRICS, R_SUMMARY, R_MARKERS, R_SPANS,
+    R_RACE, R_PAYLOAD, R_STALE,
 )
+
+#: --json output contract version: bumped when the shape of the JSON
+#: document changes (2 = schema_version/rules fields + flow rules)
+JSON_SCHEMA_VERSION = 2
 
 #: blocking calls flagged inside ``async def`` (module attr, call name)
 BLOCKING_CALLS: Set[Tuple[str, str]] = {
@@ -1122,11 +1156,29 @@ class LintResult:
 
 
 def run_lint(
-    root: Optional[str] = None, baseline_path: Optional[str] = None
+    root: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+    paths: Optional[Sequence[str]] = None,
 ) -> LintResult:
+    """Run the analyzer. ``rules``/``paths`` narrow what is REPORTED
+    (for iterating on one rule or one file): the whole tree is always
+    scanned — cross-artifact rules need the full view — and findings
+    are filtered afterwards. While either filter is active,
+    baseline-stale reporting is disabled (a partial view cannot judge
+    staleness) and the baseline acts as suppression only."""
+    from . import dmlflow  # sibling module; imported late (it imports us)
+
     root = os.path.abspath(root or repo_root())
     if baseline_path is None:
         baseline_path = os.path.join(root, DEFAULT_BASELINE)
+    if rules:
+        unknown = sorted(set(rules) - set(ALL_RULES))
+        if unknown:
+            raise LintInternalError(
+                f"unknown rule(s) {', '.join(unknown)} — valid: "
+                + ", ".join(ALL_RULES)
+            )
     trees: Dict[str, ast.Module] = {}
     findings: List[Finding] = []
     for path in scan_paths(root):
@@ -1134,14 +1186,31 @@ def run_lint(
         trees[rel] = _parse(path, rel)  # raises LintInternalError
         findings.extend(analyze_tree(trees[rel], rel))
     for rule_fn in (rule_wire, rule_metrics, rule_summary, rule_markers,
-                    rule_spans):
+                    rule_spans, dmlflow.rule_race, dmlflow.rule_payloads):
         findings.extend(rule_fn(root, trees))
+    filtered = bool(rules) or bool(paths)
+    if rules:
+        findings = [f for f in findings if f.rule in set(rules)]
+    if paths:
+        import fnmatch
+
+        findings = [
+            f for f in findings
+            if any(fnmatch.fnmatch(f.path, p) for p in paths)
+        ]
     baseline = load_baseline(baseline_path)
     new, suppressed = apply_baseline(
         findings, baseline, _rel(root, baseline_path)
     )
-    new.sort()
-    suppressed.sort()
+    if filtered:
+        new = [f for f in new if f.rule != R_STALE]
+    # explicit sort key, not dataclass ordering: under `python -m
+    # dml_tpu.tools.dmllint` this module is __main__ while dmlflow
+    # imports the package copy, so findings from the two passes are
+    # instances of two (identical) Finding classes
+    sort_key = lambda f: (f.path, f.line, f.rule, f.msg, f.key)  # noqa: E731
+    new.sort(key=sort_key)
+    suppressed.sort(key=sort_key)
     return LintResult(
         findings=new, suppressed=suppressed, baseline_size=len(baseline)
     )
@@ -1154,10 +1223,21 @@ def bench_block(root: Optional[str] = None) -> Dict[str, Any]:
     not kill a bench run (the error lands in the block instead)."""
     try:
         res = run_lint(root)
+
+        def n(rule: str) -> int:
+            return sum(
+                1 for f in res.findings + res.suppressed if f.rule == rule
+            )
+
         return {
             "lint_clean": res.clean,
             "findings": len(res.findings),
             "baseline_size": res.baseline_size,
+            # flow-aware pass counts (round-16 gate): findings INCLUDING
+            # baselined ones, so the artifact records how many flagged
+            # sites exist even on a clean tree
+            "race_findings": n(R_RACE),
+            "payload_findings": n(R_PAYLOAD),
             "rules": list(ALL_RULES),
         }
     except Exception as e:  # defensive: bench preamble must survive
@@ -1177,17 +1257,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "under the root)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable output")
+    p.add_argument("--rules", default=None, metavar="R1,R2",
+                   help="only report these rules (comma-separated; "
+                        "stale-baseline reporting is disabled while "
+                        "filtering)")
+    p.add_argument("--paths", default=None, metavar="GLOB[,GLOB]",
+                   help="only report findings whose path matches one of "
+                        "these globs (the whole tree is still scanned)")
     args = p.parse_args(argv)
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        if args.rules else None
+    paths = [g.strip() for g in args.paths.split(",") if g.strip()] \
+        if args.paths else None
     try:
-        res = run_lint(args.root, args.baseline)
+        res = run_lint(args.root, args.baseline, rules=rules, paths=paths)
     except LintInternalError as e:
         if args.json:
-            print(json.dumps({"internal_error": str(e)}))
+            print(json.dumps({"internal_error": str(e),
+                              "schema_version": JSON_SCHEMA_VERSION}))
         else:
             print(f"dmllint: internal error: {e}", file=sys.stderr)
         return 2
     if args.json:
         print(json.dumps({
+            "schema_version": JSON_SCHEMA_VERSION,
             "clean": res.clean,
             "findings": [
                 {"path": f.path, "line": f.line, "rule": f.rule,
@@ -1196,6 +1289,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ],
             "suppressed": len(res.suppressed),
             "baseline_size": res.baseline_size,
+            "rules": list(rules) if rules else list(ALL_RULES),
         }, indent=2))
     else:
         for f in res.findings:

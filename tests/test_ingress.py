@@ -707,3 +707,47 @@ def test_compact_summary_trim_keeps_request_keys():
     for k in ("req_p99_ms", "req_goodput_qps", "req_shed_ratio",
               "req_failover_ok"):
         assert k in doc["summary"]
+
+
+@pytest.mark.ingress
+def test_submit_cancellation_does_not_leak_futures(monkeypatch):
+    """race-yield-hazard fix (ISSUE 13): a CANCELLED submit — a
+    wait_for timeout around it, client teardown — must pop the future
+    and stream queue it registered before awaiting admission.
+    CancelledError flies past `except Exception`, so only the
+    try/finally form cleans up on that path."""
+    from types import SimpleNamespace
+
+    from dml_tpu.ingress import router as router_mod
+
+    async def run():
+        node = SimpleNamespace(
+            register=lambda *a, **k: None,
+            on_became_leader_cbs=[],
+            new_rid=lambda: "n#1",
+            me=SimpleNamespace(unique_name="n:1"),
+        )
+        jobs = SimpleNamespace(node=node, store=None, on_job_done_cbs=[])
+        r = router_mod.RequestRouter(jobs)
+
+        hang = asyncio.Event()
+
+        async def never(*a, **k):
+            await hang.wait()
+
+        monkeypatch.setattr(router_mod, "leader_retry", never)
+        t = asyncio.create_task(r.submit("m", stream=True))
+        await asyncio.sleep(0.05)
+        assert len(r._futs) == 1 and len(r._streams) == 1
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        assert len(r._futs) == 0
+        assert len(r._streams) == 0
+        # the submit may have been ADMITTED with only its ACK lost:
+        # the cancelled client records the lost classification, so a
+        # late completed push counts as a terminal conflict instead of
+        # silently evading the exactly-once verdict
+        assert list(r._client_terminal.values()) == ["lost"]
+
+    asyncio.run(run())

@@ -262,3 +262,61 @@ async def test_data_plane_refuses_corrupt_replica(tmp_path):
         assert src.inventory() == {}
     finally:
         await plane.stop()
+
+
+def test_download_result_echo_mismatch_dropped():
+    """drift-wire-payloads fix (ISSUE 13): the DOWNLOAD result's
+    file/version echo is validated against the request it claims to
+    resolve — a garbled or byzantine ACK carrying a real req id must
+    not flip a replica slot for the wrong file or version."""
+    from types import SimpleNamespace
+
+    from dml_tpu.cluster.store_service import StoreService
+    from dml_tpu.cluster.wire import Message, MsgType
+
+    md = StoreMetadata()
+    rid = md.new_request("put", "f.jpeg", "client:1", ["a:1"], version=2)
+    st = md.get_request(rid)
+    svc = SimpleNamespace(
+        node=SimpleNamespace(is_leader=True), metadata=md, _me="leader:1",
+    )
+    h = StoreService._h_download_result
+    # wrong file echo: dropped before any status change
+    asyncio.run(h(svc, Message("a:1", MsgType.DOWNLOAD_FILE_SUCCESS,
+                               {"req": rid, "file": "other.jpeg",
+                                "version": 2}), None))
+    assert st.replicas["a:1"] == "pending"
+    # wrong version echo: dropped too
+    asyncio.run(h(svc, Message("a:1", MsgType.DOWNLOAD_FILE_SUCCESS,
+                               {"req": rid, "file": "f.jpeg",
+                                "version": 9}), None))
+    assert st.replicas["a:1"] == "pending"
+    # matching echo: the slot flips and the replica is recorded
+    svc._resolve_put = lambda *a, **k: None
+    asyncio.run(h(svc, Message("a:1", MsgType.DOWNLOAD_FILE_SUCCESS,
+                               {"req": rid, "file": "f.jpeg",
+                                "version": 2}), None))
+    assert st.replicas["a:1"] == "ok"
+    assert md.replicas_of("f.jpeg") == ["a:1"]
+
+
+def test_delete_result_echo_mismatch_dropped():
+    """Same echo contract for the DELETE fan-in path."""
+    from types import SimpleNamespace
+
+    from dml_tpu.cluster.store_service import StoreService
+    from dml_tpu.cluster.wire import Message, MsgType
+
+    md = StoreMetadata()
+    rid = md.new_request("delete", "f.jpeg", "client:1", ["a:1", "b:1"])
+    st = md.get_request(rid)
+    svc = SimpleNamespace(
+        node=SimpleNamespace(is_leader=True), metadata=md, _me="leader:1",
+    )
+    h = StoreService._h_delete_result
+    asyncio.run(h(svc, Message("a:1", MsgType.DELETE_FILE_ACK,
+                               {"req": rid, "file": "other.jpeg"}), None))
+    assert st.replicas["a:1"] == "pending"
+    asyncio.run(h(svc, Message("a:1", MsgType.DELETE_FILE_ACK,
+                               {"req": rid, "file": "f.jpeg"}), None))
+    assert st.replicas["a:1"] == "ok"

@@ -759,3 +759,26 @@ def test_span_name_registry_is_closed():
                  "fetch", "infer", "prefill", "handoff", "decode",
                  "put", "result", "store_put", "store_get", "marker"):
         assert name in SPAN_NAMES
+
+
+@pytest.mark.tracing
+def test_trace_reply_degradation_detection():
+    """drift-wire-payloads fix (ISSUE 13): every degraded TRACE_PULL
+    reply tier is detected — the explicit count-only `truncated`
+    marker, the label-stripped tier, AND the halved-newest-half tiers
+    (which only betray themselves as got < held)."""
+    from dml_tpu.cluster.node import Node
+
+    detect = Node._trace_reply_degradation
+    # full reply: nothing to report
+    assert detect({"ok": True, "held": 4}, 4) is None
+    assert detect({"ok": True}, 7) is None
+    # count-only tier
+    deg = detect({"ok": True, "held": 9, "truncated": "spans"}, 0)
+    assert deg == {"held": 9, "got": 0, "truncated": "spans"}
+    # halved tier: no marker at all, only the count gap
+    deg = detect({"ok": True, "held": 100}, 25)
+    assert deg == {"held": 100, "got": 25}
+    # stripped tier
+    deg = detect({"ok": True, "held": 4, "stripped": True}, 4)
+    assert deg == {"held": 4, "got": 4, "stripped": True}
