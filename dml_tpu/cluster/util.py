@@ -90,16 +90,29 @@ _retry_nonce = itertools.count()
 class BoundedDict(dict):
     """Dict that evicts its oldest insertion beyond `maxlen` — for
     idempotency-token and recently-completed caches that must not grow
-    with a long-lived process."""
+    with a long-lived process.
 
-    def __init__(self, maxlen: int = 1000):
+    ``on_evict(key)`` fires per bound-forced eviction (NOT on explicit
+    deletes): silent eviction is invisible state loss — e.g. a
+    session-affinity row aging out of the router guarantees the
+    session's next turn misses its worker's KV cache, which operators
+    can only see if the eviction is counted."""
+
+    def __init__(self, maxlen: int = 1000, on_evict=None):
         super().__init__()
         self.maxlen = maxlen
+        self.on_evict = on_evict
 
     def __setitem__(self, key, value):
         super().__setitem__(key, value)
         while len(self) > self.maxlen:
-            del self[next(iter(self))]
+            victim = next(iter(self))
+            del self[victim]
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(victim)
+                except Exception:
+                    log.exception("BoundedDict on_evict hook failed")
 
     def setdefault(self, key, default=None):
         # dict.setdefault is C-level and bypasses __setitem__; route it

@@ -95,7 +95,7 @@ Refresh the key lists with ``python -m dml_tpu.tools.dmlflow``.
     REQUEST_STATUS: id?
     REQUEST_STATUS_ACK: done? known? terminal? * <- REQUEST_STATUS
     REQUEST_STREAM_READY: host? id? port? token?
-    INGRESS_RELAY: job reqs?
+    INGRESS_RELAY: job? reqs? sessions?
     TRACE_PULL: max_spans? peers? timeout? trace_ids? *
     TRACE_PULL_ACK: degraded? error? failed? held? ok? spans? stripped? truncated? * <- TRACE_PULL
 """

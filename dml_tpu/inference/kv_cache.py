@@ -1,0 +1,592 @@
+"""Worker-resident KV prefix cache: warm-start decode from retired
+requests' KV slabs, prefilling only the new suffix.
+
+Session affinity (ingress/router.py) routes a multi-turn session back
+to the worker that served its previous turn — but before this module
+nothing REUSED the KV that worker computed: every turn re-prefilled
+the whole conversation from token 0, so turn-N prefill cost grew
+linearly in history length, exactly on the interactive traffic the
+SLO tiers protect. This module cashes the locality promise in:
+
+- **capture**: when a request retires from the LMServer slot grid,
+  its KV rows (prompt + generated positions, already in the slot's
+  cache) and the token ids they belong to are retained host-side,
+  keyed by token prefix in a trie — so both multi-turn sessions
+  (turn N+1's prompt extends turn N's prompt + completion) and
+  shared system-prompt prefixes hit;
+- **warm start**: a new request whose prompt extends a cached prefix
+  adopts the cached rows and prefills ONLY the suffix — one
+  `prefill_suffix` forward over the new tokens attending over the
+  cached prefix — then enters a slot through the same
+  `LMServer.submit_prefilled` placement the disaggregated handoff
+  uses. Greedy outputs are token-identical to the cold full-prefill
+  path (the repo's exactness contract; pinned by
+  tests/test_kv_cache.py), so the cache changes TTFT and prefill
+  cost, never answers. Sampled serving (temperature > 0) never warm
+  starts — first tokens are argmax-seeded, the same discipline as
+  the disaggregated backend;
+- **budget**: entries are ref-counted (an entry pinned by an
+  in-flight adopter is never evicted) under an explicit host-bytes
+  budget with LRU eviction; an entry whose token path is a strict
+  prefix of a newly inserted one is dominated and dropped
+  immediately (a session's turn N slab dies when turn N+1 retires).
+
+The host readback this costs happens ONCE per retiring request (the
+slot's rows sliced device-side, materialized off the chunk-dispatch
+readback), not per decode step; with the cache disabled
+(``LMServer.kv_cache is None``, the default) the serve path is
+bit-identical to a build without this module.
+
+Metric family (observability docstring map): ``lm_kv_cache_*`` —
+hits/misses/evictions counters, resident-bytes + entries gauges, and
+the prefill tokens-saved counter the bench's multi-turn phase reads.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability import METRICS
+
+log = logging.getLogger(__name__)
+
+_M_HITS = METRICS.counter(
+    "lm_kv_cache_hits_total",
+    "prefix-cache warm starts (requests adopted from a cached slab)")
+_M_MISSES = METRICS.counter(
+    "lm_kv_cache_misses_total",
+    "prefix-cache lookups with no usable cached prefix")
+_M_EVICT = METRICS.counter(
+    "lm_kv_cache_evictions_total",
+    "prefix-cache entries evicted (budget LRU + dominated prefixes)")
+_M_SAVED = METRICS.counter(
+    "lm_kv_cache_tokens_saved_total",
+    "prompt tokens NOT re-prefilled thanks to warm starts")
+_M_BYTES = METRICS.gauge(
+    "lm_kv_cache_bytes", "resident host bytes across prefix caches")
+_M_ENTRIES = METRICS.gauge(
+    "lm_kv_cache_entries", "live prefix-cache entries across caches")
+
+# process-wide totals behind the gauges: several backends (tests, a
+# disagg primary + its lender) can hold caches in one process and a
+# per-instance set() would make them fight over the gauge
+_TOTALS_LOCK = threading.Lock()
+_TOTAL_BYTES = 0
+_TOTAL_ENTRIES = 0
+
+
+def _totals_add(d_bytes: int, d_entries: int) -> None:
+    global _TOTAL_BYTES, _TOTAL_ENTRIES
+    with _TOTALS_LOCK:
+        _TOTAL_BYTES += d_bytes
+        _TOTAL_ENTRIES += d_entries
+        _M_BYTES.set(_TOTAL_BYTES)
+        _M_ENTRIES.set(_TOTAL_ENTRIES)
+
+
+def rows_nbytes(rows: Dict[str, Dict[str, np.ndarray]]) -> int:
+    return sum(
+        int(np.asarray(a).nbytes)
+        for kv in rows.values() for a in kv.values()
+    )
+
+
+def slice_rows(
+    rows: Dict[str, Dict[str, Any]], n: int
+) -> Dict[str, Dict[str, Any]]:
+    """First ``n`` positions of a slab tree (the slab leaf layout:
+    values carry T on axis 1, kv_quant scales on axis 2 — the
+    `LMServer.submit_prefilled` contract)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, kv in rows.items():
+        out[name] = {}
+        for key, a in kv.items():
+            out[name][key] = (
+                a[:, :, :n] if key.endswith("_s") else a[:, :n]
+            )
+    return out
+
+
+def concat_rows(
+    prefix: Dict[str, Dict[str, np.ndarray]],
+    suffix: Dict[str, Dict[str, np.ndarray]],
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Prefix slab ++ suffix slab along the position axis."""
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for name, kv in prefix.items():
+        out[name] = {}
+        for key, a in kv.items():
+            axis = 2 if key.endswith("_s") else 1
+            out[name][key] = np.concatenate(
+                [np.asarray(a), np.asarray(suffix[name][key])], axis=axis
+            )
+    return out
+
+
+def capture_slot_rows(cache: Dict[str, Any], slot: int, n: int):
+    """Device-side slice of one slot's first ``n`` cache positions in
+    slab layout (values [KV, n, D], kv_quant scales [KV, 1, n]). The
+    slices are their own buffers, so the slot can be reused
+    immediately; materialization to host happens at `offer`."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, kv in cache.items():
+        out[name] = {}
+        for key, arr in kv.items():
+            if key.endswith("_s"):
+                out[name][key] = arr[slot, :, :, :n]
+            else:
+                out[name][key] = arr[slot, :, :n]
+    return out
+
+
+class _TrieNode:
+    __slots__ = ("children", "owners", "terminals")
+
+    def __init__(self):
+        self.children: Dict[int, "_TrieNode"] = {}
+        self.owners: set = set()      # every entry passing through
+        self.terminals: set = set()   # entries ENDING exactly here
+
+
+class _Entry:
+    __slots__ = ("eid", "tokens", "rows", "nbytes", "refs")
+
+    def __init__(self, eid: int, tokens: np.ndarray,
+                 rows: Dict[str, Dict[str, np.ndarray]], nbytes: int):
+        self.eid = eid
+        self.tokens = tokens  # token ids at positions [0, len(rows_T))
+        self.rows = rows
+        self.nbytes = nbytes
+        self.refs = 0
+
+
+class Lease:
+    """A pinned match: the entry cannot evict while the adopter holds
+    the lease. ``m`` is the usable prefix length for the prompt the
+    lease was acquired against (always < len(prompt): at least one
+    suffix token remains to produce the next-token logits)."""
+
+    def __init__(self, cache: "KVPrefixCache", entry: _Entry, m: int):
+        self._cache = cache
+        self._entry = entry
+        self.m = int(m)
+        self._released = False
+
+    def prefix_rows(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """The entry's first ``m`` positions (host arrays, zero-copy
+        views into the cached slab — valid while the lease is held;
+        `concat_rows` copies them out)."""
+        return slice_rows(self._entry.rows, self.m)
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._cache._unpin(self._entry)
+
+
+class KVPrefixCache:
+    """Token-prefix-keyed cache of retired requests' KV slabs.
+
+    Thread-safe: the LMDriver thread adopts while the event loop
+    (DisaggLMBackend) peeks for routing — one lock guards the trie,
+    the LRU order, and the byte budget. ``min_match`` is the shortest
+    cached prefix worth a warm start (below it a full prefill is
+    cheaper than the extra dispatch)."""
+
+    def __init__(self, max_bytes: int, min_match: int = 1):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        self.max_bytes = int(max_bytes)
+        self.min_match = max(1, int(min_match))
+        self._closed = False
+        self._lock = threading.Lock()
+        self._root = _TrieNode()
+        #: eid -> entry in LRU order (oldest first)
+        self._entries: "OrderedDict[int, _Entry]" = OrderedDict()
+        self._next_id = 0
+        self.bytes = 0
+        # instance counters (the bench reads per-backend stats; the
+        # registry counters above are process-global)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.tokens_saved = 0
+        self.inserts = 0
+
+    # ---- write side ---------------------------------------------------
+
+    def offer(self, tokens: np.ndarray, rows: Dict[str, Dict[str, Any]],
+              ) -> bool:
+        """Retain a retired request's slab: ``tokens[i]`` is the token
+        at position i, ``rows`` the per-layer KV for exactly those
+        positions (device or host arrays; materialized here). Returns
+        False when the slab was not kept (already covered, bigger
+        than the whole budget, or everything evictable is pinned)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = int(tokens.size)
+        if n < 1:
+            return False
+        host = {
+            name: {k: np.asarray(a) for k, a in kv.items()}
+            for name, kv in rows.items()
+        }
+        nbytes = rows_nbytes(host)
+        with self._lock:
+            if self._closed:
+                return False  # a retire racing close() must not
+                # resurrect host bytes into a dropped cache
+            covered, _ = self._walk(tokens)
+            if covered >= n:
+                return False  # an existing entry already spans this
+            if nbytes > self.max_bytes:
+                return False
+            if not self._make_room(nbytes):
+                return False  # every evictable entry is pinned
+            self._next_id += 1
+            e = _Entry(self._next_id, tokens, host, nbytes)
+            node = self._root
+            for d in range(n):
+                node = node.children.setdefault(
+                    int(tokens[d]), _TrieNode()
+                )
+                node.owners.add(e.eid)
+                # an entry ENDING strictly inside the new path is
+                # dominated (its rows are a sub-slab of ours): drop it
+                # now unless an in-flight adopter still pins it
+                if d + 1 < n and node.terminals:
+                    for teid in list(node.terminals):
+                        te = self._entries.get(teid)
+                        if te is not None and te.refs == 0:
+                            self._evict(te)
+            node.terminals.add(e.eid)
+            self._entries[e.eid] = e
+            self.bytes += nbytes
+            self.inserts += 1
+            _totals_add(nbytes, 1)
+            return True
+
+    def _make_room(self, need: int) -> bool:
+        while self.bytes + need > self.max_bytes:
+            victim = next(
+                (e for e in self._entries.values() if e.refs == 0), None
+            )
+            if victim is None:
+                return False
+            self._evict(victim)
+        return True
+
+    def _evict(self, e: _Entry) -> None:
+        node = self._root
+        stack: List[Tuple[_TrieNode, int]] = []
+        for t in e.tokens:
+            child = node.children.get(int(t))
+            if child is None:
+                break
+            stack.append((node, int(t)))
+            child.owners.discard(e.eid)
+            child.terminals.discard(e.eid)
+            node = child
+        for parent, tok in reversed(stack):
+            child = parent.children[tok]
+            if child.owners or child.children:
+                break
+            del parent.children[tok]
+        self._entries.pop(e.eid, None)
+        self.bytes -= e.nbytes
+        self.evictions += 1
+        _M_EVICT.inc()
+        _totals_add(-e.nbytes, -1)
+
+    # ---- read side ----------------------------------------------------
+
+    def _walk(self, prompt: np.ndarray) -> Tuple[int, Optional[int]]:
+        """Deepest trie depth along ``prompt`` with a live owner, and
+        the newest owning entry id there (None when no match)."""
+        node = self._root
+        best_d, best_eid = 0, None
+        for d in range(int(prompt.size)):
+            node = node.children.get(int(prompt[d]))
+            if node is None:
+                break
+            if node.owners:
+                best_d, best_eid = d + 1, max(node.owners)
+        return best_d, best_eid
+
+    def _usable(self, prompt: np.ndarray) -> Tuple[int, Optional[int]]:
+        d, eid = self._walk(np.asarray(prompt, np.int32).reshape(-1))
+        m = min(d, int(np.asarray(prompt).size) - 1)
+        if m < self.min_match or eid is None:
+            return 0, None
+        return m, eid
+
+    def match_len(self, prompt: np.ndarray) -> int:
+        """Peek the usable cached-prefix length for ``prompt`` (0 =
+        miss). Routing only — no pin, no hit/miss accounting (the
+        disagg backend peeks here to keep warm requests local)."""
+        with self._lock:
+            m, eid = self._usable(prompt)
+            return m if eid is not None else 0
+
+    def acquire(self, prompt: np.ndarray) -> Optional[Lease]:
+        """Pin the longest usable cached prefix of ``prompt``; counts
+        a miss (and returns None) when nothing usable is cached. The
+        caller MUST release the lease (try/finally)."""
+        with self._lock:
+            m, eid = self._usable(prompt)
+            if eid is None:
+                self.misses += 1
+                _M_MISSES.inc()
+                return None
+            e = self._entries[eid]
+            e.refs += 1
+            self._entries.move_to_end(eid)  # LRU touch
+            return Lease(self, e, m)
+
+    def _unpin(self, e: _Entry) -> None:
+        with self._lock:
+            e.refs = max(0, e.refs - 1)
+            # a close() that ran while this adopter held its lease
+            # skipped the pinned entry — finish the job here so the
+            # bytes (and the process-wide gauges) actually return
+            if self._closed and e.refs == 0 and e.eid in self._entries:
+                self._evict(e)
+
+    def note_adopted(self, saved_tokens: int) -> None:
+        """A warm start actually placed: count the hit and the prompt
+        tokens whose prefill it skipped."""
+        with self._lock:
+            self.hits += 1
+            self.tokens_saved += int(saved_tokens)
+        _M_HITS.inc()
+        _M_SAVED.inc(int(saved_tokens))
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "tokens_saved": self.tokens_saved,
+                "inserts": self.inserts,
+            }
+
+    def close(self) -> None:
+        """Drop every unpinned entry and refuse new inserts; entries
+        pinned by an in-flight adopter drop at their lease release
+        (the gauges return to zero either way)."""
+        with self._lock:
+            self._closed = True
+            for e in list(self._entries.values()):
+                if e.refs == 0:
+                    self._evict(e)
+
+
+# ----------------------------------------------------------------------
+# suffix-only prefill: one forward over the NEW tokens, attending over
+# the cached prefix KV + causal self-attention within the suffix
+# ----------------------------------------------------------------------
+
+
+class SuffixPrefiller:
+    """Jitted suffix prefill per (prefix-bucket, suffix-bucket) shape.
+
+    Exactness: KV at position i is the layer projection of the
+    position-i residual stream, which depends only on tokens <= i —
+    so attending suffix queries over the CACHED prefix rows plus the
+    suffix's own causal keys computes the same function as a full
+    prefill of the whole prompt (the first sampled token is the
+    argmax at the true last prompt position, like the server's
+    bucket-padded placement prefill). Attention runs in f32 over the
+    (dequantized, for kv_quant configs) cache exactly like the decode
+    step's einsum path. Prefix and suffix lengths bucket to powers of
+    two so distinct compilations stay bounded, with validity masks
+    making the pads invisible."""
+
+    def __init__(self, cfg, max_len: int):
+        self.cfg = cfg
+        self.max_len = int(max_len)
+        self._fns: Dict[Tuple[int, int], Any] = {}
+
+    def _fn(self, pc: int, ts: int):
+        fn = self._fns.get((pc, ts))
+        if fn is None:
+            import jax
+
+            fn = jax.jit(
+                lambda params, prefix, suffix, plen, true_ts: (
+                    _suffix_prefill_impl(
+                        params, self.cfg, prefix, suffix, plen, true_ts
+                    )
+                )
+            )
+            self._fns[(pc, ts)] = fn
+        return fn
+
+    def __call__(
+        self,
+        params: Any,
+        prefix_rows: Dict[str, Dict[str, np.ndarray]],
+        m: int,
+        suffix: np.ndarray,
+    ) -> Tuple[int, Dict[str, Dict[str, np.ndarray]]]:
+        """(first_token, suffix slab for positions [m, m+ts)). The
+        returned slab concatenates onto the prefix slab to form the
+        full `submit_prefilled` payload."""
+        import jax.numpy as jnp
+
+        from .lm_server import _bucket
+
+        suffix = np.asarray(suffix, np.int32).reshape(-1)
+        ts = int(suffix.size)
+        if ts < 1:
+            raise ValueError("empty suffix")
+        pc = min(_bucket(int(m)), self.max_len)
+        tb = min(_bucket(ts), self.max_len)
+        padded = np.empty(tb, np.int32)
+        padded[:ts] = suffix
+        padded[ts:] = suffix[-1]  # the server's pad policy
+        prefix_padded = {}
+        for name, kv in prefix_rows.items():
+            prefix_padded[name] = {}
+            for key, a in kv.items():
+                a = np.asarray(a)
+                t_axis = 2 if key.endswith("_s") else 1
+                pad = [(0, 0)] * a.ndim
+                pad[t_axis] = (0, pc - a.shape[t_axis])
+                prefix_padded[name][key] = jnp.asarray(np.pad(a, pad))
+        first_dev, rows_dev = self._fn(pc, tb)(
+            params, prefix_padded, jnp.asarray(padded),
+            jnp.int32(m), jnp.int32(ts),
+        )
+        first = int(np.asarray(first_dev))
+        out_rows: Dict[str, Dict[str, np.ndarray]] = {}
+        for name, kv in rows_dev.items():
+            out_rows[name] = {}
+            for key, arr in kv.items():
+                a = np.asarray(arr)
+                out_rows[name][key] = (
+                    a[:, :, :ts] if key.endswith("_s") else a[:, :ts]
+                )
+        return first, out_rows
+
+
+def _suffix_prefill_impl(params, cfg, prefix, suffix_tok, plen, true_ts):
+    """Traced body: suffix tokens [Ts] at positions plen + arange(Ts),
+    prefix slab padded to a static bucket with only positions < plen
+    valid. Returns (argmax token at suffix position true_ts - 1,
+    suffix-position slab in cache leaf layout)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .generate import (
+        _apply_block,
+        _head,
+        _kv_dequant,
+        _kv_quantize,
+    )
+
+    ts = suffix_tok.shape[0]
+    hd = cfg.head_dim
+    grp = cfg.n_heads // cfg.kv_heads
+    x = params["embed"]["embedding"][suffix_tok].astype(cfg.dtype)[None]
+    positions = plen + jnp.arange(ts)
+    causal = (
+        jnp.arange(ts)[:, None] >= jnp.arange(ts)[None, :]
+    )  # [Ts_q, Ts_k]
+    out_rows: Dict[str, Dict[str, Any]] = {}
+    for i in range(cfg.n_layers):
+        name = f"block_{i}"
+        pfx = prefix[name]
+        if cfg.kv_quant:
+            pk = _kv_dequant(pfx["k_q"], jnp.swapaxes(pfx["k_s"], 1, 2))
+            pv = _kv_dequant(pfx["v_q"], jnp.swapaxes(pfx["v_s"], 1, 2))
+        else:
+            pk = pfx["k"].astype(jnp.float32)
+            pv = pfx["v"].astype(jnp.float32)
+        pc = pk.shape[1]
+        pmask = jnp.arange(pc)[None, None, None, None, :] < plen
+
+        def attn_fn(q, k, v, pk=pk, pv=pv, pmask=pmask):
+            # q [1, Ts, H, hd]; k/v [1, Ts, KV, hd]; f32 attention over
+            # (masked prefix ++ causal suffix), the decode einsum
+            # path's precision discipline
+            qg = q.astype(jnp.float32).reshape(
+                1, ts, cfg.kv_heads, grp, hd
+            ) * (hd ** -0.5)
+            sp = jnp.einsum("btkgd,kpd->bkgtp", qg, pk)
+            sp = jnp.where(pmask, sp, -1e30)
+            ss = jnp.einsum(
+                "btkgd,bskd->bkgts", qg, k.astype(jnp.float32)
+            )
+            ss = jnp.where(causal[None, None, None, :, :], ss, -1e30)
+            p = jax.nn.softmax(jnp.concatenate([sp, ss], axis=-1), axis=-1)
+            ctx = jnp.einsum("bkgtp,kpd->btkgd", p[..., :pc], pv)
+            ctx = ctx + jnp.einsum(
+                "bkgts,bskd->btkgd", p[..., pc:],
+                v.astype(jnp.float32),
+            )
+            return ctx.reshape(1, ts, cfg.n_heads, hd)
+
+        x, k, v = _apply_block(params[name], cfg, x, positions, attn_fn)
+        kh = jnp.swapaxes(k, 1, 2)[0]  # [KV, Ts, hd] — cache layout
+        vh = jnp.swapaxes(v, 1, 2)[0]
+        if cfg.kv_quant:
+            kq, ks = _kv_quantize(kh)
+            vq, vs = _kv_quantize(vh)
+            out_rows[name] = {
+                "k_q": kq, "k_s": jnp.swapaxes(ks, 1, 2),
+                "v_q": vq, "v_s": jnp.swapaxes(vs, 1, 2),
+            }
+        else:
+            out_rows[name] = {
+                "k": kh.astype(cfg.dtype), "v": vh.astype(cfg.dtype),
+            }
+    x_last = jax.lax.dynamic_slice_in_dim(x, true_ts - 1, 1, axis=1)
+    logits = _head(params, cfg, x_last)  # [1, V]
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+    return first, out_rows
+
+
+class WarmStart:
+    """The LMServer's warm-placement half: cache + suffix prefiller.
+    Built by `LMServer.enable_kv_cache`; `rows_for` turns a queued
+    prompt into a full `submit_prefilled` payload, or None on a miss
+    (the caller falls back to the cold group prefill)."""
+
+    def __init__(self, cache: KVPrefixCache, cfg, max_len: int):
+        self.cache = cache
+        self.prefiller = SuffixPrefiller(cfg, max_len)
+
+    def rows_for(
+        self, params: Any, prompt: np.ndarray
+    ) -> Optional[Tuple[Dict[str, Dict[str, np.ndarray]], int, int]]:
+        """(full rows for positions < len(prompt), first_token,
+        saved_tokens) or None. Failures demote to the cold path — a
+        stale or undersized cached slab must never fail the request."""
+        lease = self.cache.acquire(prompt)
+        if lease is None:
+            return None
+        try:
+            m = lease.m
+            first, suffix_rows = self.prefiller(
+                params, lease.prefix_rows(), m,
+                np.asarray(prompt, np.int32).reshape(-1)[m:],
+            )
+            rows = concat_rows(lease.prefix_rows(), suffix_rows)
+        except Exception as e:
+            log.warning(
+                "kv-cache warm start failed (%r); cold prefill", e
+            )
+            return None
+        finally:
+            lease.release()
+        return rows, first, m

@@ -173,6 +173,7 @@ class LMBackend:
         top_k: Optional[int] = None,
         seed: int = 0,
         gather_shardings: Any = None,
+        kv_cache_bytes: int = 0,
     ):
         self.cfg = cfg
         self.max_new_tokens = max_new_tokens
@@ -181,6 +182,17 @@ class LMBackend:
             chunk=chunk, temperature=temperature, top_k=top_k, seed=seed,
             gather_shardings=gather_shardings,
         )
+        # worker-resident KV prefix cache (inference/kv_cache.py):
+        # retired requests' KV rows are retained under this host-bytes
+        # budget and prompts extending a cached prefix warm-start with
+        # a suffix-only prefill. 0 (the default) = disabled — the
+        # serve path stays bit-identical to a cache-less build.
+        self.kv_cache = None
+        if int(kv_cache_bytes) > 0:
+            from .kv_cache import KVPrefixCache
+
+            self.kv_cache = KVPrefixCache(int(kv_cache_bytes))
+            self.server.enable_kv_cache(self.kv_cache)
         # measured serving constants for the scheduler's cost model
         # (folded from real ACKs after the first batch either way)
         self._per_query = 0.05
@@ -317,6 +329,22 @@ class LMBackend:
         """Stop the driver thread (idempotent); in-flight work
         finishes first."""
         self.driver.stop()
+        if self.kv_cache is not None:
+            self.kv_cache.close()
+
+    def set_kv_cache_enabled(self, enabled: bool) -> None:
+        """Toggle the prefix cache WITHOUT dropping its contents —
+        the bench's warm-vs-cold comparison flips this to run the
+        same backend both ways. No-op when the backend was built
+        without a cache budget."""
+        if self.kv_cache is None:
+            return
+        self.server.enable_kv_cache(self.kv_cache if enabled else None)
+
+    def kv_cache_stats(self) -> Optional[Dict[str, int]]:
+        """Prefix-cache counters (None when disabled) — the bench's
+        multi-turn phase aggregates these per worker."""
+        return None if self.kv_cache is None else self.kv_cache.stats()
 
     def decode_tokens_total(self) -> int:
         """Delivered-token count of THIS backend's server — the
@@ -558,6 +586,12 @@ class LMBackend:
                 else None
             ),
             seed=int(spec.get("seed", 0)),
+            # {"kv_cache_mb": 256} turns on the worker-resident KV
+            # prefix cache with that host-bytes budget (0/absent =
+            # off, today's behavior)
+            kv_cache_bytes=int(
+                float(spec.get("kv_cache_mb", 0) or 0) * (1 << 20)
+            ),
         )
         # operators pick the serving concurrency mode per deployment
         # ({"overlap": false}): the driver's cross-batch batching wins

@@ -284,7 +284,26 @@ class LMServer:
         # server (each distinct shape costs one XLA compilation unless
         # a jit/persistent cache already holds it)
         self._seen_shapes: set = set()
+        # worker-resident KV prefix cache (inference/kv_cache.py),
+        # enable_kv_cache wires both; None (the default) keeps the
+        # serve path bit-identical to a cache-less build
+        self.kv_cache = None
+        self._warm = None
         _M_SLOTS_TOTAL.set(max_slots)
+
+    def enable_kv_cache(self, cache) -> None:
+        """Attach a `KVPrefixCache`: retiring requests donate their KV
+        rows + token ids, and queued greedy requests whose prompt
+        extends a cached prefix warm-start through `submit_prefilled`
+        with only the suffix prefilled. Pass None to detach (the cold
+        path, bit-identical to today's behavior)."""
+        from .kv_cache import WarmStart
+
+        self.kv_cache = cache
+        self._warm = (
+            WarmStart(cache, self.cfg, self.max_len)
+            if cache is not None else None
+        )
 
     def _maybe_gather(self, params):
         """Trace-time hook: under the param-gather serving form the
@@ -482,7 +501,30 @@ class LMServer:
         )
         if slot is None:
             raise RuntimeError("no free slot for prefilled request")
-        tp = prompt.size
+        self._rid += 1
+        req = _Request(
+            self._rid, prompt, int(max_new_tokens),
+            t_submit=time.monotonic(), on_token=on_token,
+        )
+        _M_REQS.inc()
+        self._place_prefilled(slot, req, rows, int(first_token))
+        _M_SLOTS.set(sum(1 for r in self._slot_req if r is not None))
+        return req.rid
+
+    def _place_prefilled(
+        self,
+        slot: int,
+        req: _Request,
+        rows: Dict[str, Dict[str, np.ndarray]],
+        first_token: int,
+    ) -> None:
+        """Place an already-prefilled request into ``slot`` — the
+        shared core of `submit_prefilled` (disaggregated slab
+        adoption) and the KV-prefix-cache warm placement
+        (_place_waiting). ``rows`` covers positions < len(prompt);
+        ``first_token`` is host-side, so it lands in the output
+        directly with no pending readback."""
+        tp = req.prompt.size
         # rebuild the [1, KV, max_len, ...] insert-shaped tree: values
         # pad the T axis (2), kv_quant scales carry T on lanes (3)
         pcache = {}
@@ -499,12 +541,6 @@ class LMServer:
                 pad = [(0, 0)] * a.ndim
                 pad[t_axis] = (0, self.max_len - tp)
                 pcache[name][key] = jnp.asarray(np.pad(a, pad))[None]
-        self._rid += 1
-        req = _Request(
-            self._rid, prompt, int(max_new_tokens),
-            t_submit=time.monotonic(), on_token=on_token,
-        )
-        _M_REQS.inc()
         self.cache = self._insert(
             self.cache, pcache, jnp.int32(slot), jnp.int32(0)
         )
@@ -526,8 +562,6 @@ class LMServer:
         _M_TOKENS.inc()
         if req.done:  # max_new_tokens == 1: the slab's token was all
             self._retire(slot)
-        _M_SLOTS.set(sum(1 for r in self._slot_req if r is not None))
-        return req.rid
 
     def _place_waiting(self) -> None:
         # Placement is FULLY ASYNC and GROUP-BATCHED: free slots take
@@ -548,6 +582,32 @@ class LMServer:
                 pairs.append((slot, self._queue.pop(0)))
         if not pairs:
             return
+        if self._warm is not None and self.temperature == 0.0:
+            # KV-prefix warm starts intercept placement REQUEST BY
+            # REQUEST: a prompt extending a cached prefix adopts the
+            # cached rows + a suffix-only prefill through the
+            # submit_prefilled placement; everything else falls
+            # through to the cold group prefill below. Greedy only —
+            # sampled first tokens are rid-keyed (submit_prefilled's
+            # documented discipline), and with no cache attached this
+            # branch never runs, keeping the cold path bit-identical.
+            cold: List[Tuple[int, _Request]] = []
+            for slot, req in pairs:
+                warm = self._warm.rows_for(self.params, req.prompt)
+                if warm is None:
+                    cold.append((slot, req))
+                    continue
+                rows, first, saved = warm
+                now = time.monotonic()
+                _M_QUEUE_WAIT.observe(now - req.t_submit)
+                self._place_prefilled(slot, req, rows, first)
+                self.kv_cache.note_adopted(saved)
+            pairs = cold
+            if not pairs:
+                _M_SLOTS.set(
+                    sum(1 for r in self._slot_req if r is not None)
+                )
+                return
         groups: Dict[int, List[Tuple[int, _Request]]] = {}
         for slot, req in pairs:
             b = min(_bucket(req.prompt.size), self.max_len)
@@ -626,11 +686,46 @@ class LMServer:
     def _retire(self, slot: int) -> None:
         req = self._slot_req[slot]
         assert req is not None
+        # greedy-only like the warm/read side: a sampled server can
+        # never adopt (first tokens are rid-keyed), so capturing would
+        # pay per-retire readbacks into a cache nothing ever reads
+        if self.kv_cache is not None and self.temperature == 0.0:
+            self._capture_retired(slot, req)
         self._done[req.rid] = req
         req.slot = None
         self._slot_req[slot] = None
         self.rid_vec[slot] = 0
         _M_REQS_DONE.inc()
+
+    def _capture_retired(self, slot: int, req: _Request) -> None:
+        """Donate a retiring request's KV rows to the prefix cache.
+        Valid cache positions are [0, Tp + emitted - 1): the LAST
+        sampled token was never fed back through the model, so its
+        row is unwritten — the entry's token list stops one short of
+        the full output, which is exactly what a next-turn prompt
+        (history + new suffix) re-covers with its own suffix prefill.
+        Capture is a device-side slice here; the host materialization
+        happens in `KVPrefixCache.offer` (once per retired request,
+        never per decode step). Any failure only forfeits the cache
+        entry — retirement itself must not break."""
+        from .kv_cache import capture_slot_rows
+
+        try:
+            n = req.prompt.size + req.emitted - 1
+            need = req.emitted - 1  # generated tokens with rows
+            if len(req.out) < need:
+                # deferred-first placement retire (budget-1 whose
+                # token value is still on device): need == 0 there,
+                # so this only guards a future delivery-order drift
+                return
+            tokens = np.concatenate([
+                req.prompt, np.asarray(req.out[:need], np.int32),
+            ])
+            self.kv_cache.offer(
+                tokens, capture_slot_rows(self.cache, slot, n)
+            )
+        except Exception as e:
+            log.warning("kv-cache capture failed at retire: %r", e)
 
     @staticmethod
     def _distribute_firsts(entries, vals, off) -> int:

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import math
 import random
 import time
@@ -39,13 +40,26 @@ from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence, Tup
 
 @dataclass(frozen=True)
 class Arrival:
-    """One scheduled request: fire at trace start + ``t`` seconds."""
+    """One scheduled request: fire at trace start + ``t`` seconds.
+
+    ``turn`` > 0 marks a CHAINED multi-turn session request
+    (`multi_turn_trace` / `run_sessions`): turn N's prompt is the
+    session's accumulated history (prior prompts + completions) plus
+    this arrival's seeded ``suffix`` token ids, so consecutive turns
+    share a growing token prefix — the workload shape the worker-
+    resident KV prefix cache (inference/kv_cache.py) exists for.
+    ``budget`` is the per-request generation budget those prompts
+    carry (0 = the driver's default). Plain open-loop arrivals keep
+    turn == 0 and no suffix."""
 
     t: float
     model: str
     slo: str
     session: Optional[str] = None
     stream: bool = False
+    turn: int = 0
+    suffix: Optional[Tuple[int, ...]] = None
+    budget: int = 0
 
 
 @dataclass
@@ -73,7 +87,18 @@ class ArrivalTrace:
             seed=int(d["seed"]),
             duration_s=float(d["duration_s"]),
             rate_qps=float(d["rate_qps"]),
-            arrivals=[Arrival(**a) for a in d["arrivals"]],
+            # suffix rides JSON as a list; the dataclass keeps a tuple
+            # so a round-tripped trace re-serializes byte-identically
+            arrivals=[
+                Arrival(**{
+                    **a,
+                    "suffix": (
+                        tuple(a["suffix"])
+                        if a.get("suffix") is not None else None
+                    ),
+                })
+                for a in d["arrivals"]
+            ],
         )
 
 
@@ -126,6 +151,51 @@ def open_loop_trace(
     )
 
 
+def multi_turn_trace(
+    seed: int,
+    n_sessions: int,
+    turns: int,
+    model: str,
+    *,
+    slo: str = "interactive",
+    start_gap_s: float = 0.5,
+    think_s: float = 0.5,
+    suffix_len: int = 8,
+    vocab: int = 61,
+    budget: int = 16,
+) -> ArrivalTrace:
+    """Seeded GROWING-HISTORY session trace: ``n_sessions`` sessions of
+    ``turns`` chained turns each. Turn N's prompt is the session's
+    prior prompts + completions plus this turn's seeded ``suffix``
+    (drawn from ``vocab``), so consecutive turns extend a shared token
+    prefix — the prefix-cache workload. Arrival times stagger session
+    starts by ``start_gap_s`` with ``think_s`` between a session's
+    turns; `run_sessions` treats them as EARLIEST-fire times (a turn
+    additionally waits for its predecessor's completion — closed-loop
+    within a session, open-loop across sessions). Same seed =>
+    byte-identical trace; JSON round-trips like `open_loop_trace`'s."""
+    rng = random.Random(seed)
+    arrivals: List[Arrival] = []
+    for s in range(n_sessions):
+        t0 = round(s * start_gap_s + rng.random() * 0.1, 6)
+        for k in range(turns):
+            arrivals.append(Arrival(
+                t=round(t0 + k * think_s, 6), model=model, slo=slo,
+                session=f"mt{seed}s{s}", stream=True, turn=k + 1,
+                suffix=tuple(
+                    rng.randrange(vocab) for _ in range(suffix_len)
+                ),
+                budget=int(budget),
+            ))
+    arrivals.sort(key=lambda a: (a.t, a.session, a.turn))
+    duration = max((a.t for a in arrivals), default=0.0) + think_s
+    rate = len(arrivals) / duration if duration > 0 else 0.0
+    return ArrivalTrace(
+        seed=seed, duration_s=round(duration, 6),
+        rate_qps=round(rate, 6), arrivals=arrivals,
+    )
+
+
 # ----------------------------------------------------------------------
 # outcomes + scoring
 # ----------------------------------------------------------------------
@@ -163,6 +233,12 @@ class Outcome:
     #: fallback when a trace was sampled away or evicted
     trace_id: Optional[str] = None
     stages: Optional[Dict[str, float]] = None
+    #: multi-turn session fields (`run_sessions`): which turn this
+    #: outcome belongs to (0 = not chained) and the client-side
+    #: time-to-first-token measured at the first streamed chunk —
+    #: the warm-vs-cold number the prefix-cache bench phase scores
+    turn: int = 0
+    ttft_s: Optional[float] = None
 
 
 def percentile(sorted_vals: Sequence[float], p: float) -> float:
@@ -244,6 +320,41 @@ def summarize(
     attrib = _p99_attribution(outcomes, trace_stages)
     if attrib is not None:
         out["p99_attribution"] = attrib
+    turn_block = _by_turn(outcomes)
+    if turn_block is not None:
+        out["by_turn"] = turn_block
+    return out
+
+
+def _by_turn(outcomes: Sequence[Outcome]) -> Optional[Dict[str, Any]]:
+    """Per-turn TTFT scorecard over chained session outcomes (None
+    when the run carried no multi-turn traffic). Turn 1 pays the cold
+    prefill either way; turns >= 2 are where a prefix-cache warm
+    start shows up as a TTFT drop."""
+    rows = [o for o in outcomes if o.turn > 0]
+    if not rows:
+        return None
+    by: Dict[int, List[Outcome]] = {}
+    for o in rows:
+        by.setdefault(o.turn, []).append(o)
+    out: Dict[str, Any] = {}
+    for turn, grp in sorted(by.items()):
+        tt = sorted(
+            o.ttft_s for o in grp
+            if o.terminal == TERMINAL_COMPLETED and o.ttft_s is not None
+        )
+        out[str(turn)] = {
+            "n": len(grp),
+            "completed": sum(
+                1 for o in grp if o.terminal == TERMINAL_COMPLETED
+            ),
+            "ttft_ms": {
+                "p50": round(percentile(tt, 50) * 1e3, 1) if tt else None,
+                "mean": (
+                    round(sum(tt) / len(tt) * 1e3, 1) if tt else None
+                ),
+            },
+        }
     return out
 
 
@@ -352,6 +463,146 @@ async def drive_one(
         reason=term.get("reason"), model=a.model, session=a.session,
         trace_id=term.get("trace_id"),
     )
+
+
+async def run_sessions(
+    ingress,
+    trace: ArrivalTrace,
+    *,
+    submit_timeout: float = 8.0,
+    wait_timeout: float = 45.0,
+    turn_retries: int = 3,
+    now: Callable[[], float] = time.monotonic,
+) -> Tuple[List[Outcome], float, Dict[str, List[List[int]]]]:
+    """Drive a `multi_turn_trace` through a RequestRouter's client
+    verbs: sessions run concurrently (open-loop starts), but WITHIN a
+    session turn N+1 submits only after turn N completes — its prompt
+    is the accumulated history (prior prompts + completions) plus the
+    arrival's seeded suffix, shipped as an inline prompt-file payload
+    with the turn's budget directive. Every turn streams; TTFT is the
+    client-observed first streamed chunk.
+
+    A failed turn retries up to ``turn_retries`` times (greedy decode
+    is deterministic, so a retry cannot fork the transcript — the
+    failover case leans on this); a turn that never completes aborts
+    its session, with the remaining turns recorded as rejections so
+    terminals stay exhaustive. Returns (outcomes, wall seconds,
+    {session: completion token lists in turn order}) — the transcript
+    map is what the bench's warm-vs-cold equality verdict compares."""
+    from .router import RequestRejected
+
+    t0 = now()
+    outcomes: List[Outcome] = []
+    transcripts: Dict[str, List[List[int]]] = {}
+    by_session: Dict[str, List[Arrival]] = {}
+    for a in trace.arrivals:
+        if not a.session or a.turn <= 0:
+            raise ValueError("run_sessions wants multi_turn_trace arrivals")
+        by_session.setdefault(a.session, []).append(a)
+
+    async def one_turn(
+        a: Arrival, history: List[int]
+    ) -> Tuple[Outcome, Optional[List[int]]]:
+        prompt = history + list(a.suffix or ())
+        budget = int(a.budget) or 16
+        payload = (
+            f"# max_new_tokens: {budget}\n"
+            + " ".join(str(t) for t in prompt)
+        )
+        t_sub = now()
+        try:
+            rid = await ingress.submit(
+                a.model, slo=a.slo, payload=payload, session=a.session,
+                stream=True, timeout=submit_timeout,
+            )
+        except RequestRejected as e:
+            return Outcome(
+                slo=a.slo,
+                terminal=TERMINAL_SHED if e.shed else TERMINAL_REJECTED,
+                reason=e.reason, model=a.model, session=a.session,
+                turn=a.turn,
+            ), None
+        except Exception as e:
+            return Outcome(
+                slo=a.slo, terminal=TERMINAL_LOST, reason=repr(e),
+                model=a.model, session=a.session, turn=a.turn,
+            ), None
+        ttft_box: List[float] = []
+        stream_task = asyncio.ensure_future(ingress.stream_text(
+            rid, timeout=wait_timeout,
+            on_first=lambda: ttft_box.append(now() - t_sub),
+        ))
+        try:
+            term = await ingress.wait(rid, timeout=wait_timeout)
+        except Exception as e:
+            stream_task.cancel()
+            return Outcome(
+                slo=a.slo, terminal=TERMINAL_LOST, reason=f"wait: {e!r}",
+                model=a.model, session=a.session, turn=a.turn,
+            ), None
+        try:
+            await stream_task  # EOF rides the terminal settle
+        except Exception as e:
+            # TTFT may be missing; the terminal is authoritative
+            logging.getLogger(__name__).debug(
+                "session stream drain ended early: %r", e
+            )
+        e2e = now() - t_sub
+        result = term.get("result") if term.get("ok") else None
+        toks = (result or {}).get("tokens")
+        if term.get("ok") and isinstance(toks, list):
+            return Outcome(
+                slo=a.slo, terminal=TERMINAL_COMPLETED, e2e_s=e2e,
+                deadline_met=bool(term.get("deadline_met")),
+                model=a.model, session=a.session,
+                worker=term.get("worker"), has_result=True,
+                trace_id=term.get("trace_id"), turn=a.turn,
+                ttft_s=ttft_box[0] if ttft_box else None,
+            ), [int(t) for t in toks]
+        return Outcome(
+            slo=a.slo,
+            terminal=(TERMINAL_LOST if term.get("terminal") == "lost"
+                      else TERMINAL_REJECTED),
+            reason=term.get("reason") or "no_tokens_in_result",
+            model=a.model, session=a.session, turn=a.turn,
+        ), None
+
+    async def one_session(sess: str, turns_list: List[Arrival]) -> None:
+        history: List[int] = []
+        transcripts[sess] = []
+        for i, a in enumerate(sorted(turns_list, key=lambda x: x.turn)):
+            delay = a.t - (now() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            o: Optional[Outcome] = None
+            toks: Optional[List[int]] = None
+            for attempt in range(turn_retries + 1):
+                o, toks = await one_turn(a, history)
+                if o.terminal == TERMINAL_COMPLETED or attempt == turn_retries:
+                    break
+                await asyncio.sleep(0.25 * (attempt + 1))
+            assert o is not None
+            outcomes.append(o)
+            if o.terminal != TERMINAL_COMPLETED or toks is None:
+                # the chain is broken — later prompts would diverge
+                # from the deterministic transcript, so the session
+                # aborts and its remaining turns settle as typed
+                # rejections (terminals stay exhaustive for scoring)
+                for rest in sorted(turns_list, key=lambda x: x.turn)[i + 1:]:
+                    outcomes.append(Outcome(
+                        slo=rest.slo, terminal=TERMINAL_REJECTED,
+                        reason="session_aborted", model=rest.model,
+                        session=sess, turn=rest.turn,
+                    ))
+                return
+            # history grows by this turn's prompt suffix + completion
+            transcripts[sess].append(toks)
+            history = history + list(a.suffix or ()) + toks
+
+    await asyncio.gather(
+        *(one_session(s, rows) for s, rows in by_session.items())
+    )
+    return outcomes, now() - t0, transcripts
 
 
 async def run_open_loop(

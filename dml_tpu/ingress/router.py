@@ -94,6 +94,23 @@ _M_FILL = METRICS.histogram(
 _M_FORMATION = METRICS.histogram(
     "request_batch_formation_seconds",
     "first-enqueue -> dispatch wall per formed batch")
+# session-affinity observability: the router's session map is a REAL
+# locality signal once the worker-resident KV prefix cache exists
+# (inference/kv_cache.py) — a routed-to-holder turn warm-starts, a
+# miss re-prefills the whole history. Hits/misses make the signal's
+# quality visible; the eviction counter makes `_session_node` bound
+# pressure visible (a silently evicted session is a guaranteed cache
+# miss on its next turn).
+_M_AFF_HITS = METRICS.counter(
+    "request_session_affinity_hits_total",
+    "session requests routed to their previous turn's live worker")
+_M_AFF_MISSES = METRICS.counter(
+    "request_session_affinity_misses_total",
+    "session requests with no live affinity target (first turn, dead "
+    "or demoted holder, or an evicted session row)")
+_M_AFF_EVICT = METRICS.counter(
+    "request_session_affinity_evictions_total",
+    "session->worker rows evicted from the router's bounded map")
 
 
 def _terminal_kind(terminal: Any) -> str:
@@ -282,8 +299,18 @@ class RequestRouter:
         self._by_job: Dict[int, List[str]] = {}
         #: terminal records for status re-polls + submit dedup
         self._done: BoundedDict = BoundedDict(5000)
-        #: session -> worker that served its last turn (KV locality)
-        self._session_node: BoundedDict = BoundedDict(2000)
+        #: session -> worker that served its last turn (KV locality);
+        #: bound-forced evictions are counted (each one guarantees a
+        #: prefix-cache miss on that session's next turn)
+        self._session_node: BoundedDict = BoundedDict(
+            2000, on_evict=lambda _k: _M_AFF_EVICT.inc()
+        )
+        #: sessions whose binding changed since the last standby relay
+        #: (failover-safe affinity: the rows piggyback on INGRESS_RELAY
+        #: so a promoted router keeps routing turn N+1 to the worker
+        #: holding the session's cached KV)
+        self._session_dirty: set = set()
+        self._session_flush_t = 0.0
         #: standby: job_id -> relayed request dicts (promotion adopts)
         self._relayed: BoundedDict = BoundedDict(500)
         #: model -> (stamp, sampled input files): pattern matching is
@@ -569,6 +596,9 @@ class RequestRouter:
             # or demoted holder must not pin the batch to a ghost
             if aff and aff in self.jobs.worker_pool():
                 affinity = aff
+                _M_AFF_HITS.inc()
+            else:
+                _M_AFF_MISSES.inc()
         self._active[req_id] = _RequestState(req=req, root=root)
         self._pending_by_class[slo.name] = (
             self._pending_by_class.get(slo.name, 0) + 1
@@ -620,6 +650,13 @@ class RequestRouter:
                         f"dispatch {fb.model}/{fb.slo.name} "
                         f"x{len(fb.reqs)}",
                     )
+                now = time.monotonic()
+                if (
+                    self._session_dirty
+                    and now - self._session_flush_t >= self._SESSION_FLUSH_S
+                ):
+                    self._session_flush_t = now
+                    self._flush_sessions()
             except Exception:
                 log.exception("%s: ingress formation tick failed", self._me)
 
@@ -753,11 +790,51 @@ class RequestRouter:
                          r.ctx.span_id if r.ctx else "",
                          int(bool(r.ctx and r.ctx.sampled))]
                         for r in reqs
-                    ]},
+                    ],
+                    # session->worker rows dirtied since the last
+                    # relay piggyback here (failover-safe affinity:
+                    # turn N+1 after a promotion still routes to the
+                    # worker holding the session's cached KV)
+                    "sessions": self._take_session_rows()},
                 )
             except Exception:
                 log.exception("%s: ingress relay of job %d failed",
                               self._me, job_id)
+
+    #: max session rows per relay datagram (UDP control-frame budget)
+    _SESSION_RELAY_MAX = 100
+    #: standalone session-row flush cadence while dirty rows wait and
+    #: no dispatch relay happens to carry them
+    _SESSION_FLUSH_S = 0.25
+
+    def _take_session_rows(self) -> List[List[str]]:
+        """Pop up to ``_SESSION_RELAY_MAX`` dirtied session->worker
+        bindings for a relay payload. Best-effort at-most-once UDP
+        like the job relay itself: a dropped row costs the promoted
+        router one affinity miss, never correctness."""
+        rows: List[List[str]] = []
+        while self._session_dirty and len(rows) < self._SESSION_RELAY_MAX:
+            s = self._session_dirty.pop()
+            w = self._session_node.get(s)
+            if w:
+                rows.append([s, w])
+        return rows
+
+    def _flush_sessions(self) -> None:
+        """Standalone INGRESS_RELAY carrying only session rows: a
+        binding established by the LAST completion before a quiet
+        spell (or a leader kill) must not wait for the next dispatch
+        to reach the standby."""
+        sb = self.store.standby_node()
+        if sb is None or sb.unique_name == self._me:
+            return
+        rows = self._take_session_rows()
+        if not rows:
+            return
+        try:
+            self.node.send(sb, MsgType.INGRESS_RELAY, {"sessions": rows})
+        except Exception:
+            log.exception("%s: ingress session-row flush failed", self._me)
 
     # ------------------------------------------------------------------
     # router role: completion fan-out
@@ -865,6 +942,8 @@ class RequestRouter:
             e2e = now - r.arrival
             met = now <= r.deadline
             if r.session and worker:
+                if self._session_node.get(r.session) != worker:
+                    self._session_dirty.add(r.session)
                 self._session_node[r.session] = worker
             terminal = {
                 "terminal": "completed", "slo": r.slo.name,
@@ -1039,10 +1118,19 @@ class RequestRouter:
 
     async def _h_ingress_relay(self, msg: Message, addr) -> None:
         """Standby side: remember which requests ride which job so a
-        promotion can fan their completions out."""
+        promotion can fan their completions out, and adopt relayed
+        session->worker rows so affinity survives the failover (a
+        promoted router otherwise routes every session's next turn to
+        a cold peer, turning KV locality into guaranteed misses)."""
         if msg.sender != self.node.leader_unique or self.node.is_leader:
             return
-        self._relayed[int(msg.data["job"])] = {
+        for row in msg.data.get("sessions") or []:
+            if isinstance(row, (list, tuple)) and len(row) >= 2:
+                self._session_node[str(row[0])] = str(row[1])
+        job = msg.data.get("job")
+        if job is None:
+            return  # session-row-only flush
+        self._relayed[int(job)] = {
             "at": time.monotonic(),
             "reqs": list(msg.data.get("reqs") or []),
         }
@@ -1369,9 +1457,12 @@ class RequestRouter:
         self._spawn(pull(), f"stream pull {req_id}")
 
     async def stream_text(
-        self, req_id: str, timeout: float = 30.0
+        self, req_id: str, timeout: float = 30.0,
+        on_first: Optional[Callable[[], None]] = None,
     ) -> List[str]:
-        """Collect a streaming request's token chunks until EOF."""
+        """Collect a streaming request's token chunks until EOF.
+        ``on_first`` fires at the first chunk — the client-side TTFT
+        probe the multi-turn loadgen phase reads."""
         q = self._streams.get(req_id)
         if q is None:
             raise KeyError(f"{req_id} is not a streaming request")
@@ -1382,6 +1473,11 @@ class RequestRouter:
                 item = await asyncio.wait_for(
                     q.get(), max(0.01, deadline - time.monotonic())
                 )
+                if item is not None and not chunks and on_first is not None:
+                    try:
+                        on_first()
+                    except Exception as e:
+                        log.warning("stream on_first hook failed: %r", e)
                 if item is None:
                     # terminal settle also EOFs; drain any residue
                     # pushed by a racing pull task

@@ -165,6 +165,12 @@ line when you add the metric.
     jobs_queue_depth                 schedulable batches per model
     jobs_requeues_total              batches requeued after worker loss
     jobs_workers_busy                C5 workers-with-assignments gauge
+    lm_kv_cache_bytes                prefix-cache resident host bytes
+    lm_kv_cache_entries              live prefix-cache entries
+    lm_kv_cache_evictions_total      prefix-cache entries evicted
+    lm_kv_cache_hits_total           warm starts from cached prefixes
+    lm_kv_cache_misses_total         lookups with no usable prefix
+    lm_kv_cache_tokens_saved_total   prompt tokens not re-prefilled
     lm_server_compile_events_total   decode-graph compile events
     lm_server_decode_tokens_total    tokens decoded (all slots)
     lm_server_prefill_dispatch_seconds  prefill dispatch wall
@@ -193,6 +199,9 @@ line when you add the metric.
     request_in_flight                admitted, not yet terminal
     request_queue_wait_seconds       admission -> dispatch wait
     request_rejected_total           post-admission typed rejections
+    request_session_affinity_evictions_total  session rows aged out
+    request_session_affinity_hits_total  sessions routed to KV holder
+    request_session_affinity_misses_total  sessions with no live target
     request_shed_total               admission sheds by slo= reason=
     request_stream_tokens_total      tokens pushed into request streams
     store_corruption_detected_total  sha256 mismatches quarantined

@@ -1138,6 +1138,131 @@ def run_tracing_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# round-17 KV prefix cache: the request_serving section's multi-turn
+# phase (inference/kv_cache.py — warm-start decode from resident KV
+# slabs, suffix-only prefill) embeds a `kv_cache` block
+# ----------------------------------------------------------------------
+
+#: first round whose request_serving section must carry the kv_cache
+#: block (growing-history session trace scored warm vs cold)
+KV_CACHE_REQUIRED_FROM_ROUND = 17
+
+
+def check_kv_cache_block(path: str) -> List[str]:
+    """Validate the ``request_serving.kv_cache`` block WHEN the
+    section ran:
+
+    - ``hit_ratio`` > 0 — the multi-turn session trace actually
+      warm-started (a zero here means session affinity never landed a
+      turn on its KV holder, i.e. the locality promise is still
+      unfunded);
+    - ``warm_vs_cold_ttft`` > 1 — TTFT with the cache strictly beats
+      the cold full-re-prefill run of the SAME trace;
+    - ``tokens_saved`` > 0 — prompt tokens the suffix-only prefill
+      skipped, from the worker-side counter;
+    - ``warm_equals_cold`` True — every warm-start completion is
+      token-identical to the cold path (the exactness contract);
+    - the mid-session leader-failover sub-case ran and stayed
+      token-identical too (``failover.warm_equals_cold`` True with
+      completions > 0) — relayed session affinity plus exactly-once.
+
+    Artifacts before round ``KV_CACHE_REQUIRED_FROM_ROUND`` are
+    exempt; summary-only driver captures gate on the compact line's
+    ``kv_hit_ratio`` / ``kv_warm_vs_cold_ttft`` keys."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < KV_CACHE_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        problems = []
+        hr = s.get("kv_hit_ratio")
+        if hr is not None and (
+            not isinstance(hr, (int, float)) or not 0 < hr <= 1
+        ):
+            problems.append(
+                f"{name}: summary kv_hit_ratio = {hr!r} — the "
+                "multi-turn trace never warm-started"
+            )
+        rt = s.get("kv_warm_vs_cold_ttft")
+        if rt is not None and (
+            not isinstance(rt, (int, float)) or not math.isfinite(rt)
+            or rt <= 1.0
+        ):
+            problems.append(
+                f"{name}: summary kv_warm_vs_cold_ttft = {rt!r} — "
+                "warm TTFT must strictly beat the cold re-prefill"
+            )
+        return problems
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "request_serving" in not_run:
+        return []
+    block = matrix.get("request_serving")
+    if block is None or block.get("skipped"):
+        return []  # the request gate already flags a missing section
+    kb = block.get("kv_cache")
+    if not isinstance(kb, dict):
+        if rnd is None:
+            return []  # partial/preview artifact
+        return [f"{name}: request_serving ran without a `kv_cache` "
+                "block — the multi-turn prefix-cache phase is required "
+                f"from round {KV_CACHE_REQUIRED_FROM_ROUND}"]
+    problems: List[str] = []
+    hr = kb.get("hit_ratio")
+    if not isinstance(hr, (int, float)) or not 0 < hr <= 1:
+        problems.append(
+            f"{name}: kv_cache.hit_ratio = {hr!r} — the session trace "
+            "must actually hit the prefix cache (> 0)"
+        )
+    rt = kb.get("warm_vs_cold_ttft")
+    if not isinstance(rt, (int, float)) or not math.isfinite(rt) \
+            or rt <= 1.0:
+        problems.append(
+            f"{name}: kv_cache.warm_vs_cold_ttft = {rt!r} — warm-start "
+            "TTFT must strictly beat the cold full-re-prefill run"
+        )
+    ts = kb.get("tokens_saved")
+    if not isinstance(ts, int) or ts <= 0:
+        problems.append(
+            f"{name}: kv_cache.tokens_saved = {ts!r} — suffix-only "
+            "prefill never skipped a prompt token"
+        )
+    if kb.get("warm_equals_cold") is not True:
+        problems.append(
+            f"{name}: kv_cache.warm_equals_cold = "
+            f"{kb.get('warm_equals_cold')!r} — warm-start completions "
+            "must be token-identical to the cold path"
+        )
+    fo = kb.get("failover")
+    if not isinstance(fo, dict):
+        problems.append(
+            f"{name}: kv_cache.failover missing — the mid-session "
+            "leader-kill sub-case never ran"
+        )
+    else:
+        if fo.get("warm_equals_cold") is not True:
+            problems.append(
+                f"{name}: kv_cache.failover.warm_equals_cold = "
+                f"{fo.get('warm_equals_cold')!r} — completions must "
+                "stay token-identical across the leader failover"
+            )
+        if not fo.get("completed", 0):
+            problems.append(
+                f"{name}: kv_cache.failover completed 0 turns — the "
+                "sessions never resumed after the leader kill"
+            )
+    return problems
+
+
+def run_kv_cache_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_kv_cache_block(artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # static-analysis verdict: the bench preamble runs tools/dmllint.py and
 # records the result; from round 11 on an artifact must say the tree
 # is lint-clean (zero un-baselined async-hazard/drift findings) with a
@@ -1474,6 +1599,9 @@ def main() -> None:
     for problem in run_tracing_check(art_path):
         total += 1
         print(f"tracing block: {problem}")
+    for problem in run_kv_cache_check(art_path):
+        total += 1
+        print(f"kv-cache block: {problem}")
     for problem in run_lint_check(art_path):
         total += 1
         print(f"lint block: {problem}")

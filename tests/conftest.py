@@ -73,4 +73,5 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "lint: static-analysis coverage (tools/dmllint.py rule fixtures and the tier-1 zero-unbaselined-findings enforcement)")
     config.addinivalue_line("markers", "tracing: distributed request-tracing coverage (span propagation, flight recorder, cluster trace collection, tail attribution)")
     config.addinivalue_line("markers", "scale: control-plane scale coverage (bounded delta gossip, relay metrics aggregation, O(100)-node sims, sustained churn)")
+    config.addinivalue_line("markers", "kvcache: KV prefix-cache coverage (warm-start decode from resident slabs, suffix-only prefill, budgeted eviction, session affinity relay)")
 

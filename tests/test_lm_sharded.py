@@ -621,6 +621,7 @@ def test_disagg_adoption_failure_falls_back(tmp_path, parts, monkeypatch):
     gb.prefill_timeout = 5.0
     gb.last_ttft_s = None
     gb.handoffs = gb.fallbacks = gb.handoff_bytes = 0
+    gb.warm_locals = 0
 
     pf = LMPrefillBackend(params, cfg, max_len=64)
 
