@@ -73,7 +73,11 @@ SECTION_EST_S = {
     "cluster_lm_sharded": 560.0,
     "lm": 450.0,
     "cluster_lm_serving": 210.0,  # + >=15 s steady-state refill phase
-    "chaos": 200.0,  # 2 soak seeds + 6 adversarial scenario families
+    "chaos": 230.0,  # 2 soak seeds + 7 adversarial scenario families
+    # elastic capacity: one live cluster — saturated load window,
+    # authenticated scale-out of 2 joiners mid-load, re-measure,
+    # graceful scale-in + forged-join storm + invariant sweep
+    "elastic_capacity": 120.0,
     # control-plane scale matrix: 16/64/128-node membership-only
     # clusters x full-vs-delta gossip (bring-up, traffic window,
     # metrics aggregation, kill + election each) + the 64-node
@@ -580,6 +584,173 @@ def _bench_chaos(out, *, seeds=(1, 2), scenario_seeds=(1,),
                 "so walls measure protocol rounds, not deployed "
                 "wall-clock",
     }
+
+
+def _bench_elastic(out, *, base_port=29940, n_nodes=4, window_s=5.0,
+                   joiners=2):
+    """Elastic capacity (ROADMAP item 2's done-condition): capacity
+    added MID-LOAD raises measured throughput with ZERO restarts.
+
+    One CPU stub cluster with the authenticated join policy on; a
+    continuous job stream keeps the pool saturated while q/s is
+    measured over a window, then `joiners` brand-new nodes join
+    through JOIN_REQUEST (no node restarts, no cluster restart), the
+    scheduler absorbs them as weighted slots, and the same window
+    re-measures. Afterwards the joiners leave GRACEFULLY (retired
+    immediately — scale-in must not read as an outage), a forged-join
+    storm is blasted at the live nodes (typed rejections must move,
+    no phantom may enter any table), and the full chaos invariant
+    sweep must end green. claim_check gates the block from round 18."""
+    import asyncio
+    import shutil
+
+    from dml_tpu.cluster.chaos import (
+        FAST_TIMING, LocalCluster, fuzz_datagrams, invariant_sweep,
+        STUB_MODEL, _join_rejected_total,
+    )
+
+    root = f"/tmp/dml_tpu_bench_elastic_{os.getpid()}"
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root, exist_ok=True)
+
+    async def run():
+        import socket as _socket
+
+        cluster = LocalCluster(
+            n_nodes, root, base_port, timing=FAST_TIMING,
+            join_secret="bench-elastic",
+        )
+        try:
+            await cluster.start()
+            await cluster.wait_for(cluster.converged, 20.0,
+                                   "elastic bench convergence")
+            client = cluster.client()
+            for i in range(4):
+                p = os.path.join(root, f"img_{i}.jpeg")
+                with open(p, "wb") as f:
+                    f.write(b"\xff\xd8fakejpeg" + bytes([i]))
+                await client.store.put(p, f"img_{i}.jpeg")
+                cluster.expect_files.add(f"img_{i}.jpeg")
+
+            completed = {"q": 0}
+            stop = asyncio.Event()
+
+            async def loader():
+                # closed-loop per slot, open across slots: 3 jobs kept
+                # in flight so the pool is saturated before AND after
+                # the scale-out — the q/s delta isolates capacity
+                async def one():
+                    while not stop.is_set():
+                        c = cluster.client()
+                        try:
+                            jid = await c.jobs.submit_job(
+                                STUB_MODEL, 24, timeout=10.0, retries=3)
+                            done = await c.jobs.wait_job(jid, timeout=60.0)
+                            completed["q"] += int(
+                                done.get("total_queries", 0))
+                        except Exception:
+                            if stop.is_set():
+                                return
+                            await asyncio.sleep(0.1)
+                await asyncio.gather(*(one() for _ in range(3)))
+
+            load_task = asyncio.create_task(loader(), name="elastic-load")
+
+            async def measure() -> float:
+                q0 = completed["q"]
+                t0 = asyncio.get_running_loop().time()
+                await asyncio.sleep(window_s)
+                wall = asyncio.get_running_loop().time() - t0
+                return (completed["q"] - q0) / wall
+
+            await asyncio.sleep(1.5)  # ramp: fill the pipeline
+            leader = next(sn for sn in cluster.nodes.values()
+                          if sn.node.is_leader)
+            pool_before = len(leader.jobs.worker_pool())
+            qps_before = await measure()
+
+            joined = []
+            for _ in range(joiners):
+                sn = await cluster.scale_out()
+                joined.append(sn.node.me.unique_name)
+            await cluster.wait_for(
+                lambda: len(leader.jobs.worker_pool()) > pool_before,
+                15.0, "joined capacity taking pool slots",
+            )
+            await asyncio.sleep(1.0)  # let the new slots fill
+            pool_after = len(leader.jobs.worker_pool())
+            qps_after = await measure()
+
+            # graceful scale-in of every joiner, mid-load
+            scale_in_sent = []
+            for u in joined:
+                scale_in_sent.append(await cluster.scale_in(u))
+
+            # forged-join storm at the live cluster
+            reject_base = _join_rejected_total()
+            _, frames = fuzz_datagrams(
+                7, 24, tuple(sorted(cluster.nodes)),
+                join_secret="bench-elastic",
+                universe_epoch=cluster.spec.universe_epoch,
+                kinds=("join_bad_mac", "join_garbled", "join_stale",
+                       "join_replay"),
+            )
+            lid = cluster.spec.node_by_unique_name(
+                cluster.leader_uname() or "")
+            storm_sent = 0
+            if lid is not None:
+                sock = _socket.socket(_socket.AF_INET,
+                                      _socket.SOCK_DGRAM)
+                try:
+                    for fr in frames:
+                        sock.sendto(fr, (lid.host, lid.port))
+                        storm_sent += 1
+                finally:
+                    sock.close()
+            await asyncio.sleep(0.5)
+            storm_rejected = _join_rejected_total() - reject_base
+
+            stop.set()
+            await asyncio.wait_for(load_task, 90.0)
+            report = await invariant_sweep(cluster, {}, {})
+            gain = qps_after / qps_before if qps_before > 0 else None
+            elastic_ok = bool(
+                gain is not None and gain > 1.0
+                and cluster._restart_counter == 0
+                and all(scale_in_sent)
+                and storm_rejected > 0
+                and report.ok
+            )
+            return {
+                "nodes": n_nodes,
+                "joiners": joined,
+                "window_s": window_s,
+                "qps_before": round(qps_before, 1),
+                "qps_after": round(qps_after, 1),
+                # `is not None`: a measured-zero collapse must record
+                # 0.0 (gated), never masquerade as "window not run"
+                "scaleout_gain": (
+                    round(gain, 2) if gain is not None else None),
+                "pool_slots_before": pool_before,
+                "pool_slots_after": pool_after,
+                "restarts": cluster._restart_counter,
+                "scale_in_graceful": scale_in_sent,
+                "storm": {"sent": storm_sent,
+                          "rejected": int(storm_rejected)},
+                "sweep_ok": report.ok,
+                "sweep_failures": report.failures,
+                "elastic_ok": elastic_ok,
+                "note": "q/s windows measured on the SAME live "
+                        "cluster, load never paused, zero process "
+                        "restarts — the gain is pure admitted "
+                        "capacity; CPU stub backend, so the ratio "
+                        "(not the absolute q/s) is the claim",
+            }
+        finally:
+            await cluster.stop()
+            shutil.rmtree(root, ignore_errors=True)
+
+    out["elastic_capacity"] = asyncio.run(run())
 
 
 def _bench_control_plane_scale(
@@ -3002,6 +3173,10 @@ def main() -> None:
             # chaos (stub backend; the admission/formation/failover
             # machinery is what's scored)
             ("request_serving", lambda: _bench_request_serving(out)),
+            # elastic capacity: CPU-only like chaos — authenticated
+            # scale-out mid-load must RAISE q/s with zero restarts
+            # (ROADMAP item 2 done-condition, round 18)
+            ("elastic_capacity", lambda: _bench_elastic(out)),
             # control-plane scale matrix: CPU-only, membership-level —
             # the O(100)-node gossip/metrics/churn story (round 12)
             ("control_plane_scale",
@@ -3167,6 +3342,15 @@ def main() -> None:
             "control_plane_scale", "scale_metrics_wall_s"),
         "scale_ok": g("control_plane_scale", "scale_ok"),
         "scale_churn_ok": g("control_plane_scale", "churn", "ok"),
+        # elastic capacity (cluster/node.py authenticated join/leave,
+        # round-18 gate): q/s ratio after brand-new nodes joined
+        # mid-load with zero restarts, and the overall verdict (gain
+        # > 1, graceful scale-in, forged-join storm rejected+counted,
+        # green invariant sweep)
+        "elastic_scaleout_gain": g("elastic_capacity", "scaleout_gain"),
+        "elastic_ok": g("elastic_capacity", "elastic_ok"),
+        "elastic_qps_before": g("elastic_capacity", "qps_before"),
+        "elastic_qps_after": g("elastic_capacity", "qps_after"),
         # static-analysis verdict (tools/dmllint.py, round-11 gate);
         # the flow-aware pass counts (tools/dmlflow.py: race-yield-
         # hazard / drift-wire-payloads, baselined findings included)
@@ -3263,6 +3447,7 @@ _COMPACT_DROP_ORDER = (
     "section_wall_s", "kv_heads_tok_s", "chaos_scenarios_ok",
     "lint_findings", "lint_baseline",
     "scale_metrics_wall_s", "scale_churn_ok",
+    "elastic_qps_before", "elastic_qps_after",
     "lm_tok_s", "fail_detect_s", "fail_completed", "cluster_readback_ms",
     "chaos_malformed_dropped", "train_mfu_b128_ga4", "opt_batch",
     "inception_concat_bound", "sharded_vs_single",
@@ -3290,7 +3475,8 @@ COMPACT_SUMMARY_BUDGET = 1500
 #: streamed-handoff gate; req_* the round-9 request-serving gate;
 #: lint_clean the round-11 static-analysis gate (lint_race /
 #: lint_payload extend it to the round-16 flow-aware rules); scale_*
-#: the round-12 control-plane-scale gate.
+#: the round-12 control-plane-scale gate; elastic_scaleout_gain +
+#: elastic_ok the round-18 elastic-capacity gate.
 _COMPACT_KEEP_KEYS = (
     "headline_qps", "cluster_qps", "cluster_pipelining",
     "cluster_lm_tok_s", "cluster_lm_steady_tok_s",
@@ -3306,6 +3492,7 @@ _COMPACT_KEEP_KEYS = (
     "lint_clean", "lint_race", "lint_payload",
     "scale_converge_s", "scale_detect_s",
     "scale_bytes_per_node_s", "scale_ok",
+    "elastic_scaleout_gain", "elastic_ok",
     "section_errors", "sections_skipped",
 )
 

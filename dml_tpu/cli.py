@@ -705,13 +705,15 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "2%% loss + duplicate delivery)")
     pc.add_argument("--scenario", default=None,
                     choices=["asym", "disk", "dns", "skew", "fuzz",
-                             "churn"],
+                             "churn", "elastic"],
                     help="run one adversarial scenario family: "
                          "asym(metric partition), disk(-full + "
                          "corruption), dns (introducer outage during "
                          "failover), (clock) skew, fuzz (byzantine "
                          "datagrams), churn (sustained seeded "
-                         "join/leave)")
+                         "join/leave), elastic (authenticated "
+                         "scale-out mid-load + graceful LEAVE + "
+                         "forged-join storm)")
     pc.add_argument("--plan", default=None, metavar="FILE",
                     help="replay a saved plan JSON instead of generating")
     pc.add_argument("--dump", default=None, metavar="FILE",

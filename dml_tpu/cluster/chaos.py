@@ -55,6 +55,7 @@ from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ..config import ClusterSpec, NodeId, StoreConfig, Timing
+from ..config import join_mac as _join_mac
 from ..observability import METRICS
 from .introducer import IntroducerService
 from .node import Node
@@ -163,18 +164,36 @@ def _child_seed(seed: int, tag: str) -> int:
 #:              live replica directly, so a silently-corrupted copy
 #:              is forced through detection
 #: job          args.{n}: submit + await a stub-backend job
+#: scale_out    args.{n,group}: start n BRAND-NEW nodes (fresh
+#:              identities outside the genesis table) that join the
+#:              running cluster through the authenticated
+#:              JOIN_REQUEST path; args.group absorbs them into that
+#:              worker group (requires the plan's join_secret)
+#: scale_in     target=name|"joiner" (the most recent runtime
+#:              joiner)|"worker": graceful departure — the node
+#:              announces LEAVE, is retired from the universe
+#:              immediately (no SWIM suspicion window), and its
+#:              service stack stops
+#: join_storm   args.{n}: blast n forged JOIN_REQUESTs (bad HMAC,
+#:              garbled payload, stale epoch, replayed nonce) at the
+#:              live nodes — the typed rejection counters must move
+#:              and no phantom may enter the universe
 EVENT_KINDS = (
     "crash", "restart", "partition", "partition_asym", "heal", "loss",
     "shape", "store_fault", "store_heal", "disk_fault", "disk_heal",
     "disk_corrupt", "dns_crash", "dns_restart", "skew", "fuzz",
-    "put", "get", "job",
+    "put", "get", "job", "scale_out", "scale_in", "join_storm",
 )
 
 #: the adversarial scenario families `scenario_plan` generates and the
 #: bench chaos section + claim_check validate per-family ("churn" —
 #: sustained seeded join/leave, not one-off restarts — landed with the
-#: control-plane scale work and is claim_check-gated from round 12)
-SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz", "churn")
+#: control-plane scale work and is claim_check-gated from round 12;
+#: "elastic" — capacity change as a first-class event: authenticated
+#: scale-out mid-load, graceful LEAVE scale-in, join flapping, and a
+#: forged-join storm — is claim_check-gated from round 18)
+SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz", "churn",
+                     "elastic")
 
 
 @dataclass(frozen=True)
@@ -225,6 +244,10 @@ class ChaosPlan:
     #: quiet tail after the last event before the invariant sweep
     settle_s: float = 1.0
     name: str = "chaos"
+    #: non-empty = the cluster runs with the elastic join policy ON
+    #: (authenticated runtime join/leave); the elastic scenario
+    #: family needs it, everything else keeps the static universe
+    join_secret: str = ""
 
     def __post_init__(self):
         object.__setattr__(
@@ -236,13 +259,16 @@ class ChaosPlan:
         return (self.events[-1].t if self.events else 0.0) + self.settle_s
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "name": self.name,
             "seed": self.seed,
             "n_nodes": self.n_nodes,
             "settle_s": self.settle_s,
             "events": [e.to_dict() for e in self.events],
         }
+        if self.join_secret:
+            out["join_secret"] = self.join_secret
+        return out
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ChaosPlan":
@@ -251,6 +277,7 @@ class ChaosPlan:
             n_nodes=int(d.get("n_nodes", 5)),
             settle_s=float(d.get("settle_s", 1.0)),
             name=str(d.get("name", "chaos")),
+            join_secret=str(d.get("join_secret", "")),
             events=tuple(
                 event(e["t"], e["kind"], e.get("target"),
                       **e.get("args", {}))
@@ -269,7 +296,9 @@ class ChaosPlan:
 
 
 def fuzz_datagrams(
-    seed: int, n: int, senders: Tuple[str, ...] = ()
+    seed: int, n: int, senders: Tuple[str, ...] = (),
+    join_secret: str = "", universe_epoch: int = 0,
+    kinds: Optional[Tuple[str, ...]] = None,
 ) -> Tuple[List[bytes], List[bytes]]:
     """Seeded byzantine-wire generator: ``(malformed, byzantine)``.
 
@@ -278,9 +307,17 @@ def fuzz_datagrams(
     caller can assert the malformed-drop counter moved by at least
     their count. ``byzantine`` frames parse fine but carry adversarial
     content — forged senders, junk field types, missing keys, deep
-    nesting — and must be survivable: handlers may log and drop, but
-    no dispatcher coroutine may die.
-    """
+    nesting, and JOIN_REQUEST forgeries (bad HMAC, garbled node
+    payload, stale epoch, replayed nonce) — and must be survivable:
+    handlers may log and drop (the join forgeries COUNTED, in
+    membership_join_rejected_total), but no dispatcher coroutine may
+    die and no phantom may enter the universe.
+
+    ``join_secret``/``universe_epoch`` arm the two forgery classes
+    that need a VALID MAC to reach their check (stale epoch, replayed
+    nonce); without the secret those kinds still emit — they just die
+    earlier, at bad_mac. ``kinds`` restricts the seeded menu (the
+    elastic join-storm event uses the four join_* kinds alone)."""
     rng = random.Random(seed)
     base = Message(
         "127.0.0.1:65001", MsgType.PING, {"members": {}, "leader": None}
@@ -292,13 +329,23 @@ def fuzz_datagrams(
         sender = rng.choice(senders) if senders else "6.6.6.6:666"
         return Message(sender, mtype, data).pack()
 
+    def join_frame(node: Dict[str, Any], nonce: str, epoch: int,
+                   mac: Optional[str], sender: str) -> bytes:
+        if mac is None:
+            mac = "%064x" % rng.getrandbits(256)
+        return Message(sender, MsgType.JOIN_REQUEST, {
+            "node": node, "nonce": nonce, "epoch": epoch, "mac": mac,
+        }).pack()
+
+    menu = kinds or (
+        "trunc", "magic", "len_lie", "garbage", "oversize", "replay",
+        "byz_forged", "byz_junk_fields", "byz_missing", "byz_nested",
+        "join_bad_mac", "join_garbled", "join_stale", "join_replay",
+    )
     malformed: List[bytes] = []
     byzantine: List[bytes] = []
     for _ in range(n):
-        kind = rng.choice((
-            "trunc", "magic", "len_lie", "garbage", "oversize", "replay",
-            "byz_forged", "byz_junk_fields", "byz_missing", "byz_nested",
-        ))
+        kind = rng.choice(menu)
         if kind == "trunc":
             malformed.append(base[: rng.randrange(1, len(base))])
         elif kind == "magic":
@@ -351,11 +398,60 @@ def fuzz_datagrams(
                 MsgType.PUT_REQUEST, MsgType.GET_FILE_REQUEST,
                 MsgType.SUBMIT_JOB_REQUEST, MsgType.DOWNLOAD_FILE,
             )), {}))
-        else:  # byz_nested
+        elif kind == "byz_nested":
             nested: Any = rng.random()
             for _ in range(40):
                 nested = {"d": nested}
             byzantine.append(forged(MsgType.JOB_STATUS_REQUEST, {"rid": nested}))
+        elif kind == "join_bad_mac":
+            # a phantom with a random MAC: dies at the HMAC check,
+            # counted bad_mac, never touches the universe
+            byzantine.append(join_frame(
+                {"host": "6.6.6.6", "port": 666, "name": "EVIL",
+                 "rank": 99},
+                f"fz{rng.getrandbits(48):012x}", universe_epoch,
+                None, "6.6.6.6:666",
+            ))
+        elif kind == "join_garbled":
+            byzantine.append(forged(MsgType.JOIN_REQUEST, rng.choice((
+                {},
+                {"node": "not-a-dict", "nonce": 7, "epoch": "x",
+                 "mac": None},
+                {"node": {"host": 1, "port": "y"}, "nonce": "n",
+                 "epoch": 0, "mac": "m"},
+                {"node": {"host": "6.6.6.6", "port": 666},
+                 "nonce": "", "epoch": 0, "mac": "m"},
+            ))))
+        elif kind == "join_stale":
+            # valid MAC over an OLD epoch (a captured pre-churn join
+            # replayed after the universe moved): with the secret it
+            # reaches — and dies at — the stale_epoch check
+            node = {"host": "6.6.6.7", "port": 667, "name": "STALE",
+                    "rank": 0}
+            nonce = f"fz{rng.getrandbits(48):012x}"
+            stale = universe_epoch - 1
+            mac = (_join_mac(join_secret, node, nonce, stale)
+                   if join_secret else None)
+            byzantine.append(join_frame(node, nonce, stale, mac,
+                                        "6.6.6.7:667"))
+        else:  # join_replay
+            # the same fully-valid frame twice: the node is an
+            # EXISTING member (so the first delivery is an idempotent
+            # rejoin, no phantom) and the second dies at the nonce
+            # replay window
+            target = rng.choice(senders) if senders else "6.6.6.8:668"
+            host, _, port = target.rpartition(":")
+            node = {"host": host, "port": int(port), "name": "",
+                    "rank": 0}
+            nonce = f"fz{rng.getrandbits(48):012x}"
+            # a valid MAC only when the target IS a real member —
+            # otherwise this would be a legitimate admission (secret
+            # possession = authorization), not a forgery
+            mac = (_join_mac(join_secret, node, nonce, universe_epoch)
+                   if join_secret and senders else None)
+            frame = join_frame(node, nonce, universe_epoch, mac, target)
+            byzantine.append(frame)
+            byzantine.append(frame)
     return malformed, byzantine
 
 
@@ -453,6 +549,14 @@ def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
       transport; every malformed frame dies in Message.unpack
       (counted by transport_malformed_dropped_total), no coroutine
       dies, and the cluster keeps serving.
+    - ``elastic``: capacity change under load — a brand-new node
+      joins mid-job through the authenticated JOIN_REQUEST path and
+      takes pool slots, a join FLAPS (scale-out immediately followed
+      by a graceful scale-in), a forged-join storm (bad HMAC /
+      garbled / stale epoch / replayed nonce) moves the typed
+      rejection counters without admitting a phantom, and a genesis
+      worker leaves gracefully — retired from the table immediately,
+      never read as an outage.
 
     Timings are seed-jittered: one seed reproduces one schedule,
     different seeds explore different interleavings.
@@ -471,6 +575,28 @@ def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
         event(j(0.1, 0.3), "put", name=seed_file, size=1024),
         event(j(0.4, 0.6), "job", n=16),
     ]
+    if family == "elastic":
+        events += [
+            event(j(0.9, 1.1), "job", n=20),
+            # capacity joins MID-LOAD (the job above is in flight)
+            event(j(1.2, 1.4), "scale_out", n=1),
+            event(j(2.0, 2.3), "job", n=16),
+            # join flapping: out, then immediately gone again —
+            # gracefully, so it must never read as a failure
+            event(j(2.6, 2.8), "scale_out", n=1),
+            event(j(3.4, 3.6), "scale_in", "joiner"),
+            # forged-join storm: every frame rejected + counted
+            event(j(4.0, 4.2), "join_storm", n=24),
+            event(j(4.5, 4.9), "job", n=12),
+            # graceful scale-in of a GENESIS worker: retired from the
+            # table immediately, replicas re-replicated
+            event(j(5.3, 5.5), "scale_in", "worker"),
+            event(j(6.0, 6.4), "job", n=12),
+        ]
+        return ChaosPlan(seed=seed, events=tuple(events),
+                         n_nodes=n_nodes, settle_s=1.5,
+                         name=f"elastic-{seed}",
+                         join_secret=f"chaos-elastic-{seed}")
     if family == "asym":
         events += [
             event(j(1.0, 1.3), "partition_asym",
@@ -728,6 +854,7 @@ class LocalCluster:
         ingress_classes: Optional[Dict[str, Any]] = None,
         services: str = "full",
         gossip_protocol: Optional[str] = None,
+        join_secret: str = "",
     ):
         """`worker_groups` (config.WorkerGroupSpec list) pools nodes
         into tensor-parallel serving groups (jobs/groups.py); the
@@ -753,7 +880,12 @@ class LocalCluster:
         control-plane scale probe: one UDP socket + two coroutines
         per node). `gossip_protocol` overrides the spec's piggyback
         protocol ("delta" product default | "full" reference
-        baseline) — the scale bench scores one against the other."""
+        baseline) — the scale bench scores one against the other.
+
+        `join_secret` (non-empty) turns the elastic join policy ON:
+        every node joins through the authenticated JOIN_REQUEST path,
+        `scale_out` can admit brand-new nodes mid-run, and `scale_in`
+        retires them (or genesis workers) through graceful LEAVE."""
         if services not in ("full", "store", "core"):
             raise ValueError(f"unknown services mode {services!r}")
         self.root = root
@@ -763,6 +895,8 @@ class LocalCluster:
         spec_kw: Dict[str, Any] = {}
         if gossip_protocol is not None:
             spec_kw["gossip_protocol"] = gossip_protocol
+        if join_secret:
+            spec_kw["join_secret"] = join_secret
         self.spec = ClusterSpec.localhost(
             n_nodes,
             base_port=base_port,
@@ -775,6 +909,14 @@ class LocalCluster:
             worker_groups=list(worker_groups or []),
             **spec_kw,
         )
+        #: elastic bookkeeping: genesis identities (fixed at
+        #: construction — the invariant sweep's phantom check needs
+        #: the pre-churn truth), every identity LEGITIMATELY admitted
+        #: via scale_out, and the live runtime joiners in join order
+        self.genesis_unames = {n.unique_name for n in self.spec.nodes}
+        self.joined_ever: List[str] = []
+        self.joined_live: List[str] = []
+        self._join_port = base_port + n_nodes + 100
         self._make_jobs = make_jobs or self._default_jobs
         self.with_ingress = with_ingress
         self.ingress_formation = ingress_formation
@@ -813,9 +955,13 @@ class LocalCluster:
             members = node.spec.group_members_unique(g.name)
             if members and uname == members[0]:
                 # group primary: stub group engine — capacity-scaled
-                # latency, degrades when a member dies mid-batch
+                # latency, degrades when a member dies mid-batch.
+                # Membership re-reads the spec per batch so elastic
+                # joins/leaves re-shape the group under the engine.
                 gb = stub_group_backend(
-                    g.name, members,
+                    g.name,
+                    lambda gname=g.name: node.spec.group_members_unique(
+                        gname),
                     lambda: {
                         n.unique_name
                         for n in node.membership.alive_nodes()
@@ -850,9 +996,15 @@ class LocalCluster:
         for nid in self.spec.nodes:
             await self.start_node(nid)
 
-    async def start_node(self, nid: NodeId) -> SimNode:
-        node = Node(self.spec, nid,
-                    seed=_child_seed(self.seed, f"node/{nid.unique_name}"))
+    async def start_node(
+        self,
+        nid: NodeId,
+        spec: Optional[ClusterSpec] = None,
+        join_group: Optional[str] = None,
+    ) -> SimNode:
+        node = Node(spec or self.spec, nid,
+                    seed=_child_seed(self.seed, f"node/{nid.unique_name}"),
+                    join_group=join_group)
         store = jobs = ingress = None
         if self.services != "core":
             store = StoreService(
@@ -897,6 +1049,7 @@ class LocalCluster:
         disk (a crash does not wipe a disk), so a restart with the
         same identity reports its old inventory."""
         sn = self.nodes.pop(uname)
+        self.joined_live = [u for u in self.joined_live if u != uname]
         if sn.ingress is not None:
             await sn.ingress.stop()
         if sn.jobs is not None:
@@ -922,6 +1075,62 @@ class LocalCluster:
         for uname in list(self.nodes):
             await self.crash_node(uname)
         await self.dns.stop()
+
+    # ---- elastic capacity (authenticated runtime join/leave) ----
+
+    async def scale_out(
+        self,
+        name: Optional[str] = None,
+        group: Optional[str] = None,
+        wait_s: float = 15.0,
+    ) -> SimNode:
+        """Start a BRAND-NEW node (an identity outside the genesis
+        table) that joins the running cluster through the
+        authenticated JOIN_REQUEST path. The joiner gets its own
+        PRIVATE spec copy — genesis view plus itself — so admission,
+        the epoch handshake, and the JOIN_ACK universe catch-up are
+        exercised for real, not short-circuited through the sim's
+        shared spec object. Waits until the join completes."""
+        if not self.spec.join_secret:
+            raise RuntimeError("scale_out needs join_secret set")
+        self._join_port += 1
+        n = len(self.joined_ever) + 1
+        nid = NodeId("127.0.0.1", self._join_port,
+                     name=name or f"J{n}", rank=0)
+        jspec = ClusterSpec.from_json(self.spec.to_json())
+        jspec.add_node(nid, local=True)
+        sn = await self.start_node(nid, spec=jspec, join_group=group)
+        self.joined_ever.append(nid.unique_name)
+        self.joined_live.append(nid.unique_name)
+        await self.wait_for(
+            lambda: sn.node.joined, wait_s,
+            f"runtime join of {nid.unique_name}",
+        )
+        return sn
+
+    async def scale_in(self, uname: str) -> bool:
+        """Graceful departure: the node announces LEAVE (retired from
+        the universe + membership immediately — a scale-in must never
+        read as an outage), then its service stack stops. Returns
+        whether the goodbye was actually sent (False = it degraded to
+        a silent exit and SWIM will clean it up the crash way)."""
+        sn = self.nodes.pop(uname, None)
+        if sn is None:
+            raise ValueError(f"unknown/dead node {uname}")
+        self.joined_live = [u for u in self.joined_live if u != uname]
+        sent = await sn.node.leave_cluster()
+        if sent:
+            # let the goodbye land + the leader's table-change gossip
+            # start before silencing the stack
+            await asyncio.sleep(2 * self.spec.timing.ping_interval)
+        if sn.ingress is not None:
+            await sn.ingress.stop()
+        if sn.jobs is not None:
+            await sn.jobs.stop()
+        if sn.store is not None:
+            await sn.store.stop()
+        await sn.node.stop()
+        return sent
 
     # ---- fault application ----
 
@@ -1172,6 +1381,11 @@ class LocalCluster:
                 if uname not in (leader, standby):
                     return uname
             return None
+        if target == "joiner":
+            # the most recent LIVE runtime joiner (elastic scale-in /
+            # join-flap target)
+            live = [u for u in self.joined_live if u in self.nodes]
+            return live[-1] if live else None
         if target == "skewed":
             # the live node whose SWIM clock runs furthest AHEAD (the
             # mask-a-real-failure victim of the skew scenario)
@@ -1260,6 +1474,15 @@ def _malformed_dropped_total() -> float:
     )
 
 
+def _join_rejected_total() -> float:
+    """Sum across the typed rejection reasons (labeled counter)."""
+    snap = METRICS.snapshot()
+    return float(sum(
+        v for k, v in snap["counters"].items()
+        if k.startswith("membership_join_rejected_total")
+    ))
+
+
 async def invariant_sweep(
     cluster: LocalCluster,
     acked_jobs: Dict[int, Dict[str, Any]],
@@ -1267,6 +1490,8 @@ async def invariant_sweep(
     timeout: float = 25.0,
     fuzz_malformed_sent: int = 0,
     malformed_baseline: float = 0.0,
+    forged_joins_sent: int = 0,
+    join_reject_baseline: float = 0.0,
 ) -> InvariantReport:
     """The machine-checked end state every plan run must reach."""
     failures: List[str] = []
@@ -1445,6 +1670,38 @@ async def invariant_sweep(
                 "but transport_malformed_dropped_total never moved"
             )
 
+    # 7. elastic universe integrity: every node in every live node's
+    # table is either genesis or a LEGITIMATELY admitted joiner (no
+    # phantom survived the forged-join pressure), and when the plan
+    # blasted forged joins, the typed rejection counters moved
+    if cluster.spec.join_secret:
+        legit = cluster.genesis_unames | set(cluster.joined_ever)
+        phantoms = sorted({
+            n.unique_name
+            for sn in cluster.nodes.values()
+            for n in sn.node.spec.nodes
+            if n.unique_name not in legit
+        })
+        checks["universe"] = {
+            "epochs": {u: sn.node.spec.universe_epoch
+                       for u, sn in sorted(cluster.nodes.items())},
+            "joined_ever": list(cluster.joined_ever),
+        }
+        if phantoms:
+            failures.append(
+                f"phantom node(s) entered the universe: {phantoms}"
+            )
+        if forged_joins_sent:
+            delta = _join_rejected_total() - join_reject_baseline
+            checks["forged_joins"] = {
+                "sent": forged_joins_sent, "rejected": int(delta)}
+            if delta <= 0:
+                failures.append(
+                    f"join storm sent {forged_joins_sent} forged "
+                    "JOIN_REQUESTs but membership_join_rejected_total "
+                    "never moved"
+                )
+
     return InvariantReport(ok=not failures, failures=failures, checks=checks)
 
 
@@ -1502,6 +1759,8 @@ class ChaosRunner:
         self._fuzz_counter = 0
         self.fuzz_malformed_sent = 0
         self._malformed_baseline = _malformed_dropped_total()
+        self.forged_joins_sent = 0
+        self._join_reject_baseline = _join_rejected_total()
 
     # ---- workload ----
 
@@ -1614,6 +1873,53 @@ class ChaosRunner:
         # sweep's "the drop counter must have moved" obligation
         self.fuzz_malformed_sent += sent["malformed"]
         return sent
+
+    def _do_join_storm(self, n: int) -> Dict[str, int]:
+        """Blast forged JOIN_REQUESTs (bad HMAC / garbled / stale
+        epoch / replayed nonce) at every live node. Crafted at the
+        CURRENT universe epoch with the cluster's own secret, so the
+        stale/replay forgeries carry VALID MACs and reach — and die
+        at — their dedicated checks instead of all collapsing into
+        bad_mac. The sweep asserts the rejection counters moved and
+        no phantom entered any table."""
+        self._fuzz_counter += 1
+        c = self.cluster
+        senders = tuple(sorted(c.nodes))
+        _, frames = fuzz_datagrams(
+            _child_seed(self.plan.seed,
+                        f"join_storm/{self._fuzz_counter}"),
+            n, senders,
+            join_secret=c.spec.join_secret,
+            universe_epoch=c.spec.universe_epoch,
+            kinds=("join_bad_mac", "join_garbled", "join_stale",
+                   "join_replay"),
+        )
+        # aim at the LEADER (the only node that admits): every forged
+        # frame reaches the admission check. Non-leaders get a share
+        # too — they must ignore JOIN_REQUESTs silently, not crash.
+        targets = []
+        leader = c.leader_uname()
+        for uname in sorted(c.nodes):
+            nid = c.spec.node_by_unique_name(uname)
+            if nid is not None:
+                targets.append((nid.host, nid.port))
+                if uname == leader:
+                    targets.extend([(nid.host, nid.port)] * 3)
+        if not targets:
+            return {"forged_joins": 0}
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sent = 0
+        try:
+            for i, frame in enumerate(frames):
+                try:
+                    sock.sendto(frame, targets[i % len(targets)])
+                    sent += 1
+                except OSError:
+                    continue
+        finally:
+            sock.close()
+        self.forged_joins_sent += sent
+        return {"forged_joins": sent}
 
     async def _do_job(self, n: int) -> None:
         """Submit + await one stub job, tracking its terminal state.
@@ -1801,6 +2107,23 @@ class ChaosRunner:
             )
         elif ev.kind == "job":
             self._spawn_workload(self._do_job(int(ev.arg("n", 16))), "job")
+        elif ev.kind == "scale_out":
+            names = []
+            for _ in range(int(ev.arg("n", 1))):
+                sn = await c.scale_out(group=ev.arg("group"))
+                names.append(sn.node.me.unique_name)
+            record["resolved"] = names
+        elif ev.kind == "scale_in":
+            uname = c.resolve_target(ev.target or "joiner")
+            if uname is None or uname not in c.nodes:
+                record["skipped"] = "no live target"
+            else:
+                record["resolved"] = uname
+                record["graceful"] = await c.scale_in(uname)
+                self._measure("repair", c.replication_satisfied,
+                              self.store_repair_s, _M_REPAIR)
+        elif ev.kind == "join_storm":
+            record["injected"] = self._do_join_storm(int(ev.arg("n", 24)))
         self.executed.append(record)
 
     async def run(self) -> ChaosReport:
@@ -1856,6 +2179,8 @@ class ChaosRunner:
             self.cluster, self.jobs, self.seed_files,
             fuzz_malformed_sent=self.fuzz_malformed_sent,
             malformed_baseline=self._malformed_baseline,
+            forged_joins_sent=self.forged_joins_sent,
+            join_reject_baseline=self._join_reject_baseline,
         )
         # an event that ERRORED (failed restart, crash that threw)
         # means the plan did not actually run as scheduled — the
@@ -1900,7 +2225,7 @@ async def run_plan(
     os.makedirs(root, exist_ok=True)
     cluster = LocalCluster(
         plan.n_nodes, root, base_port, seed=plan.seed, timing=timing,
-        services=services,
+        services=services, join_secret=plan.join_secret,
     )
     try:
         await cluster.start()

@@ -89,6 +89,16 @@ class IntroducerService:
                     addr,
                 )
             elif msg.type == MsgType.UPDATE_INTRODUCER:
+                # elastic membership: the leader's periodic re-assert
+                # piggybacks the universe change log, so the DNS keeps
+                # learning runtime-joined nodes (each entry verifies
+                # its own HMAC stamp inside apply_universe — a forged
+                # update can't teach the DNS a phantom). Without this,
+                # a joined node promoted to leader could never pass
+                # the node-table validation below.
+                uni = msg.data.get("uni")
+                if isinstance(uni, dict) and self.spec.join_secret:
+                    self.spec.apply_universe(uni)
                 new = msg.data.get("introducer", "")
                 if new and self.spec.node_by_unique_name(new) is not None:
                     self.current_introducer = new

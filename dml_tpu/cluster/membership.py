@@ -337,6 +337,49 @@ class MembershipList:
         self._fresh.pop(unique_name, None)
         self.recompute_ping_targets()
 
+    def retire(self, unique_name: str) -> bool:
+        """Graceful departure (elastic LEAVE): drop the member NOW —
+        no suspicion window, no cleanup delay, no failure counters —
+        and tombstone it so a lagging peer's stale ALIVE gossip can't
+        resurrect the entry. A planned scale-in must never read as an
+        outage: the SWIM failure path (suspect -> cleanup ->
+        _M_FAILED) is for nodes that DIDN'T say goodbye. Returns True
+        when the member was present."""
+        ent = self._members.pop(unique_name, None)
+        self._suspect_since.pop(unique_name, None)
+        self._fresh.pop(unique_name, None)
+        self._tombstones[unique_name] = (
+            max(ent[0], self._now()) if ent is not None else self._now()
+        )
+        if ent is None:
+            return False
+        self.recompute_ping_targets()
+        if self.hooks.on_topology_change:
+            self.hooks.on_topology_change()
+        return True
+
+    def prune_unknown(self) -> List[str]:
+        """Drop members the spec no longer knows (they LEFT the
+        universe): without this an entry for a retired node lingers
+        ALIVE in the table forever — it is never pinged (ring comes
+        from the spec) so it can never be suspected, but it skews the
+        alive gauge and keeps riding our gossip. Not a failure:
+        no hooks, no counters."""
+        gone = [
+            u for u in self._members
+            if u != self.me.unique_name
+            and self.spec.node_by_unique_name(u) is None
+        ]
+        for u in gone:
+            ent = self._members.pop(u, None)
+            self._suspect_since.pop(u, None)
+            self._fresh.pop(u, None)
+            if ent is not None:
+                self._tombstones[u] = max(ent[0], self._now())
+        if gone:
+            self.recompute_ping_targets()
+        return gone
+
     def reset(self) -> None:
         """Leave the cluster: forget everyone but self."""
         self._members = {self.me.unique_name: (self._now(), ALIVE)}

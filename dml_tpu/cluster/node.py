@@ -23,14 +23,16 @@ Key design fixes over the reference (SURVEY §7 quirks):
 from __future__ import annotations
 
 import asyncio
+import hmac as _hmac
 import itertools
 import logging
 import math
+import os
 import random
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
-from ..config import ClusterSpec, NodeId
+from ..config import ClusterSpec, NodeId, join_mac, leave_mac, reply_mac
 from ..observability import METRICS
 from .election import Election
 from .membership import MembershipHooks, MembershipList
@@ -53,16 +55,50 @@ _M_RELAY_FALLBACK = METRICS.counter(
     "metrics_relay_fallback_total",
     "relay shards that failed and fell back to direct leader pulls")
 
+# elastic membership (authenticated runtime join/leave): admissions,
+# typed rejections, graceful departures, and the universe version in
+# force — the byzantine-join chaos scenario asserts the rejection
+# counters move while no phantom enters the table
+_M_JOIN_ADMIT = METRICS.counter(
+    "membership_join_admitted_total",
+    "authenticated runtime joins admitted, by kind (new|rejoin)")
+_M_JOIN_REJECT = METRICS.counter(
+    "membership_join_rejected_total",
+    "JOIN_REQUESTs rejected, by reason "
+    "(disabled|garbled|bad_mac|stale_epoch|replay)")
+_M_LEAVES = METRICS.counter(
+    "membership_leaves_total",
+    "graceful LEAVE departures retired by the leader")
+_M_LEAVE_REJECT = METRICS.counter(
+    "membership_leave_rejected_total",
+    "LEAVE announcements rejected, by reason "
+    "(disabled|garbled|bad_mac|stale_epoch|replay)")
+_M_UEPOCH = METRICS.gauge(
+    "membership_universe_epoch",
+    "version of the dynamic node universe this process holds")
+
+#: bound on the leader's seen-nonce replay window (join + leave MACs)
+_NONCE_CAP = 4096
+
 Handler = Callable[[Message, Tuple[str, int]], Awaitable[None]]
 
 
 class Node:
     """One cluster node: transport + membership + election + services."""
 
-    def __init__(self, spec: ClusterSpec, me: NodeId, seed: int = 0):
+    def __init__(
+        self,
+        spec: ClusterSpec,
+        me: NodeId,
+        seed: int = 0,
+        join_group: Optional[str] = None,
+    ):
         self.spec = spec
         self.me = me
         self.seed = seed
+        #: worker group this node asks to be absorbed into when it
+        #: joins at runtime (rides JOIN_REQUEST; None = plain slot)
+        self.join_group = join_group
         self.transport: Optional[UdpTransport] = None
         self.membership = MembershipList(
             spec,
@@ -91,6 +127,15 @@ class Node:
         self._stopped = asyncio.Event()
         self._left = False
         self._probe_idx = 0  # anti-entropy probe round-robin cursor
+        # elastic membership state: last universe epoch each peer
+        # advertised (drives the per-target gossip catch-up), the
+        # bounded seen-nonce replay window (leader side), and the
+        # authenticated epoch hint a stale-epoch JOIN rejection taught
+        # us to claim next try
+        self._peer_uepoch: Dict[str, int] = {}
+        self._seen_nonces: Dict[str, None] = {}
+        self._join_epoch_hint = 0
+        self._last_uepoch = spec.universe_epoch
         # seeded chooser for the delta-mode random gossip target (one
         # extra ping per tick at scale; see _random_gossip_target)
         self._gossip_rng = random.Random(
@@ -262,6 +307,12 @@ class Node:
                 elif not self.joined:
                     await self._try_join()
                 else:
+                    if self.spec.universe_epoch != self._last_uepoch:
+                        # the spec changed under us without a wire
+                        # event on THIS node (in-process sims share
+                        # one spec object; production paths go
+                        # through _adopt_universe): re-derive
+                        self._universe_changed()
                     self.membership.heartbeat_self()
                     self.membership.cleanup()
                     if self.election.in_progress:
@@ -316,13 +367,54 @@ class Node:
         want = min(len(candidates), 2 if len(candidates) > 64 else 1)
         return self._gossip_rng.sample(candidates, want)
 
+    def _universe_piggyback(
+        self, data: Dict[str, Any], peer_epoch: Optional[int]
+    ) -> Dict[str, Any]:
+        """Attach the elastic-universe fields to a gossip payload:
+        our epoch (`ue`, so the peer knows whether to catch US up) and
+        — when we know the peer is behind — a contiguous WINDOW of
+        HMAC-stamped change entries past its epoch (`uni`); a peer
+        far behind converges window by window over successive
+        exchanges. Only log entries ride gossip; the `full` table
+        form (needed only past the retained UNIVERSE_LOG_CAP) rides
+        the authenticated JOIN_ACK path alone. No-ops (and keeps the
+        wire byte-identical) when the join policy is off."""
+        if not self.spec.join_secret:
+            return data
+        data["ue"] = self.spec.universe_epoch
+        if peer_epoch is not None and peer_epoch < self.spec.universe_epoch:
+            uni = self.spec.universe_delta(peer_epoch, max_entries=16)
+            if "full" not in uni:
+                data["uni"] = uni
+        return data
+
+    def _note_universe(self, msg: Message) -> None:
+        """Fold a gossip datagram's universe fields into our state:
+        remember the peer's epoch, apply any change entries (each
+        verifies its own HMAC stamp — a forged sender can ship them
+        but cannot mint them). Out-of-universe senders are ignored
+        wholesale: the unauthenticated drop posture stays intact."""
+        if not self.spec.join_secret:
+            return
+        if self.spec.node_by_unique_name(msg.sender) is None:
+            return
+        ue = msg.data.get("ue")
+        if isinstance(ue, int) and ue >= 0:
+            self._peer_uepoch[msg.sender] = ue
+        uni = msg.data.get("uni")
+        if isinstance(uni, dict):
+            self._adopt_universe(uni)
+
     async def _ping_one(self, target: NodeId, gossip: Dict[str, Any]) -> None:
         """One ping + ACK wait (reference check/_wait,
         worker.py:1083-1159). >N consecutive misses => suspect."""
         uname = target.unique_name
         ev = asyncio.Event()
         self._ack_waiters[uname] = ev
-        self.send(target, MsgType.PING, {"members": gossip, "leader": self.membership.leader})
+        self.send(target, MsgType.PING, self._universe_piggyback(
+            {"members": gossip, "leader": self.membership.leader},
+            self._peer_uepoch.get(uname),
+        ))
         try:
             await asyncio.wait_for(ev.wait(), self.spec.timing.ack_timeout)
             self._missed_acks[uname] = 0
@@ -364,10 +456,13 @@ class Node:
             return
         target = candidates[self._probe_idx % len(candidates)]
         self._probe_idx += 1
-        self.send(target, MsgType.PING, {
-            "members": self.membership.snapshot(),
-            "leader": self.membership.leader,
-        })
+        self.send(target, MsgType.PING, self._universe_piggyback(
+            {
+                "members": self.membership.snapshot(),
+                "leader": self.membership.leader,
+            },
+            self._peer_uepoch.get(target.unique_name),
+        ))
 
     def _check_leader_conflict(self, their_leader: Optional[str]) -> None:
         """Two sides of a healed partition each elected a leader; the
@@ -423,6 +518,17 @@ class Node:
             self._become_leader()
             return
         target = self.spec.node_by_unique_name(introducer)
+        if self.spec.join_secret:
+            # join policy on: EVERY join is the authenticated
+            # handshake — for a node the leader already knows it is a
+            # mark-alive rejoin (no epoch bump), for a new node it is
+            # admission into a bumped universe. The leader may itself
+            # be a runtime joiner we haven't learned yet, so resolve
+            # its address from the unique name when the table can't.
+            await self._join_authenticated(
+                introducer, target or self._nid_from_unique(introducer)
+            )
+            return
         if target is None:
             return
         try:
@@ -440,6 +546,120 @@ class Node:
         # report local files so the leader's global table includes us
         # (reference ALL_LOCAL_FILES, worker.py:592-593)
         self.send(target, MsgType.ALL_LOCAL_FILES, {"files": self.local_inventory()})
+
+    @staticmethod
+    def _nid_from_unique(uname: str) -> Optional[NodeId]:
+        """A dialable NodeId from a bare ``host:port`` unique name —
+        the elastic-membership escape hatch for addressing a leader
+        that joined after our table was written."""
+        host, _, port = str(uname).rpartition(":")
+        try:
+            p = int(port)
+        except (TypeError, ValueError):
+            return None
+        if not host or not (0 < p < 65536):
+            return None
+        return NodeId(host, p)
+
+    async def _join_authenticated(
+        self, introducer: str, target: Optional[NodeId]
+    ) -> None:
+        """The JOIN_REQUEST handshake (one attempt; the failure-
+        detection loop retries each tick). The request carries our
+        identity + a fresh nonce + the universe epoch we believe
+        current, HMAC-bound to the shared cluster secret; the reply is
+        MAC-verified before ANY field of it is trusted. A stale_epoch
+        rejection teaches us the cluster's epoch (authenticated), so
+        the next tick's attempt claims it — replayed captures can't
+        follow, which is the point of binding the epoch."""
+        if target is None:
+            return
+        secret = self.spec.join_secret
+        epoch = max(self.spec.universe_epoch, self._join_epoch_hint)
+        nonce = os.urandom(8).hex()
+        node_d = {"host": self.me.host, "port": self.me.port,
+                  "name": self.me.name, "rank": self.me.rank}
+        data: Dict[str, Any] = {
+            "node": node_d, "nonce": nonce, "epoch": epoch,
+            "have": self.spec.universe_epoch,
+            "mac": join_mac(secret, node_d, nonce, epoch,
+                            group=self.join_group or ""),
+        }
+        if self.join_group:
+            data["group"] = self.join_group
+        try:
+            ack = await self.request(
+                target, MsgType.JOIN_REQUEST, data,
+                timeout=self.spec.timing.ack_timeout,
+            )
+        except asyncio.TimeoutError:
+            log.debug("%s: leader %s not answering JOIN_REQUEST",
+                      self.me, introducer)
+            return
+        uni = ack.get("universe")
+        try:
+            ack_epoch = int(ack.get("epoch", -1))
+        except (TypeError, ValueError):
+            return
+        mac = ack.get("mac")
+        want = reply_mac(secret, nonce, ack_epoch,
+                         uni if isinstance(uni, dict) else {})
+        if not isinstance(mac, str) or not _hmac.compare_digest(mac, want):
+            log.warning(
+                "%s: JOIN_ACK failed authentication; ignoring", self.me
+            )
+            return
+        if not ack.get("ok"):
+            reason = ack.get("reason")
+            if reason == "stale_epoch" and ack_epoch >= 0:
+                self._join_epoch_hint = ack_epoch
+                log.info(
+                    "%s: join told stale_epoch; retrying at epoch %d",
+                    self.me, ack_epoch,
+                )
+            else:
+                log.warning("%s: join rejected (%r)", self.me, reason)
+            return
+        if isinstance(uni, dict):
+            self._adopt_universe(uni, verified=True)
+        self.membership.merge(ack.get("members", {}))
+        self.membership.mark_alive(introducer)
+        self._set_leader(ack.get("leader") or introducer)
+        self.joined = True
+        self._join_epoch_hint = 0
+        log.info("%s joined (authenticated); leader=%s epoch=%d",
+                 self.me, self.membership.leader, self.spec.universe_epoch)
+        self.send(target, MsgType.ALL_LOCAL_FILES,
+                  {"files": self.local_inventory()})
+
+    def _adopt_universe(self, delta: Any, verified: bool = False) -> bool:
+        """Apply a universe catch-up and re-derive everything keyed on
+        the node table. A `leave` entry retires the member from SWIM
+        immediately (graceful scale-in must not ride the suspicion
+        path as a false failure) and fires the node-failed service
+        hooks so in-flight work requeues — minus the failure counters."""
+        before = {n.unique_name for n in self.spec.nodes}
+        if not self.spec.apply_universe(delta, verified=verified):
+            return False
+        for gone in sorted(before - {n.unique_name for n in self.spec.nodes}):
+            self.membership.retire(gone)
+            self._missed_acks.pop(gone, None)
+            for cb in self.on_node_failed_cbs:
+                cb(gone)
+        self._universe_changed()
+        return True
+
+    def _universe_changed(self) -> None:
+        """The node table changed under us: ring/ping targets and the
+        epoch gauge re-derive, membership entries for departed nodes
+        retire (not fail), and bookkeeping for departed peers drops."""
+        self._last_uepoch = self.spec.universe_epoch
+        _M_UEPOCH.set(self.spec.universe_epoch)
+        self.membership.prune_unknown()
+        self.membership.recompute_ping_targets()
+        for u in list(self._peer_uepoch):
+            if self.spec.node_by_unique_name(u) is None:
+                self._peer_uepoch.pop(u, None)
 
     def _become_leader(self) -> None:
         self.joined = True
@@ -524,10 +744,22 @@ class Node:
         attempt = 0
         while self.is_leader:
             try:
+                update: Dict[str, Any] = {
+                    "introducer": self.me.unique_name}
+                if self.spec.join_secret and self.spec.universe_epoch:
+                    # the DNS validates UPDATE_INTRODUCER senders
+                    # against ITS node table, and it restarts with
+                    # state loss — so the introducer must keep
+                    # learning runtime-joined nodes, or a joined node
+                    # promoted to leader could never re-register.
+                    # Entries self-verify their HMAC stamps there.
+                    uni = self.spec.universe_delta(0)
+                    if "full" not in uni:
+                        update["uni"] = uni
                 await self.request(
                     self.spec.introducer,
                     MsgType.UPDATE_INTRODUCER,
-                    {"introducer": self.me.unique_name},
+                    update,
                     timeout=self.spec.timing.ack_timeout,
                 )
                 attempt = 0
@@ -559,6 +791,8 @@ class Node:
         self.register(MsgType.METRICS_PULL, self._h_metrics_pull)
         self.register(MsgType.METRICS_RELAY_PULL, self._h_metrics_relay)
         self.register(MsgType.TRACE_PULL, self._h_trace_pull)
+        self.register(MsgType.JOIN_REQUEST, self._h_join_request)
+        self.register(MsgType.LEAVE, self._h_leave)
 
     def _spawn_bg(self, coro: Awaitable, name: str) -> asyncio.Task:
         """Background task spawned from a handler: held (never naked),
@@ -1207,11 +1441,206 @@ class Node:
             "degraded": dict(sorted(degraded.items())),
         }
 
+    # ------------------------------------------------------------------
+    # elastic membership: authenticated runtime join/leave
+    # ------------------------------------------------------------------
+
+    def _send_addr(self, addr: Tuple[str, int], mtype: MsgType,
+                   data: Dict[str, Any]) -> None:
+        """Reply straight to a socket address — the one path allowed
+        to answer a sender the node table doesn't (yet) resolve,
+        which is exactly a joiner mid-handshake."""
+        assert self.transport is not None, "node not started"
+        self.transport.send(Message(self.me.unique_name, mtype, data), addr)
+
+    def _nonce_replayed(self, nonce: str) -> bool:
+        """Record-and-test against the bounded seen-nonce window."""
+        if nonce in self._seen_nonces:
+            return True
+        self._seen_nonces[nonce] = None
+        if len(self._seen_nonces) > _NONCE_CAP:
+            self._seen_nonces.pop(next(iter(self._seen_nonces)))
+        return False
+
+    async def _h_join_request(self, msg: Message, addr) -> None:
+        """Leader-side admission of an authenticated runtime join.
+        Every rejection is TYPED and counted
+        (membership_join_rejected_total) — forged, replayed, stale-
+        epoch, and garbled requests must be observable, not silent —
+        and only a request whose HMAC binds (identity, addr, nonce,
+        epoch) to the shared secret can touch the universe. A
+        stale_epoch rejection echoes the current epoch under the
+        reply MAC so a live joiner can re-claim it next tick while a
+        replayed capture cannot."""
+        if not self.is_leader:
+            return  # the joiner re-resolves the leader via DNS and retries
+        d = msg.data
+        rid = d.get("rid")
+        secret = self.spec.join_secret
+        nonce = d.get("nonce") if isinstance(d.get("nonce"), str) else ""
+
+        def reject(reason: str, epoch_hint: Optional[int] = None) -> None:
+            _M_JOIN_REJECT.inc(reason=reason)
+            log.warning("%s: JOIN_REQUEST from %s rejected (%s)",
+                        self.me, msg.sender, reason)
+            reply: Dict[str, Any] = {"rid": rid, "ok": False,
+                                     "reason": reason}
+            if epoch_hint is not None:
+                reply["epoch"] = epoch_hint
+            if secret and nonce:
+                reply["mac"] = reply_mac(
+                    secret, nonce, int(reply.get("epoch", -1)), {})
+            self._send_addr(addr, MsgType.JOIN_ACK, reply)
+
+        if not secret:
+            reject("disabled")
+            return
+        nid = ClusterSpec.node_from_dict(d.get("node"))
+        try:
+            epoch = int(d.get("epoch"))
+        except (TypeError, ValueError):
+            epoch = None
+        if nid is None or not nonce or epoch is None:
+            reject("garbled")
+            return
+        group = d.get("group") if isinstance(d.get("group"), str) else None
+        mac = d.get("mac")
+        # the MAC covers the requested group too: an on-path rewrite
+        # of a topology-changing field must invalidate the request,
+        # not re-shape an attacker-chosen mesh
+        want = join_mac(secret, d.get("node"), nonce, epoch,
+                        group=group or "")
+        if not isinstance(mac, str) or not _hmac.compare_digest(mac, want):
+            reject("bad_mac")
+            return
+        if epoch != self.spec.universe_epoch:
+            reject("stale_epoch", epoch_hint=self.spec.universe_epoch)
+            return
+        if self._nonce_replayed(nonce):
+            reject("replay")
+            return
+        try:
+            have = max(0, int(d.get("have", epoch)))
+        except (TypeError, ValueError):
+            have = epoch
+        try:
+            added = self.spec.add_node(nid, group=group)
+        except ValueError:
+            # unknown group: admit as a plain pool slot rather than
+            # bouncing capacity over a topology typo
+            log.warning("%s: join group %r unknown; admitting %s "
+                        "as an ungrouped slot", self.me, group, nid)
+            added = self.spec.add_node(nid)
+        _M_JOIN_ADMIT.inc(kind="new" if added else "rejoin")
+        if added:
+            log.info(
+                "%s: admitted %s into the universe (epoch %d%s)",
+                self.me, nid.unique_name, self.spec.universe_epoch,
+                f", group {group}" if group else "",
+            )
+            self._universe_changed()
+        self.membership.mark_alive(nid.unique_name)
+        self._peer_uepoch[nid.unique_name] = self.spec.universe_epoch
+        uni = self.spec.universe_delta(min(have, self.spec.universe_epoch))
+        self._send_addr(addr, MsgType.JOIN_ACK, {
+            "rid": rid, "ok": True, "leader": self.me.unique_name,
+            "members": self.membership.snapshot(),
+            "epoch": self.spec.universe_epoch,
+            "universe": uni,
+            "mac": reply_mac(secret, nonce, self.spec.universe_epoch, uni),
+        })
+
+    async def _h_leave(self, msg: Message, addr) -> None:
+        """Leader-side graceful departure: an authenticated LEAVE
+        retires the sender from the universe AND the membership table
+        immediately — no suspicion window, no cleanup delay, no
+        failure counters — then fires the node-failed/replication
+        hooks so in-flight batches requeue and the departed replicas
+        re-replicate. The MAC binds the SENDER's identity, so a
+        spoofed goodbye can't evict someone else."""
+        secret = self.spec.join_secret
+        if not self.is_leader:
+            return
+        if not secret:
+            _M_LEAVE_REJECT.inc(reason="disabled")
+            return
+        d = msg.data
+        nonce = d.get("nonce")
+        mac = d.get("mac")
+        try:
+            epoch = int(d.get("epoch"))
+        except (TypeError, ValueError):
+            _M_LEAVE_REJECT.inc(reason="garbled")
+            return
+        if not isinstance(nonce, str) or not nonce \
+                or not isinstance(mac, str):
+            _M_LEAVE_REJECT.inc(reason="garbled")
+            return
+        want = leave_mac(secret, msg.sender, nonce, epoch)
+        if not _hmac.compare_digest(mac, want):
+            _M_LEAVE_REJECT.inc(reason="bad_mac")
+            log.warning("%s: forged LEAVE for %s dropped (bad mac)",
+                        self.me, msg.sender)
+            return
+        if epoch != self.spec.universe_epoch:
+            # the goodbye was minted against an old table; the node
+            # still goes away via ordinary failure detection
+            _M_LEAVE_REJECT.inc(reason="stale_epoch")
+            return
+        if self._nonce_replayed(nonce):
+            _M_LEAVE_REJECT.inc(reason="replay")
+            return
+        if msg.sender == self.me.unique_name:
+            return  # the leader retiring itself is the election's job
+        if not self.spec.remove_node(msg.sender):
+            return  # duplicate goodbye for an already-retired node
+        _M_LEAVES.inc()
+        log.info("%s: %s left gracefully (universe epoch %d)",
+                 self.me, msg.sender, self.spec.universe_epoch)
+        self.membership.retire(msg.sender)
+        self._missed_acks.pop(msg.sender, None)
+        for cb in self.on_node_failed_cbs:
+            cb(msg.sender)
+        for cb in self.on_replication_needed_cbs:
+            cb([msg.sender])
+        self._universe_changed()
+
+    async def leave_cluster(self) -> bool:
+        """Graceful scale-in: announce LEAVE to the leader (MAC over
+        our own identity + nonce + epoch), then go silent. Returns
+        True when the goodbye was actually sent — a leaderless window
+        or a disabled join policy degrades to the plain `leave()`,
+        where SWIM suspicion retires us the crash way."""
+        sent = False
+        leader = self.leader_node
+        if (
+            self.spec.join_secret
+            and self.joined
+            and not self.is_leader
+            and leader is not None
+        ):
+            nonce = os.urandom(8).hex()
+            epoch = self.spec.universe_epoch
+            self.send(leader, MsgType.LEAVE, {
+                "nonce": nonce, "epoch": epoch,
+                "mac": leave_mac(self.spec.join_secret,
+                                 self.me.unique_name, nonce, epoch),
+            })
+            sent = True
+        elif self.is_leader:
+            log.warning(
+                "%s: the leader has no graceful LEAVE path; stopping "
+                "hands off through the ordinary election", self.me,
+            )
+        self.leave()
+        return sent
+
     async def _h_ping(self, msg: Message, addr) -> None:
         """Merge piggybacked gossip, ACK with our own (reference PING
         branch, worker.py:616-619)."""
         if not self.joined:
             return
+        self._note_universe(msg)
         self.membership.merge(msg.data.get("members", {}))
         self.membership.mark_alive(msg.sender)
         their_leader = msg.data.get("leader")
@@ -1220,15 +1649,21 @@ class Node:
         if their_leader and self.membership.leader is None and not self.election.in_progress:
             self._set_leader(their_leader)
         self._check_leader_conflict(their_leader)
+        their_ue = msg.data.get("ue")
         self.send_unique(
             msg.sender,
             MsgType.ACK,
-            {"members": self.membership.gossip(), "leader": self.membership.leader},
+            self._universe_piggyback(
+                {"members": self.membership.gossip(),
+                 "leader": self.membership.leader},
+                their_ue if isinstance(their_ue, int) else None,
+            ),
         )
 
     async def _h_ack(self, msg: Message, addr) -> None:
         """ACK: wake the waiter, merge gossip (reference
         worker.py:551-570 -> _notify_waiting)."""
+        self._note_universe(msg)
         self.membership.merge(msg.data.get("members", {}))
         self.membership.mark_alive(msg.sender)
         self._check_leader_conflict(msg.data.get("leader"))
@@ -1296,6 +1731,7 @@ class Node:
             "me": self.me.unique_name,
             "leader": self.membership.leader,
             "joined": self.joined,
+            "universe_epoch": self.spec.universe_epoch,
             "alive": [n.unique_name for n in self.membership.alive_nodes()],
             "false_positives": self.membership.false_positives,
             "indirect_failures": self.membership.indirect_failures,

@@ -27,13 +27,13 @@ whose payload is read at the await site of that request (rid-fallback
 types). The ``rid`` correlation key is universal and implicit.
 Refresh the key lists with ``python -m dml_tpu.tools.dmlflow``.
 
-    PING: leader? members?
-    ACK: leader? members?
+    PING: leader? members? ue? uni? *
+    ACK: leader? members? ue? uni? *
     INTRODUCE: -
     INTRODUCE_ACK: leader? members? <- INTRODUCE
     FETCH_INTRODUCER: -
     FETCH_INTRODUCER_ACK: introducer? <- FETCH_INTRODUCER
-    UPDATE_INTRODUCER: introducer?
+    UPDATE_INTRODUCER: introducer? uni? *
     UPDATE_INTRODUCER_ACK: - <- UPDATE_INTRODUCER
     ELECTION: -
     COORDINATE: -
@@ -98,6 +98,9 @@ Refresh the key lists with ``python -m dml_tpu.tools.dmlflow``.
     INGRESS_RELAY: job? reqs? sessions?
     TRACE_PULL: max_spans? peers? timeout? trace_ids? *
     TRACE_PULL_ACK: degraded? error? failed? held? ok? spans? stripped? truncated? * <- TRACE_PULL
+    JOIN_REQUEST: epoch? group? have? mac? node? nonce? *
+    JOIN_ACK: epoch? leader? mac? members? ok? reason? universe? <- JOIN_REQUEST
+    LEAVE: epoch? mac? nonce?
 """
 
 from __future__ import annotations
@@ -264,6 +267,26 @@ class MsgType(enum.IntEnum):
     # resolves the awaiting request future, like METRICS_PULL_ACK.
     TRACE_PULL = 100
     TRACE_PULL_ACK = 101
+    # elastic membership (config.ClusterSpec join policy): a node
+    # outside the current universe asks the leader for admission.
+    # JOIN_REQUEST carries the joiner's identity/addr, a fresh nonce,
+    # the universe epoch the joiner believes current, and an HMAC
+    # over all of it under the shared cluster secret — forged,
+    # replayed, and stale-epoch joins are rejected and counted while
+    # everything unauthenticated keeps dying at the existing
+    # out-of-universe drops. JOIN_ACK (rid fallback, like
+    # INTRODUCE_ACK) is MAC-stamped too and ships the membership
+    # snapshot + the universe catch-up (epoch + HMAC-stamped change
+    # entries, or the full table for a joiner too far behind).
+    # LEAVE is the graceful-departure announcement: the departing
+    # node proves its own identity with the same MAC scheme and the
+    # leader retires it from the table + membership IMMEDIATELY —
+    # scale-in must not linger through SWIM suspicion as a false
+    # failure. No ACK: the leaver is already gone; loss degrades to
+    # the ordinary failure-detection path.
+    JOIN_REQUEST = 110
+    JOIN_ACK = 111
+    LEAVE = 112
 
 
 # ----------------------------------------------------------------------
@@ -374,6 +397,10 @@ HANDLER_OWNERS: Dict["MsgType", str] = {
     # distributed tracing
     MsgType.TRACE_PULL: "Node",
     MsgType.TRACE_PULL_ACK: RID_FALLBACK,
+    # elastic membership
+    MsgType.JOIN_REQUEST: "Node",
+    MsgType.JOIN_ACK: RID_FALLBACK,
+    MsgType.LEAVE: "Node",
 }
 
 

@@ -11,10 +11,12 @@ out by hand (reference GLOBAL_RING_TOPOLOGY, config.py:67-89).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import hmac as _hmac
 import json
 import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 @dataclass(frozen=True, order=True)
@@ -173,6 +175,70 @@ class WorkerGroupSpec:
     hbm_bytes: int = 0
 
 
+# ----------------------------------------------------------------------
+# authenticated-membership MACs (cluster/node.py join/leave protocol)
+# ----------------------------------------------------------------------
+
+#: bound on the retained universe-change log. Gossip catch-up and
+#: rejoin deltas can only reach back this many changes; a node further
+#: behind falls back to the `full` table form (JOIN_ACK / INTRODUCE_ACK
+#: paths), which is authenticated as a whole instead of per entry.
+UNIVERSE_LOG_CAP = 256
+
+
+def _mac(secret: str, *parts: Any) -> str:
+    msg = "\x1f".join(str(p) for p in parts).encode("utf-8")
+    return _hmac.new(secret.encode("utf-8"), msg, hashlib.sha256).hexdigest()
+
+
+def join_mac(secret: str, node: Dict[str, Any], nonce: str, epoch: int,
+             group: str = "") -> str:
+    """HMAC a JOIN_REQUEST: binds the joiner's identity + addr (host,
+    port, name, rank), a fresh nonce (replay armor), the universe
+    epoch the joiner believes current (stale-capture armor), AND the
+    worker group it asks to be absorbed into ("" = plain slot) to the
+    shared cluster secret. Forged, replayed, and stale-epoch joins
+    all fail one of the bindings — and an on-path rewrite of the
+    group field (a universe-log-recorded topology change) invalidates
+    the MAC rather than re-shaping a mesh."""
+    return _mac(
+        secret, "join", node.get("host"), node.get("port"),
+        node.get("name"), node.get("rank"), nonce, int(epoch),
+        group or "",
+    )
+
+
+def leave_mac(secret: str, unique_name: str, nonce: str, epoch: int) -> str:
+    """HMAC a LEAVE: proves the departing node (and not a spoofed
+    sender evicting someone else) is asking to be retired."""
+    return _mac(secret, "leave", unique_name, nonce, int(epoch))
+
+
+def reply_mac(secret: str, nonce: str, epoch: int,
+              universe: Optional[Dict[str, Any]] = None) -> str:
+    """HMAC a JOIN_ACK (echoing the request nonce): the joiner only
+    trusts epoch hints and universe tables that carry this, so a
+    forged ACK can neither steer the joiner's epoch claim nor feed it
+    a phantom node table."""
+    blob = json.dumps(universe or {}, sort_keys=True,
+                      separators=(",", ":"), default=str)
+    return _mac(secret, "join-ack", nonce, int(epoch),
+                hashlib.sha256(blob.encode("utf-8")).hexdigest())
+
+
+def universe_entry_mac(secret: str, entry: Dict[str, Any]) -> str:
+    """HMAC one universe-log entry (minted by the admitting leader,
+    verified by every node that applies the entry from gossip):
+    deterministic over the entry content, so independently-derived
+    copies of the same change are identical."""
+    node = entry.get("node") or {}
+    return _mac(
+        secret, "universe", int(entry.get("e", -1)), entry.get("op"),
+        node.get("host"), node.get("port"), node.get("name"),
+        node.get("rank"), entry.get("group") or "",
+    )
+
+
 @dataclass
 class ClusterSpec:
     """The whole-cluster config: node table + ring + timing + store.
@@ -181,6 +247,16 @@ class ClusterSpec:
     GLOBAL_RING_TOPOLOGY dict (config.py:54-89), duplicated into
     `introduce process/config.py`. Here there is one spec, serializable
     to JSON, shared by every role including the introducer.
+
+    The node table is the cluster's **universe**: byzantine hardening
+    drops datagrams from senders outside it. With ``join_secret`` set,
+    the universe becomes DYNAMIC — a now-versioned table
+    (``universe_epoch``) that the leader may extend at runtime through
+    the authenticated JOIN_REQUEST/LEAVE protocol (cluster/node.py):
+    every change is an HMAC-stamped log entry that rides the gossip
+    piggyback, so peers converge on the same table without trusting
+    unauthenticated datagrams. With ``join_secret`` empty the table is
+    static, exactly the pre-elastic behavior.
     """
 
     nodes: List[NodeId] = field(default_factory=list)
@@ -214,12 +290,29 @@ class ClusterSpec:
     # every N seconds while jobs are in flight (full-restart survival
     # without operator-driven checkpoint-jobs); 0 disables
     jobs_checkpoint_interval: float = 0.0
+    # ---- elastic membership (cluster/node.py join/leave protocol) ----
+    # shared cluster secret authorizing runtime membership changes.
+    # Empty (default) = joins disabled, the table is static and the
+    # out-of-universe drops are final. Non-empty = nodes join through
+    # JOIN_REQUEST (HMAC over identity+addr+nonce+epoch) and retire
+    # through LEAVE; each admitted change bumps `universe_epoch` and
+    # appends an HMAC-stamped log entry that gossip carries to peers.
+    join_secret: str = ""
+    #: version of the node table; bumps on every admitted join/leave
+    universe_epoch: int = 0
 
     # ---- lookups (reference Config.get_node*, config.py:116-144) ----
-    # The node universe is static (like the reference's H1..H10 table),
-    # so lookup tables and the ring order are computed once.
+    # Lookup tables and the ring order are recomputed by `_reindex`
+    # whenever the universe changes (at construction, and on every
+    # admitted join/leave).
 
     def __post_init__(self):
+        #: HMAC-stamped change log: the catch-up payload gossip and
+        #: JOIN_ACK/INTRODUCE_ACK ship to peers behind on the epoch
+        self._universe_log: List[Dict[str, Any]] = []
+        self._reindex()
+
+    def _reindex(self) -> None:
         self._by_unique = {n.unique_name: n for n in self.nodes}
         self._ring = sorted(self.nodes, key=lambda n: (n.rank, n.host, n.port))
         # resolve group members (names or unique names) to unique
@@ -321,6 +414,263 @@ class ClusterSpec:
         if not alive:
             return None
         return max(alive, key=lambda n: (n.rank, n.host, n.port))
+
+    # ---- dynamic universe (authenticated runtime join/leave) ----
+
+    @staticmethod
+    def _node_dict(node: NodeId) -> Dict[str, Any]:
+        return {"host": node.host, "port": node.port,
+                "name": node.name, "rank": node.rank}
+
+    @staticmethod
+    def node_from_dict(d: Any) -> Optional[NodeId]:
+        """A NodeId from wire-supplied fields, or None when the
+        payload is garbled/byzantine (wrong types, missing keys)."""
+        if not isinstance(d, dict):
+            return None
+        try:
+            host = d["host"]
+            port = int(d["port"])
+            name = str(d.get("name", "") or "")
+            rank = int(d.get("rank", 0) or 0)
+        except (KeyError, TypeError, ValueError):
+            return None
+        if not isinstance(host, str) or not host or not (0 < port < 65536):
+            return None
+        return NodeId(host, port, name=name, rank=rank)
+
+    def _append_universe_entry(self, entry: Dict[str, Any]) -> None:
+        self._universe_log.append(entry)
+        if len(self._universe_log) > UNIVERSE_LOG_CAP:
+            del self._universe_log[: len(self._universe_log)
+                                   - UNIVERSE_LOG_CAP]
+
+    def add_node(
+        self,
+        node: NodeId,
+        group: Optional[str] = None,
+        local: bool = False,
+    ) -> bool:
+        """Admit `node` into the universe (leader-side of an
+        authenticated join). Already-known nodes are a no-op rejoin
+        (False — no epoch bump). `group` absorbs the joiner into that
+        worker group's member list (under-formed groups regain
+        capacity through the reform ladder, jobs/groups.py).
+
+        ``local=True`` records the node WITHOUT minting a change
+        entry or bumping the epoch — a joiner pre-seeding its own
+        table ("I know myself; the cluster assigns the epoch") and
+        operator bookkeeping use this form."""
+        if node.unique_name in self._by_unique:
+            return False
+        if group is not None:
+            gi = next(
+                (i for i, g in enumerate(self.worker_groups)
+                 if g.name == group), None)
+            if gi is None:
+                raise ValueError(f"unknown worker group {group!r}")
+            self.worker_groups[gi] = dataclasses.replace(
+                self.worker_groups[gi],
+                members=self.worker_groups[gi].members
+                + (node.unique_name,),
+            )
+        self.nodes.append(node)
+        if not local:
+            self.universe_epoch += 1
+            entry: Dict[str, Any] = {
+                "e": self.universe_epoch, "op": "join",
+                "node": self._node_dict(node),
+            }
+            if group:
+                entry["group"] = group
+            if self.join_secret:
+                entry["mac"] = universe_entry_mac(self.join_secret, entry)
+            self._append_universe_entry(entry)
+        self._reindex()
+        return True
+
+    def _strip_from_groups(self, unique_name: str) -> None:
+        def resolves_to(member: str) -> bool:
+            nid = self._by_unique.get(member) or self.node_by_name(member)
+            return nid is not None and nid.unique_name == unique_name
+
+        for i, g in enumerate(self.worker_groups):
+            if unique_name not in self._group_members.get(g.name, ()):
+                continue
+            keep = tuple(m for m in g.members if not resolves_to(m))
+            roles = {m: r for m, r in (g.roles or {}).items()
+                     if not resolves_to(m)}
+            self.worker_groups[i] = dataclasses.replace(
+                g, members=keep, roles=roles)
+
+    def remove_node(self, unique_name: str, local: bool = False) -> bool:
+        """Retire `unique_name` from the universe (graceful LEAVE, or
+        applying a peer's leave entry). Strips the node from any
+        worker group it lent chips to — the group's remaining members
+        ARE its new full strength, which is how a scale-in re-shapes
+        group topology instead of reading as a permanent degradation."""
+        node = self._by_unique.get(unique_name)
+        if node is None:
+            return False
+        self._strip_from_groups(unique_name)
+        self.nodes = [n for n in self.nodes
+                      if n.unique_name != unique_name]
+        if not local:
+            self.universe_epoch += 1
+            entry: Dict[str, Any] = {
+                "e": self.universe_epoch, "op": "leave",
+                "node": self._node_dict(node),
+            }
+            if self.join_secret:
+                entry["mac"] = universe_entry_mac(self.join_secret, entry)
+            self._append_universe_entry(entry)
+        self._reindex()
+        return True
+
+    def universe_delta(self, since: int, max_entries: int = 64) -> Dict[str, Any]:
+        """The catch-up payload for a peer at epoch `since`: a
+        contiguous WINDOW of up to `max_entries` HMAC-stamped change
+        entries starting right past the peer's epoch. A peer far
+        behind catches up incrementally — each exchange advances it
+        `max_entries` epochs and the next exchange ships the next
+        window — so the bounded gossip piggyback converges any gap
+        the retained log covers. Only when the log no longer reaches
+        back to ``since + 1`` (> UNIVERSE_LOG_CAP changes behind)
+        does this fall to the FULL table form (nodes + worker groups
+        — accepted only on authenticated reply paths, where the
+        enclosing reply MAC covers it)."""
+        since = max(int(since), 0)
+        if since >= self.universe_epoch:
+            return {"e": self.universe_epoch, "log": []}
+        entries = [e for e in self._universe_log if e["e"] > since]
+        if entries and entries[0]["e"] == since + 1:
+            # the log is contiguous by construction (epochs increment
+            # by one per entry; the cap trims only the FRONT), so any
+            # prefix of this slice is applicable as-is
+            return {"e": self.universe_epoch,
+                    "log": list(entries[:max(1, max_entries)])}
+        return {
+            "e": self.universe_epoch,
+            "full": {
+                "nodes": [self._node_dict(n) for n in self.nodes],
+                "worker_groups": [
+                    {"name": g.name, "members": list(g.members)}
+                    for g in self.worker_groups
+                ],
+            },
+        }
+
+    def _apply_entry(self, ent: Dict[str, Any]) -> None:
+        node = self.node_from_dict(ent.get("node"))
+        if node is None:
+            return
+        if ent.get("op") == "join":
+            group = ent.get("group")
+            if group is not None and not any(
+                g.name == group for g in self.worker_groups
+            ):
+                group = None  # unknown group here: plain slot
+            if node.unique_name not in self._by_unique:
+                try:
+                    self.add_node(node, group=group, local=True)
+                except ValueError:
+                    self.add_node(node, local=True)
+            elif group is not None and node.unique_name not in \
+                    self.group_members_unique(group):
+                # already in the table (a joiner pre-seeds itself
+                # locally) but the admission absorbed it into a
+                # group: the membership must still land
+                gi = next(i for i, g in enumerate(self.worker_groups)
+                          if g.name == group)
+                self.worker_groups[gi] = dataclasses.replace(
+                    self.worker_groups[gi],
+                    members=self.worker_groups[gi].members
+                    + (node.unique_name,),
+                )
+                self._reindex()
+        elif ent.get("op") == "leave":
+            self.remove_node(node.unique_name, local=True)
+
+    def apply_universe(
+        self, delta: Any, verified: bool = False
+    ) -> bool:
+        """Fold a peer's universe catch-up into this spec. Log entries
+        verify their own HMAC stamp (unless ``verified`` — the caller
+        already authenticated the enclosing reply); a bad stamp or a
+        gap stops the application (we stay behind and catch up from a
+        healthier peer). The `full` form is accepted only when
+        ``verified``. Returns True when the table or epoch changed."""
+        if not isinstance(delta, dict):
+            return False
+        changed = False
+        full = delta.get("full")
+        if isinstance(full, dict):
+            if not verified:
+                return False  # full tables only ride authenticated paths
+            try:
+                e = int(delta.get("e", 0))
+            except (TypeError, ValueError):
+                return False
+            if e <= self.universe_epoch:
+                return False
+            nodes = [
+                n for n in (
+                    self.node_from_dict(d)
+                    for d in full.get("nodes", [])
+                    if isinstance(d, dict)
+                ) if n is not None
+            ]
+            if not nodes:
+                return False
+            members_by_group = {
+                g.get("name"): list(g.get("members", []))
+                for g in full.get("worker_groups", [])
+                if isinstance(g, dict)
+            }
+            known = {n.unique_name for n in nodes}
+            self.nodes = nodes
+            self.worker_groups = [
+                dataclasses.replace(
+                    g,
+                    members=tuple(
+                        m for m in members_by_group.get(
+                            g.name, list(g.members))
+                        if m in known or m in {n.name for n in nodes}
+                    ),
+                    roles={m: r for m, r in (g.roles or {}).items()
+                           if m in known},
+                )
+                for g in self.worker_groups
+            ]
+            self.universe_epoch = e
+            self._universe_log = []  # history predating the snapshot
+            self._reindex()
+            return True
+        log_entries = delta.get("log")
+        if not isinstance(log_entries, list):
+            return False
+        for ent in sorted(
+            (e for e in log_entries if isinstance(e, dict)),
+            key=lambda e: e.get("e", 0)
+            if isinstance(e.get("e"), int) else 0,
+        ):
+            e = ent.get("e")
+            if not isinstance(e, int) or e <= self.universe_epoch:
+                continue
+            if e != self.universe_epoch + 1:
+                break  # gap: stay behind, catch up from a longer log
+            if self.join_secret and not verified:
+                want = universe_entry_mac(self.join_secret, ent)
+                got = ent.get("mac")
+                if not isinstance(got, str) or not _hmac.compare_digest(
+                    got, want
+                ):
+                    break  # unstamped/forged entry: refuse the tail too
+            self._apply_entry(ent)
+            self.universe_epoch = e
+            self._append_universe_entry(dict(ent))
+            changed = True
+        return changed
 
     # ---- serialization ----
 

@@ -73,6 +73,14 @@ _M_GROUP_BATCHES = METRICS.counter(
 _M_GROUP_REQUEUES = METRICS.counter(
     "jobs_group_requeues_total",
     "primary in-flight batches requeued because the group degraded")
+_M_GROUP_RESHAPES = METRICS.counter(
+    "jobs_group_reshapes_total",
+    "collapsed group re-formed to a different mesh shape "
+    "(member loss, graceful leave, or absorbed joiner), per group")
+_M_GROUP_RESHAPE_CHIPS = METRICS.gauge(
+    "jobs_group_reshape_chips",
+    "chips in the mesh a group is currently collapsed to "
+    "(0 while not collapsed)")
 
 
 def note_group_requeue(group: str) -> None:
@@ -85,6 +93,52 @@ class GroupDegraded(RuntimeError):
     """A group member died out from under a sharded batch: the ICI
     mesh the batch was executing on no longer exists. Routed through
     the ordinary WORKER_TASK_FAIL -> requeue path."""
+
+
+def reform_ladder(
+    mesh, n_members: int, n_active: int
+) -> Optional[Dict[str, int]]:
+    """The best dp×tp(×pp) mesh `n_active` of `n_members` members
+    still support — the adaptive re-formation rung a degraded group
+    steps down to instead of collapsing all the way to single chips
+    (MLPerf TPU-pod practice: re-forming to a different slice shape
+    is an operation, not a failure mode).
+
+    Chips-per-member comes from the configured mesh's total extent
+    spread over the configured membership (a -1 axis fills to the
+    member count). The ladder prefers, in order: the most usable
+    chips, the widest surviving ``tp`` (weight shards stay as thin as
+    the original layout budgeted per-chip HBM for), then the deepest
+    surviving ``pp`` — with tp'/pp' restricted to divisors of the
+    configured axes so re-sharding stays a pure re-grouping of the
+    same parameter tree (which is what keeps outputs token/bitwise
+    identical through ``param_gather`` re-sharding). Returns None
+    when fewer than two members survive (single-chip fallback) or the
+    group was never degraded."""
+    if n_members <= 0 or n_active < 2 or n_active >= n_members:
+        return None
+    total = 1
+    free = False
+    for v in (mesh.dp, mesh.tp, mesh.pp):
+        if v == -1:
+            free = True
+        else:
+            total *= max(1, v)
+    if free:
+        total = max(total, n_members)
+    cpm = max(1, total // n_members)
+    usable = cpm * n_active
+    tp0 = max(1, mesh.tp)
+    pp0 = max(1, mesh.pp)
+    tp_divs = [d for d in range(tp0, 0, -1) if tp0 % d == 0]
+    pp_divs = [d for d in range(pp0, 0, -1) if pp0 % d == 0]
+    for use in range(usable, 1, -1):
+        for tp_ in tp_divs:
+            for pp_ in pp_divs:
+                if use % (tp_ * pp_) == 0:
+                    return {"dp": use // (tp_ * pp_), "tp": tp_,
+                            "pp": pp_}
+    return None
 
 
 class GroupDirectory:
@@ -109,6 +163,16 @@ class GroupDirectory:
         }
         self.degradations: Dict[str, int] = {}
         self.reforms: Dict[str, int] = {}
+        #: reform ladder kill switch: off => member loss falls all
+        #: the way back to single chips (the pre-elastic behavior)
+        self.reform_enabled = True
+        self.reshapes: Dict[str, int] = {}
+        # group -> the mesh shape it is currently collapsed to:
+        # "full" (configured mesh, all members), a reform-ladder dict
+        # {dp,tp,pp}, or None (not collapsed — degraded/withheld)
+        self._shape_last: Dict[str, Any] = {}
+        # group -> members serving the current collapsed shape
+        self._active_last: Dict[str, Tuple[str, ...]] = {}
         # collapse memo: the collapse is a pure function of (pool,
         # active LM models, enabled-flag, ACK-observed capacities) —
         # all captured by the caller-provided cache key (the service
@@ -218,17 +282,44 @@ class GroupDirectory:
         # serving group that nothing can serve on
         formed_now: Dict[str, bool] = {}
         collapses: Dict[str, bool] = {}
+        active_now: Dict[str, Tuple[str, ...]] = {}
+        shape_now: Dict[str, Any] = {}
         for g in self.spec.worker_groups:
             mem = self.members(g.name)
-            formed_now[g.name] = bool(mem) and all(
-                m in pool_set for m in mem
-            )
-            collapses[g.name] = formed_now[g.name] and (
-                not lm_set or lm_set <= set(g.lm_models)
-            )
-            _M_ALIVE.set(
-                sum(1 for m in mem if m in pool_set), group=g.name
-            )
+            present = tuple(m for m in mem if m in pool_set)
+            formed_now[g.name] = bool(mem) and len(present) == len(mem)
+            # the shape is a pure function of spec + LIVENESS — never
+            # of the round's LM set — so the bookkeeping (reshape
+            # edges, active members, on_node_failed's requeue latch)
+            # is identical no matter which caller derives it (the
+            # lm-aware scheduling tick vs group_stats' lm-blind live
+            # refresh); the LM gate applies only to the POOL output
+            # below
+            shape = None
+            if formed_now[g.name]:
+                shape = "full"
+            elif (
+                self.reform_enabled
+                and mem
+                and mem[0] in present  # the group engine lives on the
+                # primary; losing it IS the single-chip fallback
+            ):
+                shape = reform_ladder(g.mesh, len(mem), len(present))
+            if shape is not None:
+                active_now[g.name] = present
+            # pool gating: a FULL group collapses when it serves every
+            # active LM model (PR-5/6 round-aware rule); a REFORMED
+            # group serves image rounds only — resident-sharded LM
+            # engines are fixed-mesh, so LM rounds keep the
+            # single-chip slots
+            if shape == "full":
+                collapses[g.name] = (
+                    not lm_set or lm_set <= set(g.lm_models)
+                )
+            else:
+                collapses[g.name] = shape is not None and not lm_set
+            shape_now[g.name] = shape
+            _M_ALIVE.set(len(present), group=g.name)
         out: List[str] = []
         weights: Dict[str, float] = {}
         for w in pool:
@@ -237,10 +328,21 @@ class GroupDirectory:
                 out.append(w)  # ungrouped, degraded, or LM-withheld
             elif w == self.members(g.name)[0]:
                 out.append(w)  # the group's one pool slot
-                weights[w] = self.capacity(g.name)
-            # formed lenders are pooled under the primary: no slot
+                shape = shape_now[g.name]
+                if shape == "full":
+                    weights[w] = self.capacity(g.name)
+                else:
+                    # reformed: weight by the reform mesh's chip
+                    # count — the survivors' actual strength, not the
+                    # full group's ACK-advertised capacity
+                    weights[w] = float(
+                        shape["dp"] * shape["tp"] * shape["pp"]
+                    )
+            # collapsed lenders are pooled under the primary: no slot
         for name, formed in formed_now.items():
             self._note_edge(name, formed)
+            self._note_shape(name, shape_now.get(name),
+                             active_now.get(name, ()))
         if full_key is not None:
             # un-keyed calls (group_stats' live refresh) must not
             # clobber the scheduling tick's memo — they would force a
@@ -251,17 +353,44 @@ class GroupDirectory:
 
     def role_in(self, pool: Iterable[str], uname: str) -> Optional[str]:
         """This node's serving role given an eligible pool: "primary"
-        (serves on the group engine), "lender" (chips pooled under the
-        primary), "degraded" (group configured but not formed), or
-        None (not in any group)."""
+        (serves on the group engine — at full strength or on a
+        reform-ladder mesh), "lender" (chips pooled under the
+        primary), "degraded" (group configured but neither formed nor
+        reformable), or None (not in any group)."""
         g = self.group_of(uname)
         if g is None:
             return None
         mem = self.members(g.name)
         pool_set = set(pool)
-        if not all(m in pool_set for m in mem):
+        present = tuple(m for m in mem if m in pool_set)
+        collapsed = bool(mem) and (
+            len(present) == len(mem)
+            or (
+                self.reform_enabled
+                and mem[0] in present
+                and reform_ladder(g.mesh, len(mem), len(present))
+                is not None
+            )
+        )
+        if not collapsed or uname not in present:
             return "degraded"
         return "primary" if uname == mem[0] else "lender"
+
+    def is_reformed(self, name: str) -> bool:
+        """True while the group's last derived shape is a
+        reform-ladder mesh rather than its full configured one.
+        Observability surface (group_stats, tests): the memo behind
+        it refreshes only on nodes that run the collapse, so ROUTING
+        decisions must not read it — the service's per-batch LM gate
+        (service._group_serves) derives full-strength liveness
+        directly from spec + alive instead."""
+        shape = self._shape_last.get(name)
+        return shape is not None and shape != "full"
+
+    def active_members(self, name: str) -> Tuple[str, ...]:
+        """The members serving the group's current collapsed shape
+        (empty while not collapsed)."""
+        return self._active_last.get(name, ())
 
     # -- liveness edges -----------------------------------------------
 
@@ -276,22 +405,67 @@ class GroupDirectory:
             self.degradations[name] = self.degradations.get(name, 0) + 1
             _M_DEGRADATIONS.inc(group=name)
             log.warning(
-                "group %s DEGRADED: serving falls back to the "
-                "surviving single-chip engines", name,
+                "group %s lost full strength: the reform ladder "
+                "re-shapes onto the survivors where it can, else "
+                "serving falls back to single-chip engines", name,
             )
         self._formed_last[name] = formed
         _M_FORMED.set(1.0 if formed else 0.0, group=name)
 
+    def _note_shape(self, name: str, shape: Any,
+                    active: Tuple[str, ...]) -> None:
+        """Track the mesh a group is collapsed to; a transition
+        between two DIFFERENT collapsed shapes (full -> reformed,
+        reformed -> smaller, reformed -> full) is a RESHAPE — the
+        observable edge of adaptive re-formation."""
+        last = self._shape_last.get(name)
+        if shape is not None and last is not None and shape != last:
+            self.reshapes[name] = self.reshapes.get(name, 0) + 1
+            _M_GROUP_RESHAPES.inc(group=name)
+            log.info(
+                "group %s RESHAPED %s -> %s on members %s",
+                name, last, shape, list(active),
+            )
+        self._shape_last[name] = shape
+        self._active_last[name] = tuple(active)
+        if shape == "full":
+            g = next(
+                (g for g in self.spec.worker_groups if g.name == name),
+                None)
+            chips = float(len(active)) if g is None else float(
+                max(1, g.mesh.dp) * max(1, g.mesh.tp)
+                * max(1, g.mesh.pp)
+                if -1 not in (g.mesh.dp, g.mesh.tp, g.mesh.pp)
+                else len(active))
+        elif shape is not None:
+            chips = float(shape["dp"] * shape["tp"] * shape["pp"])
+        else:
+            chips = 0.0
+        _M_GROUP_RESHAPE_CHIPS.set(chips, group=name)
+
     def on_node_failed(self, uname: str) -> Optional[Tuple[str, str]]:
         """SWIM failure fast path: if the dead node belonged to a
-        currently-formed group, degrade it NOW and return
-        ``(group_name, primary)`` so the coordinator can requeue the
-        primary's in-flight batches without waiting for the next
-        scheduling round to notice."""
+        currently-collapsed group (full or reformed), note the edge
+        NOW and return ``(group_name, primary)`` so the coordinator
+        can requeue the primary's in-flight batches without waiting
+        for the next scheduling round to notice — whatever mesh those
+        batches were running on no longer exists either way."""
         g = self.group_of(uname)
-        if g is None or not self._formed_last.get(g.name):
+        if g is None:
             return None
-        self._note_edge(g.name, False)
+        active = self._active_last.get(g.name, ())
+        was_formed = bool(self._formed_last.get(g.name))
+        if was_formed:
+            self._note_edge(g.name, False)
+        if not was_formed and uname not in active:
+            return None  # not serving a collapsed mesh: nothing to requeue
+        if uname in active:
+            # latch the death out of the active set so a repeated
+            # callback for the same corpse doesn't requeue twice; the
+            # next collapse derives the new shape (reform or fallback)
+            self._active_last[g.name] = tuple(
+                m for m in active if m != uname
+            )
         return g.name, self.primary(g.name) or uname
 
     # -- ACK-advertised capacity --------------------------------------
@@ -345,6 +519,12 @@ class GroupDirectory:
                 ),
                 "degradations": self.degradations.get(g.name, 0),
                 "reforms": self.reforms.get(g.name, 0),
+                # adaptive re-formation surface: the mesh the group is
+                # collapsed to right now ("full" | {dp,tp,pp} | None),
+                # who serves it, and how often the shape has changed
+                "mesh_in_force": self._shape_last.get(g.name),
+                "active_members": list(self._active_last.get(g.name, ())),
+                "reshapes": self.reshapes.get(g.name, 0),
             }
         if not self.enabled and self.spec.worker_groups:
             out["_disabled"] = True
@@ -375,7 +555,7 @@ def _check_members(
 
 def stub_group_backend(
     group_name: str,
-    members: Tuple[str, ...],
+    members,
     alive_fn: Callable[[], Set[str]],
     per_file_s: float = 0.004,
     capacity: Optional[float] = None,
@@ -384,19 +564,53 @@ def stub_group_backend(
     single-chip stub's latency divided by the group capacity
     (aggregate throughput), with member liveness checked before AND
     after the simulated device time — a member dying mid-batch breaks
-    the mesh exactly like real ICI loss, surfacing `GroupDegraded`."""
-    cap = float(capacity if capacity is not None else max(len(members), 1))
+    the mesh exactly like real ICI loss, surfacing `GroupDegraded`.
+
+    Reform-aware: the batch serves on the ACTIVE member set (members
+    ∩ alive — the same spec+liveness derivation the coordinator's
+    reform ladder uses), scaling throughput to the survivors; the set
+    CHANGING across the batch raises `GroupDegraded` (the mesh the
+    batch was running on is gone, whichever direction it changed).
+    Fewer than two live members = no sharded mesh at all. `members`
+    may be a callable so elastic membership (leave strips members,
+    joins absorb) is re-read per batch, matching the spec-derived
+    coordinator view."""
+    members_fn = members if callable(members) else (lambda: members)
+
+    def _active() -> Tuple[str, ...]:
+        alive = alive_fn()
+        return tuple(m for m in members_fn() if m in alive)
 
     async def backend(model: str, paths: List[str]):
-        _check_members(group_name, members, alive_fn)
+        mem = members_fn()
+        active = _active()
+        if len(active) < min(2, len(mem)):
+            dead = [m for m in mem if m not in active]
+            raise GroupDegraded(
+                f"group {group_name} lost member(s) {dead}: "
+                f"{len(active)} left — no sharded mesh; batch "
+                "requeues onto the pool"
+            )
+        cap = float(
+            capacity if capacity is not None else max(len(active), 1)
+        )
+        backend.capacity = cap
         exec_time = per_file_s * max(1, len(paths)) / cap
         await asyncio.sleep(exec_time)
-        _check_members(group_name, members, alive_fn)
+        if _active() != active:
+            raise GroupDegraded(
+                f"group {group_name} membership changed mid-batch "
+                f"({list(active)} -> {list(_active())}): the mesh the "
+                "batch ran on is gone; batch requeues"
+            )
         results = {p: [{"label": model, "score": 1.0}] for p in paths}
         _M_GROUP_BATCHES.inc(group=group_name)
         return results, exec_time, None
 
-    backend.capacity = cap
+    backend.capacity = float(
+        capacity if capacity is not None
+        else max(len(members_fn()), 1)
+    )
     backend.group_name = group_name
     # the stub echoes whatever model it is asked for, so it serves any
     # (the real sharded_backend pins `model` to its compiled engine)
@@ -474,7 +688,7 @@ def sharded_backend(
 
 def group_engine_backend(
     group_name: str,
-    members: Tuple[str, ...],
+    members,
     alive_fn: Callable[[], Set[str]],
     mesh_spec,  # config.MeshSpec — the group's dp×tp layout
     batch_size: int = 32,
@@ -502,19 +716,41 @@ def group_engine_backend(
     Without this, a spec-configured group on a plain CLI node would
     COLLAPSE the pool (lenders withdrawn, primary weighted at group
     capacity) while the primary still served single-chip — less
-    throughput than no groups at all."""
-    cache: Dict[str, Any] = {}
+    throughput than no groups at all.
+
+    Reform-aware: each batch derives the ACTIVE member set (members ∩
+    alive, same derivation as the coordinator's reform ladder) and
+    compiles/caches one engine per (model, reformed mesh). The
+    variables tree is identical across shapes (seed-deterministic, or
+    the one operator-loaded tree), so ``param_gather`` keeps reformed
+    outputs bitwise-equal to the full-mesh — and single-chip — path;
+    re-sharding changes WHERE weight shards live, never the math."""
+    from ..config import MeshSpec
+
+    members_fn = members if callable(members) else (lambda: members)
+    cache: Dict[Tuple[str, Tuple[int, int, int]], Any] = {}
     explicit: Dict[str, Any] = {}  # model -> operator-loaded tree
 
-    def _build(model: str):
+    def _mesh_for(n_active: int, n_members: int):
+        """The mesh to serve on at this strength: the configured
+        layout at full membership, the reform-ladder rung otherwise
+        (None = no viable sharded mesh)."""
+        if n_active >= n_members:
+            return mesh_spec
+        rung = reform_ladder(mesh_spec, n_members, n_active)
+        if rung is None:
+            return None
+        return MeshSpec(dp=rung["dp"], tp=rung["tp"], pp=rung["pp"])
+
+    def _build(model: str, use_mesh):
         import jax
 
         from ..parallel.inference import ShardedInference
         from ..parallel.mesh import make_mesh
 
         devices = jax.devices()
-        sizes = (mesh_spec.dp, mesh_spec.tp, mesh_spec.sp,
-                 mesh_spec.pp, mesh_spec.ep)
+        sizes = (use_mesh.dp, use_mesh.tp, use_mesh.sp,
+                 use_mesh.pp, use_mesh.ep)
         if -1 not in sizes:
             # a fully-specified group mesh takes its chip count off
             # the front of the host's device list (a -1 axis fills
@@ -528,36 +764,51 @@ def group_engine_backend(
                     f"devices, host sees {len(devices)}"
                 )
             devices = devices[:want]
-        mesh = make_mesh(mesh_spec, devices=devices)
+        mesh = make_mesh(use_mesh, devices=devices)
         si = ShardedInference(
             model, mesh, batch_size=batch_size, seed=seed,
             variables=explicit.get(model), param_gather=True,
         )
-        cache[model] = si
+        cache[(model, (use_mesh.dp, use_mesh.tp, use_mesh.pp))] = si
         backend.capacity = float(
             mesh.shape.get("dp", 1) * mesh.shape.get("tp", 1)
         )
         return si
 
     async def backend(model: str, paths: List[str]):
-        _check_members(group_name, members, alive_fn)
+        mem = members_fn()
+        alive = alive_fn()
+        active = tuple(m for m in mem if m in alive)
+        use_mesh = _mesh_for(len(active), max(len(mem), 1))
+        if use_mesh is None:
+            raise GroupDegraded(
+                f"group {group_name} has {len(active)} live "
+                "member(s): no sharded mesh; batch requeues"
+            )
 
         def run():
-            si = cache.get(model) or _build(model)
+            key = (model, (use_mesh.dp, use_mesh.tp, use_mesh.pp))
+            si = cache.get(key) or _build(model, use_mesh)
             return _sharded_run(si, paths, si.spec.input_size)
 
         results, infer_time = await asyncio.to_thread(run)
-        _check_members(group_name, members, alive_fn)
+        now_active = tuple(m for m in members_fn() if m in alive_fn())
+        if now_active != active:
+            raise GroupDegraded(
+                f"group {group_name} membership changed mid-batch: "
+                "the mesh the batch ran on is gone; batch requeues"
+            )
         _M_GROUP_BATCHES.inc(group=group_name)
         return results, infer_time, None
 
     def set_variables(model: str, variables: Any) -> None:
         """Adopt operator-loaded weights (load-model): drop the cached
-        engine so the next batch rebuilds on this tree."""
+        engines (every shape) so the next batch rebuilds on this tree."""
         explicit[model] = variables
-        cache.pop(model, None)
+        for key in [k for k in cache if k[0] == model]:
+            cache.pop(key, None)
 
-    backend.capacity = float(max(len(members), 1))
+    backend.capacity = float(max(len(members_fn()), 1))
     backend.group_name = group_name
     backend.model = None  # lazy per-model engines: serves any CNN
     backend.set_variables = set_variables
@@ -567,7 +818,9 @@ def group_engine_backend(
 def wire_group_backend(node) -> Optional[Any]:
     """Give a production node its group engine IF it is the primary
     of a configured worker group (CLI/NodeApp path): lenders and
-    ungrouped nodes get None and serve single-chip."""
+    ungrouped nodes get None and serve single-chip. Membership is
+    re-read from the spec per batch — elastic joins/leaves re-shape
+    the group under a running engine."""
     spec = node.spec
     uname = node.me.unique_name
     g = spec.group_of_unique(uname)
@@ -577,7 +830,8 @@ def wire_group_backend(node) -> Optional[Any]:
     if not members or uname != members[0]:
         return None
     return group_engine_backend(
-        g.name, members,
+        g.name,
+        lambda: spec.group_members_unique(g.name),
         lambda: {n.unique_name for n in node.membership.alive_nodes()},
         g.mesh,
     )

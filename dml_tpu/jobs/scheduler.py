@@ -85,7 +85,7 @@ _M_PROBE_QPS = METRICS.histogram(
     "measured ACK throughput of each depth-probe phase, by depth")
 _M_PROBES = METRICS.counter(
     "jobs_depth_probes_total",
-    "depth probe cycles committed, by trigger (warmup|drift|ttl)")
+    "depth probe cycles committed, by trigger (warmup|drift|ttl|pool)")
 _M_PROBE_ABORTS = METRICS.counter(
     "jobs_depth_probe_aborts_total",
     "probe cycles abandoned (work drained / phase timed out)")
@@ -184,6 +184,13 @@ class DepthController:
         # worker -> first-ACK-of-this-phase discard pending (their
         # in-flight batch may predate the depth switch)
         self._phase_skip_seen: Dict[str, bool] = {}
+        # pool size the committed depth was measured against (None
+        # until first observed): elastic membership can grow or shrink
+        # the slot count mid-job, which changes the overlap economics
+        # as surely as link weather does — a size change re-arms the
+        # probe (trigger "pool") so the committed depth is re-validated
+        # against the pool that actually exists now
+        self._pool_size: Optional[int] = None
         self._phase_images = 0
         self._phase_acks = 0
         self._phase_rates: Dict[int, float] = {}
@@ -224,6 +231,28 @@ class DepthController:
         ):
             self._begin_probe()
         return self.depth
+
+    # -- pool-size hook (elastic membership) --------------------------
+
+    def on_pool_size(self, n_slots: int) -> None:
+        """Called per scheduling round with the slot count. A change
+        counts as DRIFT: a settled commit re-arms (a join/leave that
+        changed the pool mid-job invalidates the probe's premise —
+        more slots deepen the fetch/put overlap window, fewer starve
+        it), and an in-flight probe aborts (its two phases would be
+        measuring different pools). The first observation only
+        records the size — bring-up is not drift."""
+        if self._pool_size is None:
+            self._pool_size = int(n_slots)
+            return
+        if int(n_slots) == self._pool_size:
+            return
+        self._pool_size = int(n_slots)
+        if self.state == "settled":
+            self.reprobes += 1
+            self._rearm("pool")
+        elif self.state == "probing":
+            self._abort_probe()
 
     # -- ACK hook -----------------------------------------------------
 
@@ -393,6 +422,7 @@ class DepthController:
             "noise_margin": self.noise_margin,
             "drift_ratio": self.drift_ratio,
             "last_probe": self.last_probe,
+            "pool_size": self._pool_size,
             "signature_s": (
                 {k: round(v, 6) for k, v in self.signature.items()}
                 if self.signature else None

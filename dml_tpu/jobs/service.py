@@ -463,6 +463,10 @@ class JobService:
         sb = self.store.standby_node()
         cache_key = (
             self.node.membership.view_epoch,
+            # elastic membership: a join/leave re-shapes groups and
+            # pool slots without necessarily moving the SWIM view
+            # epoch on this node first
+            self.node.spec.universe_epoch,
             self.node.leader_unique,
             sb.unique_name if sb else None,
         )
@@ -517,6 +521,19 @@ class JobService:
             return False
         if getattr(gb, "model", None) not in (None, model):
             return False
+        if model in self._extra_backends:
+            # LM group engines are FIXED-mesh (weights resident,
+            # sharded at registration): a group below full strength
+            # (reform-ladder territory) must route LM batches to the
+            # single-chip backend instead. Derived LIVE from spec +
+            # liveness like role_in — the directory's collapsed-shape
+            # memo only refreshes on nodes that run the collapse.
+            g = self.groups.group_of(self._me)
+            if g is not None:
+                pool_set = set(self._eligible_workers())
+                if not all(m in pool_set
+                           for m in self.groups.members(g.name)):
+                    return False
         return self.group_role() == "primary"
 
     def group_stats(self) -> Dict[str, Any]:
@@ -876,15 +893,20 @@ class JobService:
                 log.exception("%s: scheduling tick failed", self._me)
 
     def _run_schedule(self) -> None:
-        if self.depth_ctl is not None:
-            queued = sum(len(q) for q in self.scheduler.queues.values())
-            self.scheduler.pipeline_depth = self.depth_ctl.tick(queued)
         # worker_pool() collapses formed groups and refreshes
-        # _pool_weights; the DepthController above operates at the
+        # _pool_weights; the DepthController below operates at the
         # same granularity — a group is one slot, its probe ACKs all
         # arrive under the primary's name
+        pool = self.worker_pool()
+        if self.depth_ctl is not None:
+            # elastic membership: a join/leave that changed the slot
+            # count counts as drift — the committed pipelining depth
+            # re-validates against the pool that exists NOW
+            self.depth_ctl.on_pool_size(len(pool))
+            queued = sum(len(q) for q in self.scheduler.queues.values())
+            self.scheduler.pipeline_depth = self.depth_ctl.tick(queued)
         assigns = self.scheduler.schedule(
-            self.worker_pool(), weights=self._pool_weights
+            pool, weights=self._pool_weights
         )
         for w, key in self.scheduler.pop_revoked_stages():
             sat = self._staged_at.get(w)

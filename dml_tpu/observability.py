@@ -154,6 +154,8 @@ line when you add the metric.
     jobs_group_members_alive         live members per group
     jobs_group_reforms_total         group degraded -> formed edges
     jobs_group_requeues_total        primary in-flight batches requeued
+    jobs_group_reshape_chips         chips in the mesh in force per group
+    jobs_group_reshapes_total        collapsed-shape changes (reform ladder)
     jobs_kv_handoff_bytes_total      serialized KV slab bytes pulled
     jobs_kv_handoff_seconds          prefill RPC + slab pull wall
     jobs_kv_handoff_total            disagg handoffs by result ok|fallback
@@ -187,6 +189,11 @@ line when you add the metric.
     lm_sharded_tokens_total          tokens from group-sharded serving
     membership_gossip_entries_total  gossip entries carried by mode
     membership_gossip_exchanges_total  gossip payloads built by mode
+    membership_join_admitted_total   runtime joins admitted (new|rejoin)
+    membership_join_rejected_total   JOIN_REQUESTs rejected by reason
+    membership_leave_rejected_total  LEAVE announcements rejected by reason
+    membership_leaves_total          graceful departures retired
+    membership_universe_epoch        dynamic node-table version in force
     metrics_relay_fallback_total     relay shards fallen back to direct
     metrics_relay_pulls_total        relay-shard aggregations by role
     metrics_relay_seconds            relay shard pull + pre-merge wall

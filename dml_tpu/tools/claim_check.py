@@ -352,11 +352,17 @@ CHAOS_SCENARIOS_REQUIRED_FROM_ROUND = 8
 #: the adversarial families the bench must sweep (mirror of
 #: cluster/chaos.py SCENARIO_FAMILIES — kept literal here so this
 #: tool stays importable without the cluster stack)
-CHAOS_SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz", "churn")
+CHAOS_SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz",
+                           "churn", "elastic")
 
 #: "churn" (sustained seeded join/leave) landed with the round-12
 #: control-plane scale work; earlier artifacts predate the family
 CHAOS_CHURN_REQUIRED_FROM_ROUND = 12
+
+#: "elastic" (authenticated scale-out mid-load, graceful LEAVE,
+#: join flapping, forged-join storms) landed with the round-18
+#: elastic-membership work; earlier artifacts predate the family
+CHAOS_ELASTIC_REQUIRED_FROM_ROUND = 18
 
 
 def check_chaos_block(path: str) -> List[str]:
@@ -418,6 +424,12 @@ def check_chaos_block(path: str) -> List[str]:
             fam == "churn"
             and rnd is not None
             and rnd < CHAOS_CHURN_REQUIRED_FROM_ROUND
+        ):
+            continue  # the family predates this artifact
+        if (
+            fam == "elastic"
+            and rnd is not None
+            and rnd < CHAOS_ELASTIC_REQUIRED_FROM_ROUND
         ):
             continue  # the family predates this artifact
         entry = scenarios.get(fam)
@@ -1528,6 +1540,128 @@ def run_scale_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# round-18 elastic capacity: authenticated runtime join/leave must
+# RAISE throughput when capacity joins mid-load, with zero restarts
+# (bench _bench_elastic; ROADMAP item 2's done-condition)
+# ----------------------------------------------------------------------
+
+ELASTIC_REQUIRED_FROM_ROUND = 18
+
+#: scale-out must beat the load-window noise floor, not merely tie it
+ELASTIC_GAIN_MIN = 1.05
+
+
+def check_elastic_block(path: str) -> List[str]:
+    """Validate the ``elastic_capacity`` section WHEN IT RAN:
+
+    - both q/s windows measured (finite, positive) and the post-join
+      window STRICTLY above the pre-join one (``scaleout_gain`` >
+      ``ELASTIC_GAIN_MIN``) — capacity added mid-load must raise
+      measured throughput;
+    - zero restarts (the gain must be admitted capacity, not a
+      bounce);
+    - every scale-in was graceful (LEAVE sent, not a silent exit);
+    - the forged-join storm moved the typed rejection counters;
+    - the end-of-run invariant sweep was green (one leader, files at
+      factor, no phantom in any universe, no dead coroutines).
+
+    Artifacts before round ``ELASTIC_REQUIRED_FROM_ROUND`` are
+    exempt; summary-only driver captures gate on the compact line's
+    ``elastic_*`` keys."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < ELASTIC_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        problems = []
+        gain = s.get("elastic_scaleout_gain")
+        if gain is not None and (
+            not isinstance(gain, (int, float))
+            or not math.isfinite(gain) or gain <= ELASTIC_GAIN_MIN
+        ):
+            problems.append(
+                f"{name}: summary elastic_scaleout_gain = {gain!r} — "
+                "capacity joining mid-load must raise q/s above the "
+                f"{ELASTIC_GAIN_MIN} noise floor"
+            )
+        if s.get("elastic_ok") is False:
+            problems.append(
+                f"{name}: summary elastic_ok is false — an elastic-"
+                "capacity verdict (gain / zero-restarts / graceful "
+                "scale-in / storm-rejections / sweep) failed"
+            )
+        return problems
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "elastic_capacity" in not_run:
+        return []  # honestly recorded as skipped/errored
+    block = matrix.get("elastic_capacity")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `elastic_capacity` section and not "
+                "recorded as skipped (bench lost the elastic run?)"]
+    problems: List[str] = []
+    for key in ("qps_before", "qps_after"):
+        v = block.get(key)
+        if not isinstance(v, (int, float)) or not math.isfinite(v) or v <= 0:
+            problems.append(
+                f"{name}: elastic_capacity.{key} = {v!r} (missing, "
+                "nonfinite, or zero — a load window never measured)"
+            )
+    gain = block.get("scaleout_gain")
+    if not isinstance(gain, (int, float)) or not math.isfinite(gain) \
+            or gain <= ELASTIC_GAIN_MIN:
+        problems.append(
+            f"{name}: elastic_capacity.scaleout_gain = {gain!r} — "
+            "nodes joining mid-load must RAISE measured throughput "
+            f"(> {ELASTIC_GAIN_MIN})"
+        )
+    if block.get("restarts") != 0:
+        problems.append(
+            f"{name}: elastic_capacity.restarts = "
+            f"{block.get('restarts')!r} — the scale-out gain must "
+            "come with zero restarts"
+        )
+    graceful = block.get("scale_in_graceful")
+    if not isinstance(graceful, list) or not graceful \
+            or not all(v is True for v in graceful):
+        problems.append(
+            f"{name}: elastic_capacity.scale_in_graceful = "
+            f"{graceful!r} — every scale-in must announce LEAVE"
+        )
+    storm = block.get("storm") or {}
+    if not isinstance(storm, dict) or not storm.get("sent") \
+            or not isinstance(storm.get("rejected"), (int, float)) \
+            or storm.get("rejected", 0) <= 0:
+        problems.append(
+            f"{name}: elastic_capacity.storm = {storm!r} — the "
+            "forged-join storm must run and move the typed rejection "
+            "counters"
+        )
+    if block.get("sweep_ok") is not True:
+        problems.append(
+            f"{name}: elastic_capacity invariant sweep not green "
+            f"(failures: {block.get('sweep_failures')!r})"
+        )
+    if block.get("elastic_ok") is not True:
+        problems.append(
+            f"{name}: elastic_capacity.elastic_ok = "
+            f"{block.get('elastic_ok')!r} — the section's own verdict "
+            "must be true"
+        )
+    return problems
+
+
+def run_elastic_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_elastic_block(artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
 # exists and parses (ISSUE 4 satellite; VERDICT r5 item 1)
@@ -1608,6 +1742,9 @@ def main() -> None:
     for problem in run_scale_check(art_path):
         total += 1
         print(f"scale block: {problem}")
+    for problem in run_elastic_check(art_path):
+        total += 1
+        print(f"elastic block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")
