@@ -115,6 +115,14 @@ observability:
                                     TRACE_PULL (optionally relay-fanned)
   trace chrome [path]               export cluster traces as Chrome
                                     chrome://tracing / Perfetto JSON
+  health                            signal-plane rollup: per-node stage-
+                                    wall scores, burn-rate monitor state,
+                                    firing count (served locally on the
+                                    leader, via ALERT_PULL elsewhere)
+  alerts [n]                        typed alert ledger + last n lifecycle
+                                    events (default 16): name{labels},
+                                    severity, dedup count, exemplar
+                                    trace id per row
 other: help, quit
 """
 
@@ -462,6 +470,61 @@ class NodeApp:
             ))
         elif cmd == "ingress":
             print(json.dumps(self.ingress.stats(), indent=2))
+        elif cmd in ("health", "alerts"):
+            from .cluster.wire import MsgType
+
+            max_events = (
+                int(a[0]) if cmd == "alerts" and a and a[0].isdigit()
+                else 16
+            )
+            # the leader answers from its own ledger (a self-addressed
+            # ALERT_PULL would resolve its own rid with the request
+            # leg); everyone else pulls over the wire
+            if n.is_leader:
+                ledger = {
+                    "ok": True,
+                    "node": n.me.unique_name,
+                    "alerts": j.signal.alerts.rows(),
+                    "events": j.signal.alerts.stream()[-max_events:],
+                    "health": j.signal.health_summary(),
+                }
+            else:
+                ledger = await n.leader_request(
+                    MsgType.ALERT_PULL,
+                    {"max_events": max_events}, timeout=5.0,
+                )
+            if not ledger.get("ok"):
+                print(f"!! alert pull failed: {ledger.get('error')}")
+            elif cmd == "health":
+                print(json.dumps(ledger.get("health") or {}, indent=2))
+                firing = [
+                    r for r in ledger.get("alerts") or []
+                    if r.get("state") == "firing"
+                ]
+                print(f"({len(firing)} firing alert(s) on "
+                      f"{ledger.get('node', '?')} — 'alerts' for the "
+                      "ledger)")
+            else:
+                rows = ledger.get("alerts") or []
+                for r in rows:
+                    labels = ",".join(
+                        f"{k}={v}" for k, v in
+                        sorted((r.get("labels") or {}).items())
+                    )
+                    print(f"[{r.get('state', '?')}] "
+                          f"{r.get('severity', '?')} "
+                          f"{r.get('name', '?')}{{{labels}}} "
+                          f"x{r.get('count', 0)} "
+                          f"exemplar={r.get('exemplar')}")
+                    if r.get("summary"):
+                        print(f"    {r['summary']}")
+                for ev in ledger.get("events") or []:
+                    print(f"  {ev.get('t', 0):.1f} {ev.get('event', '?')} "
+                          f"{ev.get('name', '?')} {ev.get('labels')}")
+                trunc = ledger.get("truncated")
+                print(f"({len(rows)} ledger row(s) from "
+                      f"{ledger.get('node', '?')}"
+                      + (f"; degraded: {trunc}" if trunc else "") + ")")
         elif cmd == "breakdown":
             print(json.dumps({
                 "per_batch_ms": j.breakdown_stats(),
@@ -705,7 +768,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "2%% loss + duplicate delivery)")
     pc.add_argument("--scenario", default=None,
                     choices=["asym", "disk", "dns", "skew", "fuzz",
-                             "churn", "elastic"],
+                             "churn", "elastic", "liar"],
                     help="run one adversarial scenario family: "
                          "asym(metric partition), disk(-full + "
                          "corruption), dns (introducer outage during "
@@ -713,7 +776,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "datagrams), churn (sustained seeded "
                          "join/leave), elastic (authenticated "
                          "scale-out mid-load + graceful LEAVE + "
-                         "forged-join storm)")
+                         "forged-join storm), liar (a worker whose "
+                         "self-reported batch walls understate its "
+                         "real walls — the signal plane's ACK-wall "
+                         "cross-check must catch it)")
     pc.add_argument("--plan", default=None, metavar="FILE",
                     help="replay a saved plan JSON instead of generating")
     pc.add_argument("--dump", default=None, metavar="FILE",

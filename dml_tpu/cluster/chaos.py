@@ -178,11 +178,18 @@ def _child_seed(seed: int, tag: str) -> int:
 #:              garbled payload, stale epoch, replayed nonce) at the
 #:              live nodes — the typed rejection counters must move
 #:              and no phantom may enter the universe
+#: liar         target node; args.extra_s: make that node a LYING-
+#:              METRICS straggler — every batch stalls extra_s seconds
+#:              AFTER the self-reported exec wall is measured, so its
+#:              own metrics stay clean and only the leader's
+#:              dispatch->ACK cross-check (signal.HealthScorer) can
+#:              convict it (0 clears)
 EVENT_KINDS = (
     "crash", "restart", "partition", "partition_asym", "heal", "loss",
     "shape", "store_fault", "store_heal", "disk_fault", "disk_heal",
     "disk_corrupt", "dns_crash", "dns_restart", "skew", "fuzz",
     "put", "get", "job", "scale_out", "scale_in", "join_storm",
+    "liar",
 )
 
 #: the adversarial scenario families `scenario_plan` generates and the
@@ -191,9 +198,12 @@ EVENT_KINDS = (
 #: control-plane scale work and is claim_check-gated from round 12;
 #: "elastic" — capacity change as a first-class event: authenticated
 #: scale-out mid-load, graceful LEAVE scale-in, join flapping, and a
-#: forged-join storm — is claim_check-gated from round 18)
+#: forged-join storm — is claim_check-gated from round 18;
+#: "liar" — a lying-metrics straggler whose self-reported walls stay
+#: clean while batches stall, flaggable only by the signal plane's
+#: dispatch->ACK cross-check — is claim_check-gated from round 19)
 SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz", "churn",
-                     "elastic")
+                     "elastic", "liar")
 
 
 @dataclass(frozen=True)
@@ -549,6 +559,12 @@ def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
       transport; every malformed frame dies in Message.unpack
       (counted by transport_malformed_dropped_total), no coroutine
       dies, and the cluster keeps serving.
+    - ``liar``: a worker becomes a lying-metrics straggler mid-load —
+      every batch stalls a seeded extra wall AFTER its self-reported
+      exec time is measured, so the worker's own metrics stay clean;
+      the leader's dispatch->ACK cross-check (signal plane) must
+      convict it from evidence it cannot forge, then the node heals
+      and jobs keep completing.
     - ``elastic``: capacity change under load — a brand-new node
       joins mid-job through the authenticated JOIN_REQUEST path and
       takes pool slots, a join FLAPS (scale-out immediately followed
@@ -640,6 +656,21 @@ def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
             event(j(2.8, 3.2), "crash", "skewed"),
             event(j(5.2, 5.6), "restart", "last"),
             event(j(6.0, 6.4), "job", n=12),
+        ]
+    elif family == "liar":
+        events += [
+            # the straggle must dominate honest jitter (the cross-
+            # check margin is ratio 1.4 + 0.25s absolute) without
+            # stretching the scenario wall
+            event(j(0.7, 0.9), "liar", "worker",
+                  extra_s=round(rng.uniform(0.6, 1.0), 2)),
+            # enough batches for the >= min_samples ACK medians the
+            # cross-check needs before it will convict
+            event(j(1.2, 1.5), "job", n=16),
+            event(j(2.4, 2.7), "job", n=16),
+            # heal: extra_s=0 clears the seam; completions continue
+            event(j(3.4, 3.6), "liar", "liar", extra_s=0.0),
+            event(j(3.9, 4.3), "job", n=12),
         ]
     else:  # fuzz
         events += [
@@ -942,6 +973,8 @@ class LocalCluster:
         self._disk_faults: Dict[str, Dict[str, float]] = {}
         #: uname -> SWIM clock offset seconds (restart re-applies)
         self._skews: Dict[str, float] = {}
+        #: uname -> lying-metrics straggle seconds (restart re-applies)
+        self._liars: Dict[str, float] = {}
         self._restart_counter = 0
 
     def _default_jobs(self, node: Node, store: StoreService):
@@ -1160,6 +1193,8 @@ class LocalCluster:
             )
         if uname in self._skews:
             sn.node.membership.clock_offset = self._skews[uname]
+        if uname in self._liars and sn.jobs is not None:
+            sn.jobs.liar_extra_s = self._liars[uname]
         if self._partition is not None:
             # a node restarting into an active partition must land on
             # ONE side, not silently bridge both — on BOTH directional
@@ -1236,6 +1271,19 @@ class LocalCluster:
         sn = self.nodes.get(uname)
         if sn is not None:
             sn.node.membership.clock_offset = float(offset_s)
+
+    def set_liar(self, uname: str, extra_s: float) -> None:
+        """Make one node a lying-metrics straggler: its batches stall
+        ``extra_s`` seconds AFTER the self-reported exec wall is
+        measured (0 clears). Survives restarts — a rebooted liar is
+        still a liar."""
+        if extra_s:
+            self._liars[uname] = float(extra_s)
+        else:
+            self._liars.pop(uname, None)
+        sn = self.nodes.get(uname)
+        if sn is not None and sn.jobs is not None:
+            sn.jobs.liar_extra_s = float(extra_s)
 
     def corrupt_replica(self, name: str) -> Optional[str]:
         """Flip a byte of ONE live replica's newest on-disk copy of
@@ -1396,6 +1444,11 @@ class LocalCluster:
             if not live_skews:
                 return None
             return max(sorted(live_skews), key=lambda u: live_skews[u])
+        if target == "liar":
+            # the live lying-metrics straggler (heal target of the
+            # liar scenario)
+            live = sorted(u for u in self._liars if u in self.nodes)
+            return live[0] if live else None
         nid = self.spec.node_by_name(target)
         if nid is not None:
             return nid.unique_name
@@ -2090,6 +2143,13 @@ class ChaosRunner:
                 record["skipped"] = "no live target"
             else:
                 c.set_skew(uname, float(ev.arg("offset_s", 0.0)))
+                record["resolved"] = uname
+        elif ev.kind == "liar":
+            uname = c.resolve_target(ev.target or "worker")
+            if uname is None or uname not in c.nodes:
+                record["skipped"] = "no live target"
+            else:
+                c.set_liar(uname, float(ev.arg("extra_s", 0.0)))
                 record["resolved"] = uname
         elif ev.kind == "fuzz":
             record["injected"] = self._do_fuzz(int(ev.arg("n", 36)))

@@ -30,7 +30,9 @@ import math
 import os
 import random
 import time
-from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+from typing import (
+    Any, Awaitable, Callable, Dict, Iterable, List, Optional, Tuple,
+)
 
 from ..config import ClusterSpec, NodeId, join_mac, leave_mac, reply_mac
 from ..observability import METRICS
@@ -811,6 +813,54 @@ class Node:
         t.add_done_callback(self._bg_tasks.discard)
         return t
 
+    def send_tiered(
+        self,
+        to_unique: str,
+        mtype: MsgType,
+        extra: Dict[str, Any],
+        tiers: Iterable[Callable[[], Dict[str, Any]]],
+        what: str = "payload",
+    ) -> None:
+        """Send a reply that degrades to fit the UDP frame cap: try
+        each tier's payload fragment in order (merged over ``extra``
+        with ``ok: True``) until one packs; a reply ALWAYS goes out —
+        the final fallback is an explicit ``ok: False`` error carrying
+        ``what``, so a node degrades visibly instead of vanishing from
+        the cluster view because its payload grew. The ONE shared cap
+        machinery behind METRICS_PULL_ACK, METRICS_RELAY_ACK,
+        TRACE_PULL_ACK, and the signal plane's ALERT_PULL replies
+        (PRs 10/11 carried two parallel copies of this loop; a third
+        would have been one too many)."""
+        degraded = 0
+        for tier in tiers:
+            try:
+                self.send_unique(
+                    to_unique, mtype, {**extra, "ok": True, **tier()}
+                )
+                if degraded:
+                    # to_unique is already the unique_name string
+                    # (wire.Message contract) — an attribute access
+                    # here raised AttributeError and turned every
+                    # degraded reply into a handler-failure traceback
+                    log.warning(
+                        "%s: %s over the frame cap, "
+                        "degraded to tier %d for %s",
+                        self.me.unique_name, what, degraded, to_unique,
+                    )
+                return
+            except ValueError:
+                degraded += 1
+                continue
+        log.error(
+            "%s: %s unsendable even fully degraded",
+            self.me.unique_name, what,
+        )
+        self.send_unique(
+            to_unique, mtype,
+            {**extra, "ok": False,
+             "error": f"{what} exceeds datagram cap"},
+        )
+
     def _send_metrics_tiered(
         self,
         to_unique: str,
@@ -818,57 +868,31 @@ class Node:
         snap: Dict[str, Any],
         extra: Dict[str, Any],
     ) -> None:
-        """Send a metrics snapshot, degrading to fit the UDP frame
-        cap: full -> bucket-stripped (mean/count survive, percentiles
-        drop) -> counters+gauges only -> an explicit error reply. A
-        reply ALWAYS goes out — a node must degrade visibly, never
-        vanish from the cluster view because its registry grew. The
-        one shared form for METRICS_PULL_ACK and METRICS_RELAY_ACK."""
+        """Metrics tier ladder: full -> bucket-stripped (mean/count
+        survive, percentiles drop) -> counters+gauges only. The one
+        shared form for METRICS_PULL_ACK and METRICS_RELAY_ACK."""
         from .. import observability as obs
 
-        tiers = (
-            lambda: snap,
-            lambda: obs.strip_buckets(snap),
-            lambda: {
-                **{
-                    k: snap.get(k)
-                    for k in ("v", "proc", "procs", "ts", "node",
-                              "merged_from")
-                    if k in snap
-                },
-                "counters": snap.get("counters", {}),
-                "gauges": snap.get("gauges", {}),
-                "histograms": {},
-                "stripped": True,
-                "truncated": "histograms",
-            },
-        )
-        for i, tier in enumerate(tiers):
-            try:
-                self.send_unique(
-                    to_unique, mtype, {**extra, "ok": True, "metrics": tier()}
-                )
-                if i:
-                    # to_unique is already the unique_name string
-                    # (wire.Message contract) — an attribute access
-                    # here raised AttributeError and turned every
-                    # degraded reply into a handler-failure traceback
-                    log.warning(
-                        "%s: metrics snapshot over the frame cap, "
-                        "degraded to tier %d for %s",
-                        self.me.unique_name, i, to_unique,
-                    )
-                return
-            except ValueError:
-                continue
-        log.error(
-            "%s: metrics snapshot unsendable even without histograms",
-            self.me.unique_name,
-        )
-        self.send_unique(
-            to_unique, mtype,
-            {**extra, "ok": False,
-             "error": "metrics snapshot exceeds datagram cap"},
+        self.send_tiered(
+            to_unique, mtype, extra,
+            tiers=(
+                lambda: {"metrics": snap},
+                lambda: {"metrics": obs.strip_buckets(snap)},
+                lambda: {"metrics": {
+                    **{
+                        k: snap.get(k)
+                        for k in ("v", "proc", "procs", "ts", "node",
+                                  "merged_from")
+                        if k in snap
+                    },
+                    "counters": snap.get("counters", {}),
+                    "gauges": snap.get("gauges", {}),
+                    "histograms": {},
+                    "stripped": True,
+                    "truncated": "histograms",
+                }},
+            ),
+            what="metrics snapshot",
         )
 
     async def _h_metrics_pull(self, msg: Message, addr) -> None:
@@ -947,37 +971,86 @@ class Node:
             {"rid": rid, "covered": sorted(snaps), "failed": sorted(failed)},
         )
 
-    async def _pull_peer_snapshots(
+    async def _pull_peer_replies(
         self,
         peers: List[NodeId],
+        mtype: MsgType,
+        req: Dict[str, Any],
         timeout: float,
+        on_reply: Callable[[NodeId, Dict[str, Any]], None],
+        failed: List[str],
         concurrency: int = 8,
-    ) -> Tuple[Dict[str, Dict[str, Any]], List[str]]:
-        """Bounded-concurrency METRICS_PULL fan-out: at most
-        `concurrency` requests in flight, so a straggler (or a dead
-        peer's full timeout) costs one slot-wait, not a serial wall —
-        and an O(100)-node pull doesn't burst O(N) datagrams at once.
-        Returns (snapshots by unique name, unreachable peers)."""
-        snaps: Dict[str, Dict[str, Any]] = {}
-        failed: List[str] = []
+    ) -> None:
+        """Bounded-concurrency request fan-out: at most `concurrency`
+        requests in flight, so a straggler (or a dead peer's full
+        timeout) costs one slot-wait, not a serial wall — and an
+        O(100)-node pull doesn't burst O(N) datagrams at once. A
+        timeout appends the peer to ``failed``; any reply is handed to
+        ``on_reply`` OUTSIDE the semaphore (reply processing must not
+        hold a fan-out slot). The one shared fan-out loop behind
+        METRICS_PULL and TRACE_PULL collection."""
         sem = asyncio.Semaphore(max(1, concurrency))
 
         async def pull_one(peer: NodeId) -> None:
             async with sem:
                 try:
                     reply = await self.request(
-                        peer, MsgType.METRICS_PULL, {}, timeout=timeout
+                        peer, mtype, dict(req), timeout=timeout
                     )
                 except (asyncio.TimeoutError, TimeoutError):
                     failed.append(peer.unique_name)
                     return
+            on_reply(peer, reply)
+
+        await asyncio.gather(*(pull_one(n) for n in peers))
+
+    @staticmethod
+    def _relay_shards(
+        peers: List[NodeId], relays: int
+    ) -> Tuple[List[NodeId], Dict[str, List[NodeId]]]:
+        """Deterministic relay choice (first R peers by the caller's
+        sort) + round-robin shard assignment — the one sharding rule
+        both the metrics and trace relay fan-outs use."""
+        relay_nodes = peers[:relays]
+        shards: Dict[str, List[NodeId]] = {
+            r.unique_name: [] for r in relay_nodes
+        }
+        for i, p in enumerate(peers[relays:]):
+            shards[relay_nodes[i % len(relay_nodes)].unique_name].append(p)
+        return relay_nodes, shards
+
+    @staticmethod
+    def _relay_timeout(shard_len: int, timeout: float) -> float:
+        """A relay's worst-case shard wall is one `timeout` wave per
+        concurrency batch (its bounded pull runs 8 at a time) —
+        budget for that plus wire margin, or a healthy relay on a
+        sickly shard gets misclassified as failed and its shard
+        double-pulled."""
+        waves = max(1, -(-shard_len // 8))
+        return timeout * (waves + 1) + 1.0
+
+    async def _pull_peer_snapshots(
+        self,
+        peers: List[NodeId],
+        timeout: float,
+        concurrency: int = 8,
+    ) -> Tuple[Dict[str, Dict[str, Any]], List[str]]:
+        """METRICS_PULL over the shared bounded fan-out. Returns
+        (snapshots by unique name, unreachable peers)."""
+        snaps: Dict[str, Dict[str, Any]] = {}
+        failed: List[str] = []
+
+        def on_reply(peer: NodeId, reply: Dict[str, Any]) -> None:
             snap = reply.get("metrics")
             if isinstance(snap, dict):
                 snaps[peer.unique_name] = snap
             else:
                 failed.append(peer.unique_name)
 
-        await asyncio.gather(*(pull_one(n) for n in peers))
+        await self._pull_peer_replies(
+            peers, MsgType.METRICS_PULL, {}, timeout, on_reply, failed,
+            concurrency=concurrency,
+        )
         return snaps, failed
 
     async def pull_cluster_metrics(
@@ -1067,18 +1140,11 @@ class Node:
         List[str],
         Dict[str, Any],
     ]:
-        """Two-level fan-out: deterministic relay choice (first R
-        peers by unique name), round-robin shard assignment, one
+        """Two-level fan-out: the shared ``_relay_shards`` split, one
         METRICS_RELAY_PULL per relay, direct-pull fallback per failed
         relay shard. Returns (pre-merged relay blobs, directly-pulled
         snapshots, unreachable peers, relay stats)."""
-        relay_nodes = peers[:relays]
-        rest = peers[relays:]
-        shards: Dict[str, List[NodeId]] = {
-            r.unique_name: [] for r in relay_nodes
-        }
-        for i, p in enumerate(rest):
-            shards[relay_nodes[i % len(relay_nodes)].unique_name].append(p)
+        relay_nodes, shards = self._relay_shards(peers, relays)
         blobs: List[Dict[str, Any]] = []
         direct: Dict[str, Dict[str, Any]] = {}
         failed: List[str] = []
@@ -1089,12 +1155,6 @@ class Node:
             nonlocal fallbacks
             shard = shards[relay.unique_name]
             try:
-                # the relay's worst-case shard wall is one `timeout`
-                # wave per concurrency batch (its bounded pull runs 8
-                # at a time) — budget for that plus wire margin, or a
-                # healthy relay on a sickly shard gets misclassified
-                # as failed and its shard double-pulled
-                waves = max(1, -(-len(shard) // 8))
                 reply = await self.request(
                     relay,
                     MsgType.METRICS_RELAY_PULL,
@@ -1102,7 +1162,7 @@ class Node:
                         "peers": [p.unique_name for p in shard],
                         "timeout": timeout,
                     },
-                    timeout=timeout * (waves + 1) + 1.0,
+                    timeout=self._relay_timeout(len(shard), timeout),
                 )
             except (asyncio.TimeoutError, TimeoutError):
                 reply = {}
@@ -1142,61 +1202,41 @@ class Node:
     # distributed tracing collection (dml_tpu/tracing.py)
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _trace_tiers(
+        spans: list,
+    ) -> Iterable[Callable[[], Dict[str, Any]]]:
+        """Span tier ladder for ``send_tiered``: full ->
+        labels/events stripped -> repeatedly halved newest-half (down
+        to 8 spans) -> count-only. ``held`` always carries the
+        recorder's true size so the puller can detect truncation."""
+        held = len(spans)
+        yield lambda: {"spans": list(spans), "held": held}
+        rows = [
+            {k: v for k, v in d.items() if k not in ("lb", "ev")}
+            for d in spans
+        ]
+        yield lambda r=rows: {"spans": r, "held": held, "stripped": True}
+        while len(rows) > 8:
+            rows = rows[len(rows) // 2:]  # keep the newest half
+            yield lambda r=rows: {
+                "spans": r, "held": held, "stripped": True,
+            }
+        yield lambda: {"spans": [], "held": held, "truncated": "spans"}
+
     def _send_trace_tiered(
         self,
         to_unique: str,
         spans: list,
         extra: Dict[str, Any],
     ) -> None:
-        """Send a span dump, degrading to fit the UDP frame cap: full
-        -> labels/events stripped -> repeatedly halved newest-half ->
-        count-only -> explicit error. The same always-reply discipline
-        as ``_send_metrics_tiered``: a node's recorder must degrade
-        visibly, never vanish from the cluster trace because it grew."""
-        rows = list(spans)
-        stripped = False
-        tier = 0
-        while True:
-            try:
-                self.send_unique(
-                    to_unique, MsgType.TRACE_PULL_ACK,
-                    {**extra, "ok": True, "spans": rows,
-                     "held": len(spans),
-                     **({"stripped": True} if stripped else {})},
-                )
-                if tier:
-                    log.warning(
-                        "%s: span dump over the frame cap, degraded "
-                        "%d tier(s) for %s (%d of %d spans sent)",
-                        self.me.unique_name, tier, to_unique,
-                        len(rows), len(spans),
-                    )
-                return
-            except ValueError:
-                tier += 1
-                if not stripped:
-                    stripped = True
-                    rows = [
-                        {k: v for k, v in d.items()
-                         if k not in ("lb", "ev")}
-                        for d in rows
-                    ]
-                elif len(rows) > 8:
-                    rows = rows[len(rows) // 2:]  # keep the newest half
-                else:
-                    break
-        try:
-            self.send_unique(
-                to_unique, MsgType.TRACE_PULL_ACK,
-                {**extra, "ok": True, "spans": [], "held": len(spans),
-                 "truncated": "spans"},
-            )
-        except ValueError:
-            self.send_unique(
-                to_unique, MsgType.TRACE_PULL_ACK,
-                {**extra, "ok": False,
-                 "error": "span dump exceeds datagram cap"},
-            )
+        """Send a span dump through the shared cap machinery: a
+        node's recorder must degrade visibly, never vanish from the
+        cluster trace because it grew."""
+        self.send_tiered(
+            to_unique, MsgType.TRACE_PULL_ACK, extra,
+            tiers=self._trace_tiers(spans), what="span dump",
+        )
 
     async def _h_trace_pull(self, msg: Message, addr) -> None:
         """Reply with this process's flight-recorder dump. A request
@@ -1290,31 +1330,21 @@ class Node:
         timeout: float,
         concurrency: int = 8,
     ) -> Tuple[Dict[str, list], List[str], Dict[str, Dict[str, Any]]]:
-        """Bounded-concurrency TRACE_PULL fan-out (the span analog of
-        ``_pull_peer_snapshots``): a dead peer costs one slot-wait,
-        never a serial wall. The third return maps peers whose reply
-        DEGRADED (``truncated`` tier marker, ``held`` recorder size) —
-        the ACK ships those fields so the aggregated view can say
-        "this node's recorder outgrew the frame", and until
-        drift-wire-payloads flagged them as sent-never-read they were
-        silently dropped here."""
+        """TRACE_PULL over the shared bounded fan-out (the span analog
+        of ``_pull_peer_snapshots``). The third return maps peers
+        whose reply DEGRADED (``truncated`` tier marker, ``held``
+        recorder size) — the ACK ships those fields so the aggregated
+        view can say "this node's recorder outgrew the frame", and
+        until drift-wire-payloads flagged them as sent-never-read they
+        were silently dropped here."""
         dumps: Dict[str, list] = {}
         failed: List[str] = []
         degraded: Dict[str, Dict[str, Any]] = {}
-        sem = asyncio.Semaphore(max(1, concurrency))
         req: Dict[str, Any] = {"max_spans": max_spans}
         if trace_ids is not None:
             req["trace_ids"] = trace_ids
 
-        async def pull_one(peer: NodeId) -> None:
-            async with sem:
-                try:
-                    reply = await self.request(
-                        peer, MsgType.TRACE_PULL, req, timeout=timeout
-                    )
-                except (asyncio.TimeoutError, TimeoutError):
-                    failed.append(peer.unique_name)
-                    return
+        def on_reply(peer: NodeId, reply: Dict[str, Any]) -> None:
             spans = reply.get("spans")
             if reply.get("ok") and isinstance(spans, list):
                 dumps[peer.unique_name] = spans
@@ -1330,7 +1360,10 @@ class Node:
                     )
                 failed.append(peer.unique_name)
 
-        await asyncio.gather(*(pull_one(n) for n in peers))
+        await self._pull_peer_replies(
+            peers, MsgType.TRACE_PULL, req, timeout, on_reply, failed,
+            concurrency=concurrency,
+        )
         return dumps, failed, degraded
 
     async def pull_cluster_traces(
@@ -1366,14 +1399,7 @@ class Node:
             key=lambda n: n.unique_name,
         )
         if relays > 0 and len(others) > relays:
-            relay_nodes = others[:relays]
-            rest = others[relays:]
-            shards: Dict[str, List[NodeId]] = {
-                r.unique_name: [] for r in relay_nodes
-            }
-            for i, p in enumerate(rest):
-                shards[relay_nodes[i % len(relay_nodes)].unique_name] \
-                    .append(p)
+            relay_nodes, shards = self._relay_shards(others, relays)
 
             async def pull_relay(relay: NodeId) -> None:
                 shard = shards[relay.unique_name]
@@ -1383,11 +1409,10 @@ class Node:
                 }
                 if trace_ids is not None:
                     req["trace_ids"] = trace_ids
-                waves = max(1, -(-len(shard) // 8))
                 try:
                     reply = await self.request(
                         relay, MsgType.TRACE_PULL, req,
-                        timeout=timeout * (waves + 1) + 1.0,
+                        timeout=self._relay_timeout(len(shard), timeout),
                     )
                 except (asyncio.TimeoutError, TimeoutError):
                     reply = {}

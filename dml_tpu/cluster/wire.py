@@ -101,6 +101,8 @@ Refresh the key lists with ``python -m dml_tpu.tools.dmlflow``.
     JOIN_REQUEST: epoch? group? have? mac? node? nonce? *
     JOIN_ACK: epoch? leader? mac? members? ok? reason? universe? <- JOIN_REQUEST
     LEAVE: epoch? mac? nonce?
+    ALERT: event? row?
+    ALERT_PULL: alerts? error? events? health? max_events? node? ok? truncated? * <- ALERT_PULL
 """
 
 from __future__ import annotations
@@ -287,6 +289,20 @@ class MsgType(enum.IntEnum):
     JOIN_REQUEST = 110
     JOIN_ACK = 111
     LEAVE = 112
+    # SLO signal plane (dml_tpu/signal.py): the typed alert lifecycle's
+    # wire surface. ALERT is the leader's fire-and-forget transition
+    # relay to the hot standby (the STORE_IDEMPOTENCY_RELAY /
+    # INGRESS_RELAY discipline applied to the alert ledger): every
+    # firing→resolved transition ships its row so a promoted leader
+    # inherits the firing set and can still resolve it. ALERT_PULL is
+    # request/reply on ONE type (the DOWNLOAD_FILE_SUCCESS discipline):
+    # a leg carrying a rid we minted resolves our awaiting future; any
+    # other leg is a request for the ledger + recent events + health
+    # rollup, degrading tier by tier through the shared send_tiered cap
+    # machinery (full -> truncated events -> rows-only -> explicit
+    # error). The CLI `health` / `alerts` verbs ride it.
+    ALERT = 120
+    ALERT_PULL = 121
 
 
 # ----------------------------------------------------------------------
@@ -401,6 +417,11 @@ HANDLER_OWNERS: Dict["MsgType", str] = {
     MsgType.JOIN_REQUEST: "Node",
     MsgType.JOIN_ACK: RID_FALLBACK,
     MsgType.LEAVE: "Node",
+    # SLO signal plane: ALERT_PULL is registered even though replies
+    # share the type — the handler calls resolve_rid first and falls
+    # through to request handling (the DOWNLOAD_FILE_SUCCESS shape)
+    MsgType.ALERT: "SignalPlane",
+    MsgType.ALERT_PULL: "SignalPlane",
 }
 
 

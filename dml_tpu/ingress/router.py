@@ -578,6 +578,10 @@ class RequestRouter:
             root.label(terminal="shed", reason=reason)
             root.event("shed")  # tail exemplar: captured regardless
             root.end()          # of the sampling decision
+            # signal plane: remember this trace as the freshest shed
+            # exemplar for the class, so a shed-ratio burn alert can
+            # attach the trace that EXPLAINS it
+            self.jobs.signal.note_bad_request("shed", slo.name, tid)
             ack({"accepted": False, "reason": reason, "shed": True})
             return
         adm.end()
@@ -1008,6 +1012,10 @@ class RequestRouter:
                 if state.root is not None:
                     state.root.event("deadline_miss")
                     state.root.label(miss_stage=dominant)
+                self.jobs.signal.note_bad_request(
+                    "deadline_miss", r.slo.name,
+                    r.ctx.trace_id if r.ctx is not None else None,
+                )
             self._end_root(state, "completed", now_wall,
                            deadline_met=met)
             self._done[req_id] = terminal

@@ -55,6 +55,26 @@ DEFAULT_CLASSES: Dict[str, SLOClass] = {
 }
 
 
+#: per-class SLO error budgets: the fraction of requests a class may
+#: miss/shed before its budget is spent at burn-rate 1.0 (the signal
+#: plane's burn monitors divide the observed bad fraction by this).
+#: Interactive work gets the tight budget; batch work tolerates more.
+DEFAULT_BURN_BUDGETS: Dict[str, float] = {
+    "interactive": 0.02,
+    "batch": 0.05,
+}
+
+#: fallback budget for scopes without an entry (per-model monitors,
+#: operator-defined classes): permissive, so an unknown scope cannot
+#: page at the interactive threshold by accident
+DEFAULT_BURN_BUDGET = 0.05
+
+
+def burn_budget(name: str) -> float:
+    """The SLO error budget for a class (or any monitor scope)."""
+    return DEFAULT_BURN_BUDGETS.get(name, DEFAULT_BURN_BUDGET)
+
+
 def resolve_class(
     name: str, classes: Optional[Dict[str, SLOClass]] = None
 ) -> SLOClass:

@@ -136,6 +136,10 @@ tier-1 via tests/test_dmllint.py) fails when a metric is registered in
 the map cannot silently desynchronize from the code again. Add the
 line when you add the metric.
 
+    alert_fired_total                alert firing transitions by name= severity=
+    alert_firing                     currently-firing alerts by name=
+    alert_relays_total               ledger transitions relayed to standby
+    alert_resolved_total             alert resolved transitions by name=
     cluster_alive_nodes              SWIM live-member gauge
     cluster_failover_recovery_seconds  chaos: leader-kill -> converged wall
     cluster_false_positives_total    SWIM suspicions that proved alive
@@ -211,6 +215,10 @@ line when you add the metric.
     request_session_affinity_misses_total  sessions with no live target
     request_shed_total               admission sheds by slo= reason=
     request_stream_tokens_total      tokens pushed into request streams
+    signal_crosscheck_flags_total    workers convicted by ACK-wall check
+    signal_monitor_transitions_total burn-monitor transitions by signal= to=
+    signal_samples_total             signal-plane window sample ticks
+    signal_window_value              latest windowed sample per key=
     store_corruption_detected_total  sha256 mismatches quarantined
     store_deletes_total              delete operations
     store_get_seconds                GET wall

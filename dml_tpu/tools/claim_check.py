@@ -353,7 +353,7 @@ CHAOS_SCENARIOS_REQUIRED_FROM_ROUND = 8
 #: cluster/chaos.py SCENARIO_FAMILIES — kept literal here so this
 #: tool stays importable without the cluster stack)
 CHAOS_SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz",
-                           "churn", "elastic")
+                           "churn", "elastic", "liar")
 
 #: "churn" (sustained seeded join/leave) landed with the round-12
 #: control-plane scale work; earlier artifacts predate the family
@@ -363,6 +363,11 @@ CHAOS_CHURN_REQUIRED_FROM_ROUND = 12
 #: join flapping, forged-join storms) landed with the round-18
 #: elastic-membership work; earlier artifacts predate the family
 CHAOS_ELASTIC_REQUIRED_FROM_ROUND = 18
+
+#: "liar" (a worker whose self-reported batch walls understate its
+#: real walls — the straggler cross-check's adversary) landed with
+#: the round-19 signal-plane work; earlier artifacts predate it
+CHAOS_LIAR_REQUIRED_FROM_ROUND = 19
 
 
 def check_chaos_block(path: str) -> List[str]:
@@ -430,6 +435,12 @@ def check_chaos_block(path: str) -> List[str]:
             fam == "elastic"
             and rnd is not None
             and rnd < CHAOS_ELASTIC_REQUIRED_FROM_ROUND
+        ):
+            continue  # the family predates this artifact
+        if (
+            fam == "liar"
+            and rnd is not None
+            and rnd < CHAOS_LIAR_REQUIRED_FROM_ROUND
         ):
             continue  # the family predates this artifact
         entry = scenarios.get(fam)
@@ -1662,6 +1673,118 @@ def run_elastic_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# round-19 signal plane: burn-rate alerts must FIRE under chaos
+# overload with trace exemplars, the straggler cross-check must catch
+# a lying worker, and the alert ledger must survive leader failover
+# (bench _bench_signal_plane; ISSUE 16 tentpole)
+# ----------------------------------------------------------------------
+
+SIGNAL_REQUIRED_FROM_ROUND = 19
+
+
+def check_signal_block(path: str) -> List[str]:
+    """Validate the ``signal_plane`` section WHEN IT RAN:
+
+    - the chaos-overload arm fired a typed burn-rate alert carrying
+      an exemplar trace id (an alert without an exemplar cannot be
+      drilled into — the flight recorder hook was lost);
+    - the lying-metrics arm flagged the liar via the ACK-observed
+      wall cross-check WHILE its self-reported walls stayed clean —
+      evidence the detection used the leader's own clock, not the
+      worker's word;
+    - the failover arm carried a firing alert across a leader kill
+      and resolved it on the promoted leader (ledger relay worked);
+    - the replay arm produced byte-identical alert streams from the
+      same seed (the alert pipeline is deterministic given the same
+      observations and clock).
+
+    Artifacts before round ``SIGNAL_REQUIRED_FROM_ROUND`` are
+    exempt; summary-only driver captures gate on the compact line's
+    ``alert_fired_ok`` / ``liar_flagged_ok`` keys."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < SIGNAL_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        problems = []
+        if s.get("alert_fired_ok") is False:
+            problems.append(
+                f"{name}: summary alert_fired_ok is false — chaos "
+                "overload never fired a typed burn-rate alert"
+            )
+        if s.get("liar_flagged_ok") is False:
+            problems.append(
+                f"{name}: summary liar_flagged_ok is false — the "
+                "ACK-wall cross-check missed the lying worker"
+            )
+        return problems
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "signal_plane" in not_run:
+        return []  # honestly recorded as skipped/errored
+    block = matrix.get("signal_plane")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `signal_plane` section and not recorded "
+                "as skipped (bench lost the signal-plane run?)"]
+    problems: List[str] = []
+    if block.get("alert_fired_ok") is not True:
+        problems.append(
+            f"{name}: signal_plane.alert_fired_ok = "
+            f"{block.get('alert_fired_ok')!r} — chaos overload must "
+            "fire a typed burn-rate alert"
+        )
+    ex = block.get("exemplar_trace_id")
+    if not isinstance(ex, str) or not ex:
+        problems.append(
+            f"{name}: signal_plane.exemplar_trace_id = {ex!r} — the "
+            "fired alert must carry a flight-recorder exemplar"
+        )
+    if block.get("liar_flagged_ok") is not True:
+        problems.append(
+            f"{name}: signal_plane.liar_flagged_ok = "
+            f"{block.get('liar_flagged_ok')!r} — the ACK-wall "
+            "cross-check must flag the lying worker"
+        )
+    if block.get("liar_self_report_clean") is not True:
+        problems.append(
+            f"{name}: signal_plane.liar_self_report_clean = "
+            f"{block.get('liar_self_report_clean')!r} — the liar's "
+            "self-reported walls must have LOOKED healthy (otherwise "
+            "the cross-check proved nothing)"
+        )
+    if block.get("ledger_survived_ok") is not True:
+        problems.append(
+            f"{name}: signal_plane.ledger_survived_ok = "
+            f"{block.get('ledger_survived_ok')!r} — a firing alert "
+            "must survive leader kill and resolve on the promoted "
+            "leader"
+        )
+    if block.get("replay_deterministic_ok") is not True:
+        problems.append(
+            f"{name}: signal_plane.replay_deterministic_ok = "
+            f"{block.get('replay_deterministic_ok')!r} — the same "
+            "seed must produce a byte-identical alert stream"
+        )
+    if block.get("signal_ok") is not True:
+        problems.append(
+            f"{name}: signal_plane.signal_ok = "
+            f"{block.get('signal_ok')!r} — the section's own verdict "
+            "must be true"
+        )
+    return problems
+
+
+def run_signal_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_signal_block(artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
 # exists and parses (ISSUE 4 satellite; VERDICT r5 item 1)
@@ -1745,6 +1868,9 @@ def main() -> None:
     for problem in run_elastic_check(art_path):
         total += 1
         print(f"elastic block: {problem}")
+    for problem in run_signal_check(art_path):
+        total += 1
+        print(f"signal block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")

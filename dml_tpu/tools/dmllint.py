@@ -91,6 +91,15 @@ so fixture trees exercise them selectively):
   ``dml_tpu/`` (outside tracing.py itself) is flagged as unverifiable
   — stage names in the attribution table must not be able to drift
   from the instrumentation.
+- ``drift-alert-names`` — every literal ``fire_alert("<name>", ...)``
+  / ``resolve_alert("<name>", ...)`` call site in the tree must use a
+  name declared in ``dml_tpu/signal.py``'s ``ALERT_NAMES`` registry
+  (the closed alert vocabulary operators page on); a registered name
+  no call site emits is flagged, and a NON-literal alert name in
+  ``dml_tpu/`` (outside signal.py itself, whose manager/driver
+  machinery passes names through variables by design) is flagged as
+  unverifiable — the pager catalog must not be able to drift from the
+  emission sites.
 
 Flow-aware rules (implemented in the sibling ``dmlflow`` module — see
 its docstring for the full semantics and recognized suppressions):
@@ -146,6 +155,7 @@ R_METRICS = "drift-metrics-map"
 R_SUMMARY = "drift-summary-keys"
 R_MARKERS = "drift-pytest-markers"
 R_SPANS = "drift-span-names"
+R_ALERTS = "drift-alert-names"
 # flow-aware passes (implemented in the sibling dmlflow module)
 R_RACE = "race-yield-hazard"
 R_PAYLOAD = "drift-wire-payloads"
@@ -153,7 +163,7 @@ R_STALE = "baseline-stale"
 
 ALL_RULES = (
     R_NAKED, R_SILENT, R_BLOCKING, R_UNSEEDED,
-    R_WIRE, R_METRICS, R_SUMMARY, R_MARKERS, R_SPANS,
+    R_WIRE, R_METRICS, R_SUMMARY, R_MARKERS, R_SPANS, R_ALERTS,
     R_RACE, R_PAYLOAD, R_STALE,
 )
 
@@ -947,6 +957,103 @@ def rule_spans(root: str, trees: Dict[str, ast.Module]) -> List[Finding]:
 
 
 # ----------------------------------------------------------------------
+# drift-alert-names
+# ----------------------------------------------------------------------
+
+SIGNAL_REL = "dml_tpu/signal.py"
+
+_ALERT_CALLS = ("fire_alert", "resolve_alert")
+
+
+def collect_alert_call_sites(
+    trees: Dict[str, ast.Module],
+) -> Tuple[Dict[str, List[Tuple[str, int]]], List[Tuple[str, int]]]:
+    """-> (alert name -> [(path, line), ...] for every LITERAL
+    ``fire_alert("<name>", ...)`` / ``resolve_alert("<name>", ...)``
+    call, [(path, line), ...] of non-literal call sites). Unlike the
+    span rule, signal.py itself is NOT excluded from literal
+    collection — its SignalPlane monitors are the primary emitters —
+    but its dynamic sites (the ``_drive`` dispatcher, the manager
+    pass-throughs) are the machinery's own and are filtered in
+    ``check_alert_names``."""
+    literal: Dict[str, List[Tuple[str, int]]] = {}
+    dynamic: List[Tuple[str, int]] = []
+    for rel, tree in sorted(trees.items()):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node.func) not in _ALERT_CALLS:
+                continue
+            name_arg: Optional[ast.AST] = (
+                node.args[0] if node.args else None
+            )
+            if name_arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_arg = kw.value
+            if isinstance(name_arg, ast.Constant) and isinstance(
+                name_arg.value, str
+            ):
+                literal.setdefault(name_arg.value, []).append(
+                    (rel, node.lineno)
+                )
+            else:
+                dynamic.append((rel, node.lineno))
+    return literal, dynamic
+
+
+def check_alert_names(
+    registry: Optional[Dict[str, int]],
+    literal: Dict[str, List[Tuple[str, int]]],
+    dynamic: List[Tuple[str, int]],
+    signal_rel: str,
+) -> List[Finding]:
+    fs: List[Finding] = []
+
+    def f(path: str, line: int, subject: str, msg: str) -> None:
+        fs.append(Finding(path=path, line=line, rule=R_ALERTS, msg=msg,
+                          key=f"{R_ALERTS}:{subject}"))
+
+    if registry is None:
+        f(signal_rel, 1, "no-registry",
+          "signal.py has no module-level ALERT_NAMES tuple — the alert "
+          "vocabulary must be declared where the linter (and the "
+          "on-call runbook) can see it")
+        return fs
+    for name, sites in sorted(literal.items()):
+        if name not in registry:
+            path, line = sites[0]
+            f(path, line, f"unregistered:{name}",
+              f"fire_alert/resolve_alert({name!r}) uses an alert name "
+              "not declared in signal.ALERT_NAMES — add it to the "
+              "registry first, or the pager catalog silently gains an "
+              "undocumented page")
+    for name, line in sorted(registry.items()):
+        if name not in literal:
+            f(signal_rel, line, f"unused:{name}",
+              f"ALERT_NAMES entry {name!r} has no fire_alert/"
+              "resolve_alert call site — an alert the catalog promises "
+              "but nothing ever emits")
+    for path, line in dynamic:
+        if path.startswith("dml_tpu/") and path != signal_rel:
+            f(path, line, f"dynamic:{path}:{line}",
+              "fire_alert/resolve_alert with a non-literal name cannot "
+              "be checked against ALERT_NAMES — pass the registry "
+              "constant directly so the alert vocabulary stays closed")
+    return fs
+
+
+def rule_alerts(root: str, trees: Dict[str, ast.Module]) -> List[Finding]:
+    if SIGNAL_REL not in trees:
+        return []
+    literal, dynamic = collect_alert_call_sites(trees)
+    return check_alert_names(
+        _module_const_strs(trees[SIGNAL_REL], "ALERT_NAMES"),
+        literal, dynamic, SIGNAL_REL,
+    )
+
+
+# ----------------------------------------------------------------------
 # drift-pytest-markers
 # ----------------------------------------------------------------------
 
@@ -1186,7 +1293,8 @@ def run_lint(
         trees[rel] = _parse(path, rel)  # raises LintInternalError
         findings.extend(analyze_tree(trees[rel], rel))
     for rule_fn in (rule_wire, rule_metrics, rule_summary, rule_markers,
-                    rule_spans, dmlflow.rule_race, dmlflow.rule_payloads):
+                    rule_spans, rule_alerts,
+                    dmlflow.rule_race, dmlflow.rule_payloads):
         findings.extend(rule_fn(root, trees))
     filtered = bool(rules) or bool(paths)
     if rules:

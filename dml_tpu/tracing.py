@@ -477,9 +477,21 @@ class Tracer:
             ]
         return out
 
-    def exemplar_trace_ids(self) -> List[str]:
+    def exemplar_trace_ids(self, kind: Optional[str] = None) -> List[str]:
+        """Pinned exemplar traces, oldest first. ``kind`` filters to
+        traces holding at least one span with that event (the signal
+        plane attaches the freshest ``deadline_miss`` exemplar to a
+        deadline-burn alert, not merely a recent shed)."""
         with self._lock:
-            return list(self._exemplars)
+            if kind is None:
+                return list(self._exemplars)
+            return [
+                tid for tid, spans in self._exemplars.items()
+                if any(
+                    e[0] == kind
+                    for s in spans for e in s.get("ev", ())
+                )
+            ]
 
     def stats(self) -> Dict[str, Any]:
         """Flight-recorder accounting (the bench's budget verdict):

@@ -75,4 +75,5 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "scale: control-plane scale coverage (bounded delta gossip, relay metrics aggregation, O(100)-node sims, sustained churn)")
     config.addinivalue_line("markers", "kvcache: KV prefix-cache coverage (warm-start decode from resident slabs, suffix-only prefill, budgeted eviction, session affinity relay)")
     config.addinivalue_line("markers", "elastic: elastic-membership coverage (authenticated runtime join/leave, versioned universe, adaptive group re-formation, capacity-change chaos)")
+    config.addinivalue_line("markers", "signal: SLO signal-plane coverage (windowed time-series, burn-rate monitors, straggler cross-checks, typed alert lifecycle)")
 

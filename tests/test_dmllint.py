@@ -21,11 +21,13 @@ from dml_tpu.tools.dmllint import (
     LintInternalError,
     analyze_source,
     apply_baseline,
+    check_alert_names,
     check_markers,
     check_metrics,
     check_span_names,
     check_summary,
     check_wire,
+    collect_alert_call_sites,
     collect_metric_registrations,
     collect_span_call_sites,
     collect_tracing_literals,
@@ -469,6 +471,95 @@ def test_span_name_drift_detected():
         collect_tracing_literals(tr), "dml_tpu/tracing.py",
     )
     assert not any("non-literal" in f.msg for f in fs3)
+
+
+# ----------------------------------------------------------------------
+# drift-alert-names
+# ----------------------------------------------------------------------
+
+SIGNAL_FIXTURE = textwrap.dedent("""
+    ALERT_NAMES = (
+        "slo_burn_rate",   # emitted below
+        "phantom_alert",   # registered, never emitted anywhere
+    )
+
+    class SignalPlane:
+        def _drive(self, name, labels):
+            # machinery passes names through variables by design —
+            # dynamic sites inside signal.py are NOT findings
+            self.alerts.fire_alert(name, labels)
+
+        def burn(self):
+            self.fire_alert("slo_burn_rate", {"slo": "interactive"})
+""")
+
+ALERT_USER_FIXTURE = textwrap.dedent("""
+    def ok(plane):
+        plane.resolve_alert("slo_burn_rate", {"slo": "batch"})
+
+    def bad(plane):
+        plane.fire_alert("undeclared_page", {})
+
+    def dynamic(plane, name):
+        plane.fire_alert(name, {})
+""")
+
+
+def test_alert_name_extractors():
+    trees = {
+        "dml_tpu/signal.py": ast.parse(SIGNAL_FIXTURE),
+        "dml_tpu/jobs/x.py": ast.parse(ALERT_USER_FIXTURE),
+    }
+    literal, dynamic = collect_alert_call_sites(trees)
+    assert set(literal) == {"slo_burn_rate", "undeclared_page"}
+    # BOTH dynamic sites are collected (signal.py's own included);
+    # the signal.py one is exempted by check_alert_names, not here
+    assert {p for p, _ in dynamic} == {
+        "dml_tpu/signal.py", "dml_tpu/jobs/x.py"
+    }
+
+
+def test_alert_name_drift_detected():
+    sig = ast.parse(SIGNAL_FIXTURE)
+    trees = {
+        "dml_tpu/signal.py": sig,
+        "dml_tpu/jobs/x.py": ast.parse(ALERT_USER_FIXTURE),
+    }
+    literal, dynamic = collect_alert_call_sites(trees)
+    fs = check_alert_names(
+        dmllint._module_const_strs(sig, "ALERT_NAMES"),
+        literal, dynamic, "dml_tpu/signal.py",
+    )
+    msgs = " | ".join(f.msg for f in fs)
+    # unknown literal name at a call site
+    assert "'undeclared_page'" in msgs
+    # registered name nothing ever emits
+    assert "'phantom_alert'" in msgs
+    # signal.py's OWN literal emission counts as used
+    assert "'slo_burn_rate'" not in msgs
+    # exactly one non-literal finding: the user module's, not the
+    # manager machinery's own dispatcher
+    dyn = [f for f in fs if "non-literal" in f.msg]
+    assert [f.path for f in dyn] == ["dml_tpu/jobs/x.py"]
+    # missing registry degrades to its own finding
+    fs2 = check_alert_names(None, literal, dynamic, "dml_tpu/signal.py")
+    assert any("no module-level ALERT_NAMES" in f.msg for f in fs2)
+    # tests/ may pass computed names (only dml_tpu/ is gated)
+    fs3 = check_alert_names(
+        dmllint._module_const_strs(sig, "ALERT_NAMES"),
+        {"slo_burn_rate": [("tests/t.py", 3)],
+         "phantom_alert": [("tests/t.py", 4)]},
+        [("tests/t.py", 9)], "dml_tpu/signal.py",
+    )
+    assert not fs3
+
+
+def test_alert_rule_skips_fixture_trees_without_signal():
+    # fixture trees without dml_tpu/signal.py exercise other rules
+    # without tripping a no-registry finding
+    assert dmllint.rule_alerts(
+        ".", {"dml_tpu/jobs/x.py": ast.parse(ALERT_USER_FIXTURE)}
+    ) == []
 
 
 # ----------------------------------------------------------------------

@@ -94,6 +94,41 @@ def test_summarize_sheds_are_rejections_excluded_from_latency():
     assert s["by_class"]["interactive"]["n"] == 6
 
 
+@pytest.mark.ingress
+def test_summarize_degenerate_inputs():
+    # zero outcomes at all: every count 0, every percentile None (not
+    # NaN — NaN would poison downstream JSON and burn-rate math)
+    s = loadgen.summarize([], wall_s=5.0)
+    assert (s["n"], s["completed"], s["shed"], s["rejected"]) == (0, 0, 0, 0)
+    assert s["goodput_qps"] == 0.0 and s["shed_ratio"] == 0.0
+    assert s["latency_ms"] == {"p50": None, "p95": None, "p99": None}
+    assert s["by_class"] == {}
+
+    # all-shed trace: zero completions but nonzero rows — shed_ratio
+    # is 1.0 and the latency distribution stays empty/None
+    shed_only = [
+        Outcome(slo="batch", terminal="shed", reason="queue_full")
+        for _ in range(4)
+    ]
+    s = loadgen.summarize(shed_only, wall_s=10.0)
+    assert s["completed"] == 0 and s["shed"] == 4
+    assert s["shed_ratio"] == pytest.approx(1.0)
+    assert s["goodput_qps"] == 0.0
+    assert s["latency_ms"]["p99"] is None
+    assert s["by_class"]["batch"]["shed_ratio"] == pytest.approx(1.0)
+
+    # single completed sample: every percentile collapses to it
+    one = [Outcome(slo="interactive", terminal="completed", e2e_s=0.25,
+                   deadline_met=True)]
+    s = loadgen.summarize(one, wall_s=10.0)
+    assert s["latency_ms"]["p50"] == pytest.approx(250.0)
+    assert s["latency_ms"]["p95"] == pytest.approx(250.0)
+    assert s["latency_ms"]["p99"] == pytest.approx(250.0)
+
+    # zero wall: goodput guarded to 0.0, never a division error
+    assert loadgen.summarize(one, wall_s=0.0)["goodput_qps"] == 0.0
+
+
 # ----------------------------------------------------------------------
 # admission math (pure, deterministic)
 # ----------------------------------------------------------------------

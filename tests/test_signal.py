@@ -1,0 +1,463 @@
+"""SLO signal-plane coverage: windowed time-series, burn-rate
+monitors + hysteresis, health scoring / straggler cross-check, and the
+typed alert lifecycle — units under injected clocks, plus one live
+cluster pass over the ALERT relay + ALERT_PULL wire surface."""
+
+import asyncio
+import contextlib
+import json
+import os
+import shutil
+
+import pytest
+
+from dml_tpu.signal import (
+    ALERT_NAMES,
+    AlertManager,
+    BurnRateMonitor,
+    BurnRatePolicy,
+    HealthScorer,
+    HistWindow,
+    Hysteresis,
+    MetricWindow,
+    WindowSet,
+    replay_alert_stream,
+)
+
+pytestmark = pytest.mark.signal
+
+
+# ----------------------------------------------------------------------
+# (a) windowed time-series
+# ----------------------------------------------------------------------
+
+def test_metric_window_geometry_validated():
+    with pytest.raises(ValueError):
+        MetricWindow(width_s=1.0, stride_s=0.0)
+    with pytest.raises(ValueError):
+        MetricWindow(width_s=0.5, stride_s=1.0)
+
+
+def test_metric_window_delta_rate_over_cumulative_series():
+    w = MetricWindow(width_s=10.0, stride_s=1.0)
+    # cumulative counter advancing 5/s for 8 ticks
+    for i in range(9):
+        w.observe(float(i), 5.0 * i)
+    assert w.last() == 40.0
+    assert w.delta(8.0) == 40.0
+    assert w.rate(8.0) == pytest.approx(5.0)
+    # a narrower query window sees only its own span
+    assert w.delta(8.0, window_s=3.0) == pytest.approx(15.0)
+    # single-sample / empty windows answer 0, never NaN
+    assert MetricWindow().delta(0.0) == 0.0
+    assert MetricWindow().rate(0.0) == 0.0
+
+
+def test_metric_window_same_bucket_replaces_and_old_buckets_retire():
+    w = MetricWindow(width_s=3.0, stride_s=1.0)
+    w.observe(0.2, 1.0)
+    w.observe(0.9, 2.0)  # same stride bucket: replaced, not appended
+    assert w.to_dict()["samples"] == [[0.0, 2.0]]
+    for t in (1.0, 2.0, 3.0, 4.0):
+        w.observe(t, t)
+    # ring bound retires buckets beyond width_s
+    assert len(w.to_dict()["samples"]) <= 4
+    # non-monotonic observation is dropped, never reordered
+    w.observe(1.0, 99.0)
+    assert all(v != 99.0 for _, v in w._buckets)
+
+
+def test_metric_window_trend_recovers_gauge_slope():
+    w = MetricWindow(width_s=30.0, stride_s=1.0)
+    for i in range(10):
+        w.observe(float(i), 3.0 + 0.5 * i)
+    assert w.trend(9.0) == pytest.approx(0.5)
+    flat = MetricWindow(width_s=30.0, stride_s=1.0)
+    for i in range(10):
+        flat.observe(float(i), 7.0)
+    assert flat.trend(9.0) == pytest.approx(0.0)
+
+
+def test_metric_window_determinism_same_inputs_same_dict():
+    def drive():
+        w = MetricWindow(width_s=20.0, stride_s=0.5)
+        for i in range(50):
+            w.observe(i * 0.5, (i * 37) % 11)
+        return w
+
+    a, b = drive(), drive()
+    assert a.to_dict() == b.to_dict()
+    assert a.delta(25.0) == b.delta(25.0)
+    assert a.trend(25.0) == b.trend(25.0)
+
+
+def test_hist_window_windowed_quantile_ignores_old_mass():
+    edges = [0.1, 0.5, 1.0, 5.0]
+    h = HistWindow(edges, width_s=10.0, stride_s=1.0)
+    # old regime: 100 fast samples in bucket 0 (≤ 0.1s)
+    h.observe(0.0, 100.0, 5.0, {"0": 100.0})
+    # new regime: 20 more samples, all slow (bucket 3: 1.0..5.0s)
+    h.observe(8.0, 120.0, 65.0, {"0": 100.0, "3": 20.0})
+    q = h.quantile(0.5, now=8.0)
+    # the windowed diff sees only the 20 slow samples
+    assert q is not None and q > 1.0
+    # no mass inside the window -> None, not a made-up number
+    assert HistWindow(edges).quantile(0.5, now=0.0) is None
+
+
+def test_window_set_samples_readers_on_injected_clock():
+    t = {"now": 0.0}
+    vals = {"x": 0.0}
+    ws = WindowSet(clock=lambda: t["now"], width_s=30.0, stride_s=1.0)
+    ws.track("x", lambda: vals["x"])
+    for i in range(6):
+        t["now"] = float(i)
+        vals["x"] = 10.0 * i
+        ws.sample()
+    w = ws.window("x")
+    assert w is not None and w.last() == 50.0
+    assert w.rate(5.0) == pytest.approx(10.0)
+    # a reader that raises is skipped, not fatal
+    ws.track("boom", lambda: 1 / 0)
+    ws.sample(now=6.0)
+    assert ws.window("boom").last() is None
+
+
+# ----------------------------------------------------------------------
+# (b) hysteresis + burn-rate monitors
+# ----------------------------------------------------------------------
+
+def test_hysteresis_debounces_and_band_resets_streaks():
+    h = Hysteresis(fire_after=2, clear_after=3)
+    assert h.update(True) is None          # 1 of 2
+    assert h.update(None) is None          # inside the band: reset
+    assert h.update(True) is None          # back to 1 of 2
+    assert h.update(True) == "fire"
+    assert h.firing
+    assert h.update(True) is None          # refire is not a transition
+    assert h.update(False) is None         # 1 of 3
+    assert h.update(False) is None         # 2 of 3
+    assert h.update(None) is None          # band: clear streak resets
+    assert h.update(False) is None
+    assert h.update(False) is None
+    assert h.update(False) == "resolve"
+    assert not h.firing
+
+
+def test_burn_monitor_fires_on_sustained_burn_and_respects_min_events():
+    pol = BurnRatePolicy(budget=0.02, short_s=5.0, long_s=20.0,
+                         fire_after=2, clear_after=3, min_events=8)
+    bad = MetricWindow(width_s=60.0, stride_s=1.0)
+    total = MetricWindow(width_s=60.0, stride_s=1.0)
+    mon = BurnRateMonitor(pol)
+    fired_at = None
+    # 20% bad at 10 qps -> burn = 0.2/0.02 = 10x in both windows
+    for i in range(30):
+        t = float(i)
+        total.observe(t, 10.0 * i)
+        bad.observe(t, 2.0 * i)
+        if mon.evaluate(t, bad, total) == "fire":
+            fired_at = t
+            break
+    assert fired_at is not None
+    assert mon.last["burn_short"] >= pol.fire_burn
+    assert mon.hyst.firing
+
+    # near-zero traffic must read "not burning", not NaN/inf
+    quiet = BurnRateMonitor(pol)
+    qb = MetricWindow(width_s=60.0, stride_s=1.0)
+    qt = MetricWindow(width_s=60.0, stride_s=1.0)
+    for i in range(10):
+        qt.observe(float(i), 0.2 * i)  # 2 events total, all bad
+        qb.observe(float(i), 0.2 * i)
+        assert quiet.evaluate(float(i), qb, qt) is None
+    assert quiet.last["burn_short"] == 0.0
+
+
+def test_burn_monitor_resolves_after_burn_drains():
+    pol = BurnRatePolicy(budget=0.02, short_s=4.0, long_s=8.0,
+                         fire_after=1, clear_after=2, min_events=4)
+    bad = MetricWindow(width_s=30.0, stride_s=1.0)
+    total = MetricWindow(width_s=30.0, stride_s=1.0)
+    mon = BurnRateMonitor(pol)
+    events = []
+    b = 0.0
+    for i in range(40):
+        t = float(i)
+        b += 3.0 if i < 10 else 0.0  # burst of bads, then clean
+        total.observe(t, 10.0 * i)
+        bad.observe(t, b)
+        tr = mon.evaluate(t, bad, total)
+        if tr:
+            events.append((tr, t))
+    assert [e for e, _ in events] == ["fire", "resolve"]
+
+
+# ----------------------------------------------------------------------
+# (c) health scoring + straggler cross-check
+# ----------------------------------------------------------------------
+
+def test_zscores_flag_honest_straggler_and_need_three_workers():
+    hs = HealthScorer(min_samples=4)
+    for _ in range(8):
+        hs.observe_ack("w1", 0.05, 0.04, n_items=4)
+        hs.observe_ack("w2", 0.05, 0.042, n_items=4)
+    # two workers: not enough pool for a meaningful z
+    assert all(z == 0.0 for z in hs.zscores().values())
+    for _ in range(8):
+        hs.observe_ack("w3", 0.05, 0.044, n_items=4)
+        hs.observe_ack("slow", 2.1, 2.0, n_items=4)  # honest: obs≈rep
+    zs = hs.zscores()
+    assert zs["slow"] > hs.z_fire
+    assert abs(zs["w1"]) < hs.z_fire
+    # honest straggler is NOT a liar: reported walls match observed
+    assert "slow" not in hs.liars()
+    scores = hs.scores()
+    assert scores["slow"]["score"] < scores["w1"]["score"]
+
+
+def test_crosscheck_convicts_liar_on_whole_batch_walls():
+    hs = HealthScorer(ratio=1.4, abs_margin_s=0.25, min_samples=4)
+    # liar: really takes ~1s per batch, reports ~2ms
+    for _ in range(3):
+        hs.observe_ack("liar", 1.0, 0.002, n_items=8)
+    assert hs.crosscheck("liar") is None  # below min_samples
+    hs.observe_ack("liar", 1.0, 0.002, n_items=8)
+    ev = hs.crosscheck("liar")
+    assert ev is not None
+    assert ev["observed_s"] > ev["reported_s"] * hs.ratio + hs.abs_margin_s
+    assert ev["samples"] >= hs.min_samples
+    # ...while its SELF-REPORTED walls keep its z unremarkable
+    for _ in range(6):
+        hs.observe_ack("a", 0.05, 0.002, n_items=8)
+        hs.observe_ack("b", 0.05, 0.002, n_items=8)
+    assert abs(hs.zscores()["liar"]) < hs.z_fire
+    assert hs.scores()["liar"]["liar"] is True
+    assert hs.scores()["liar"]["score"] == 0.0
+    # honest fast worker with slow network is under the margin
+    hs2 = HealthScorer()
+    for _ in range(8):
+        hs2.observe_ack("ok", 0.2, 0.15, n_items=4)
+    assert hs2.crosscheck("ok") is None
+    # forget drops the evidence
+    hs.forget("liar")
+    assert hs.crosscheck("liar") is None
+
+
+# ----------------------------------------------------------------------
+# (d) typed alert lifecycle
+# ----------------------------------------------------------------------
+
+def _mgr(t):
+    return AlertManager(clock=lambda: t["now"])
+
+
+def test_alert_registry_is_closed():
+    mgr = AlertManager(clock=lambda: 0.0)
+    # built at runtime so dmllint's drift-alert-names literal scan
+    # doesn't read the deliberately-bad name as a real call site
+    bogus = "_".join(("totally", "new", "alert"))
+    with pytest.raises(ValueError):
+        mgr.fire_alert(bogus)
+    with pytest.raises(ValueError):
+        mgr.resolve_alert(bogus)
+    with pytest.raises(ValueError):
+        mgr.fire_alert(ALERT_NAMES[0], severity="page-me")
+
+
+def test_alert_lifecycle_dedup_and_exemplar_adoption():
+    t = {"now": 1.0}
+    mgr = _mgr(t)
+    assert mgr.fire_alert("slo_burn_rate", {"slo": "interactive"},
+                          summary="burning") is True
+    assert mgr.is_firing("slo_burn_rate", {"slo": "interactive"})
+    # dedup: same name+labels while firing bumps count, returns False
+    t["now"] = 2.0
+    assert mgr.fire_alert("slo_burn_rate", {"slo": "interactive"},
+                          severity="critical",
+                          exemplar="trace-1") is False
+    row = mgr.active()[0]
+    assert row["count"] == 2 and row["last"] == 2.0
+    assert row["severity"] == "critical"   # escalated in place
+    assert row["exemplar"] == "trace-1"    # adopted when absent
+    # distinct labels are a distinct alert
+    assert mgr.fire_alert("slo_burn_rate", {"slo": "batch"}) is True
+    assert len(mgr.active()) == 2
+    # resolve is a transition once, then idempotent
+    t["now"] = 3.0
+    assert mgr.resolve_alert("slo_burn_rate", {"slo": "interactive"})
+    assert not mgr.resolve_alert("slo_burn_rate", {"slo": "interactive"})
+    assert not mgr.is_firing("slo_burn_rate", {"slo": "interactive"})
+    # resolved rows stay in the ledger; rows() orders by seq and
+    # resolving bumps the row's seq past the still-firing batch row
+    assert [r["state"] for r in mgr.rows()] == ["firing", "resolved"]
+    assert [e["event"] for e in mgr.stream()] == [
+        "fire", "fire", "resolve"]
+    assert [e["seq"] for e in mgr.stream()] == [1, 2, 3]
+
+
+def test_alert_transition_observers_see_fire_and_resolve():
+    t = {"now": 0.0}
+    mgr = _mgr(t)
+    seen = []
+    mgr.on_transition.append(
+        lambda ev, row: seen.append((ev["event"], row["name"])))
+    mgr.fire_alert("node_unhealthy", {"node": "w0"})
+    mgr.fire_alert("node_unhealthy", {"node": "w0"})  # dedup: no event
+    mgr.resolve_alert("node_unhealthy", {"node": "w0"})
+    assert seen == [("fire", "node_unhealthy"),
+                    ("resolve", "node_unhealthy")]
+
+
+def test_alert_adopt_is_newest_wins_and_drops_malformed():
+    t = {"now": 5.0}
+    mgr = _mgr(t)
+    mgr.fire_alert("metrics_liar", {"node": "w1"}, now=5.0)
+    local = mgr.rows()[0]
+    assert mgr.adopt([
+        # stale copy of the local row: ignored
+        {**local, "state": "resolved", "last": 1.0},
+        # newer resolved copy: wins
+        {**local, "state": "resolved", "last": 9.0, "seq": 7},
+        # malformed / unregistered: dropped, not raised
+        {"name": "not_an_alert", "state": "firing", "last": 9.0},
+        {"name": "metrics_liar", "state": "weird", "last": 9.0},
+        "not-a-dict",
+    ]) == 1
+    assert not mgr.is_firing("metrics_liar", {"node": "w1"})
+    # seq high-water adopted so later local transitions keep ordering
+    mgr.fire_alert("metrics_liar", {"node": "w2"}, now=10.0)
+    assert mgr.rows()[-1]["seq"] == 8
+
+
+def test_alert_ledger_bound_evicts_resolved_first():
+    mgr = AlertManager(clock=lambda: 0.0, max_alerts=2)
+    mgr.fire_alert("node_unhealthy", {"node": "a"}, now=1.0)
+    mgr.resolve_alert("node_unhealthy", {"node": "a"}, now=2.0)
+    mgr.fire_alert("node_unhealthy", {"node": "b"}, now=3.0)
+    mgr.fire_alert("node_unhealthy", {"node": "c"}, now=4.0)
+    names = {tuple(r["labels"].items()) for r in mgr.rows()}
+    assert (("node", "a"),) not in names  # resolved row evicted first
+    assert len(mgr.rows()) == 2
+
+
+# ----------------------------------------------------------------------
+# replay determinism — the bench's byte-identical claim, in miniature
+# ----------------------------------------------------------------------
+
+def _synth_ticks(n=120):
+    ticks = []
+    bad, total = {"interactive": 0.0, "batch": 0.0}, \
+                 {"interactive": 0.0, "batch": 0.0}
+    for i in range(n):
+        tick = {}
+        for scope in ("interactive", "batch"):
+            total[scope] += 10.0
+            if scope == "interactive" and 20 <= i < 45:
+                bad[scope] += 6.0
+            tick[scope] = {"bad": bad[scope], "total": total[scope],
+                           "exemplar": f"trace-{scope}-{i}"}
+        ticks.append(tick)
+    return ticks
+
+
+def test_replay_alert_stream_is_byte_deterministic():
+    s1 = replay_alert_stream(_synth_ticks())
+    s2 = replay_alert_stream(_synth_ticks())
+    assert json.dumps(s1, sort_keys=True) == json.dumps(s2, sort_keys=True)
+    fires = [e for e in s1 if e["event"] == "fire"]
+    resolves = [e for e in s1 if e["event"] == "resolve"]
+    assert fires and resolves
+    # only the scope that burned fired, with its exemplar attached
+    assert all(e["labels"] == {"slo": "interactive"} for e in fires)
+    assert all(e["exemplar"] for e in fires)
+    # quiet schedule -> empty stream
+    assert replay_alert_stream(
+        [{"batch": {"bad": 0.0, "total": 10.0 * i}} for i in range(30)]
+    ) == []
+
+
+# ----------------------------------------------------------------------
+# wire surface: standby relay + ALERT_PULL (live cluster)
+# ----------------------------------------------------------------------
+
+@contextlib.asynccontextmanager
+async def _cluster(n, base_port, tmp_path):
+    from dml_tpu.cluster.chaos import LocalCluster
+
+    root = str(tmp_path / f"signal_{base_port}")
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root)
+    c = LocalCluster(n, root, base_port)
+    try:
+        await c.start()
+        await c.wait_for(c.converged, 15.0, "initial convergence")
+        yield c
+    finally:
+        await c.stop()
+
+
+async def test_alert_relay_and_alert_pull_wire(tmp_path):
+    """A leader-fired alert relays to the standby's ledger (the
+    failover inheritance path) and ALERT_PULL serves ledger + events +
+    health rollup to any member over one request/reply MsgType."""
+    from dml_tpu.cluster.wire import MsgType
+
+    async with _cluster(3, 23960, tmp_path) as c:
+        leader_sn = next(
+            sn for sn in c.nodes.values() if sn.node.is_leader
+        )
+        sp = leader_sn.jobs.signal
+        assert sp.fire_alert(
+            "node_unhealthy", {"node": "w9"},
+            severity="warning", summary="relay test", exemplar="t-relay",
+        )
+        # standby adopts the relayed firing row
+        sb = leader_sn.node.standby_node()
+        assert sb is not None
+        standby_sp = c.nodes[sb.unique_name].jobs.signal
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 5.0
+        while loop.time() < deadline and not standby_sp.alerts.is_firing(
+            "node_unhealthy", {"node": "w9"}
+        ):
+            await asyncio.sleep(0.1)
+        assert standby_sp.alerts.is_firing("node_unhealthy", {"node": "w9"})
+        adopted = standby_sp.alerts.rows()[0]
+        assert adopted["exemplar"] == "t-relay"
+
+        # ALERT_PULL from a non-leader member
+        other = next(
+            sn for sn in c.nodes.values()
+            if not sn.node.is_leader
+            and sn.node.me.unique_name != sb.unique_name
+        )
+        ledger = await other.node.leader_request(
+            MsgType.ALERT_PULL, {"max_events": 8}, timeout=5.0
+        )
+        assert ledger["ok"] is True
+        assert ledger["node"] == leader_sn.node.me.unique_name
+        row = next(
+            r for r in ledger["alerts"] if r["name"] == "node_unhealthy"
+        )
+        assert row["state"] == "firing" and row["exemplar"] == "t-relay"
+        assert [e["event"] for e in ledger["events"]] == ["fire"]
+        assert set(ledger["health"]) == {"nodes", "monitors", "firing"}
+        assert ledger["health"]["firing"] == 1
+
+        # resolve relays too, and the pull reflects it
+        assert sp.resolve_alert("node_unhealthy", {"node": "w9"})
+        deadline = loop.time() + 5.0
+        while loop.time() < deadline and standby_sp.alerts.is_firing(
+            "node_unhealthy", {"node": "w9"}
+        ):
+            await asyncio.sleep(0.1)
+        assert not standby_sp.alerts.is_firing(
+            "node_unhealthy", {"node": "w9"}
+        )
+        ledger2 = await other.node.leader_request(
+            MsgType.ALERT_PULL, {"max_events": 8}, timeout=5.0
+        )
+        assert ledger2["health"]["firing"] == 0
+        assert [e["event"] for e in ledger2["events"]] == [
+            "fire", "resolve"]
