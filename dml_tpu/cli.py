@@ -768,7 +768,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "2%% loss + duplicate delivery)")
     pc.add_argument("--scenario", default=None,
                     choices=["asym", "disk", "dns", "skew", "fuzz",
-                             "churn", "elastic", "liar"],
+                             "churn", "elastic", "liar", "autoscale"],
                     help="run one adversarial scenario family: "
                          "asym(metric partition), disk(-full + "
                          "corruption), dns (introducer outage during "
@@ -779,7 +779,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "forged-join storm), liar (a worker whose "
                          "self-reported batch walls understate its "
                          "real walls — the signal plane's ACK-wall "
-                         "cross-check must catch it)")
+                         "cross-check must catch it), autoscale "
+                         "(controller-aimed chaos: thrashing load, "
+                         "liar-fed policy, scale-in racing a spike, "
+                         "leader kill mid-decision)")
     pc.add_argument("--plan", default=None, metavar="FILE",
                     help="replay a saved plan JSON instead of generating")
     pc.add_argument("--dump", default=None, metavar="FILE",
@@ -816,6 +819,30 @@ def main(argv: Optional[List[str]] = None) -> None:
                         help="metrics relay count (default ~sqrt(N))")
     pscale.add_argument("--base-port", type=int, default=26001)
     pscale.add_argument("-v", "--verbose", action="store_true")
+
+    pas = sub.add_parser(
+        "autoscale",
+        help="diurnal autoscale probe: replay a seeded "
+             "ramp-plateau-trough open-loop trace against an "
+             "in-process cluster (autoscaled or statically "
+             "provisioned) and print SLO-violation-minutes / "
+             "chip-idle-minutes / decision counts as JSON (the bench "
+             "autoscale section runs both arms and compares)",
+    )
+    pas.add_argument("--seed", type=int, default=5,
+                     help="trace seed (same seed = byte-identical "
+                          "arrival schedule)")
+    pas.add_argument("--mode", choices=["autoscaled", "static"],
+                     default="autoscaled",
+                     help="autoscaled = floor-sized pool plus the "
+                          "closed-loop controller; static = fixed "
+                          "mid-provisioned pool, no controller")
+    pas.add_argument("--duration", type=float, default=52.0,
+                     help="trace duration seconds")
+    pas.add_argument("--base-qps", type=float, default=3.0)
+    pas.add_argument("--peak-qps", type=float, default=90.0)
+    pas.add_argument("--base-port", type=int, default=27001)
+    pas.add_argument("-v", "--verbose", action="store_true")
 
     args = p.parse_args(argv)
     if args.command == "lint":
@@ -864,6 +891,17 @@ def main(argv: Optional[List[str]] = None) -> None:
             measure_s=args.measure_s,
             metrics_relays=args.relays,
         ), indent=2))
+    elif args.command == "autoscale":
+        from .cluster.chaos import diurnal_probe
+
+        print(json.dumps(asyncio.run(diurnal_probe(
+            args.seed,
+            args.base_port,
+            mode=args.mode,
+            duration_s=args.duration,
+            base_qps=args.base_qps,
+            peak_qps=args.peak_qps,
+        )), indent=2, sort_keys=True))
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -54,6 +54,7 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
+from ..autoscale import AutoscalePolicy, slo_violation_minutes
 from ..config import ClusterSpec, NodeId, StoreConfig, Timing
 from ..config import join_mac as _join_mac
 from ..observability import METRICS
@@ -105,6 +106,50 @@ SCALE_TIMING = Timing(
 #: model served by the deterministic stub backend (a registry CNN so
 #: the coordinator's intake accepts it without register_lm)
 STUB_MODEL = "ResNet50"
+
+#: controller knobs for the chaos/bench envelopes: the product
+#: defaults (autoscale.AutoscalePolicy) debounce in tens of seconds, a
+#: chaos plan lives for ~15 — same shape, faster clocks. floor=2 on a
+#: 5-node plan (pool 3: leader + standby are not schedulable slots)
+#: leaves exactly one slot of legitimate scale-in headroom; the
+#: signal stride under FAST_TIMING is 0.25 s, so out_fire_after=2
+#: means half a second of SUSTAINED pressure before capacity moves —
+#: the hysteresis the thrash square-wave attacks
+CHAOS_AUTOSCALE_POLICY = AutoscalePolicy(
+    floor=2,
+    ceiling=6,
+    backlog_per_slot=2.0,
+    idle_arrival_qps=0.5,
+    out_fire_after=2,
+    out_clear_after=2,
+    in_fire_after=6,
+    in_clear_after=1,
+    confirm_ticks=2,
+    out_cooldown_s=3.0,
+    in_cooldown_s=5.0,
+    realloc_cooldown_s=8.0,
+    apply_timeout_s=10.0,
+)
+
+#: the diurnal bench arm's knobs: floor 2 / ceiling 4 schedulable
+#: slots around a static mid-provisioned baseline of 3, and an
+#: idleness bar (idle_arrival_qps) sized so the trace's TROUGH rate
+#: reads as idle while its plateau never does
+DIURNAL_AUTOSCALE_POLICY = AutoscalePolicy(
+    floor=2,
+    ceiling=4,
+    backlog_per_slot=2.0,
+    idle_arrival_qps=8.0,
+    out_fire_after=2,
+    out_clear_after=2,
+    in_fire_after=2,
+    in_clear_after=1,
+    confirm_ticks=1,
+    out_cooldown_s=2.0,
+    in_cooldown_s=2.0,
+    realloc_cooldown_s=8.0,
+    apply_timeout_s=10.0,
+)
 
 
 def _child_seed(seed: int, tag: str) -> int:
@@ -201,9 +246,14 @@ EVENT_KINDS = (
 #: forged-join storm — is claim_check-gated from round 18;
 #: "liar" — a lying-metrics straggler whose self-reported walls stay
 #: clean while batches stall, flaggable only by the signal plane's
-#: dispatch->ACK cross-check — is claim_check-gated from round 19)
+#: dispatch->ACK cross-check — is claim_check-gated from round 19;
+#: "autoscale" — chaos aimed at the CLOSED-LOOP CONTROLLER itself:
+#: thrashing square-wave load against the scale-out hysteresis, a
+#: lying straggler feeding the policy, a scale-in racing a traffic
+#: spike, and a leader kill between a decision firing and its
+#: actuation ACK — is claim_check-gated from round 20)
 SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz", "churn",
-                     "elastic", "liar")
+                     "elastic", "liar", "autoscale")
 
 
 @dataclass(frozen=True)
@@ -258,6 +308,12 @@ class ChaosPlan:
     #: (authenticated runtime join/leave); the elastic scenario
     #: family needs it, everything else keeps the static universe
     join_secret: str = ""
+    #: arm the closed-loop autoscaler: every node's controller gets
+    #: the chaos policy (CHAOS_AUTOSCALE_POLICY) and real actuators
+    #: (LocalCluster.scale_out / scale_in), and the invariant sweep
+    #: adds the decision-plane checks — exactly-once actuation, pool
+    #: never decided below floor, no in-flight batch on a retiree
+    autoscale: bool = False
 
     def __post_init__(self):
         object.__setattr__(
@@ -278,6 +334,8 @@ class ChaosPlan:
         }
         if self.join_secret:
             out["join_secret"] = self.join_secret
+        if self.autoscale:
+            out["autoscale"] = True
         return out
 
     @classmethod
@@ -288,6 +346,7 @@ class ChaosPlan:
             settle_s=float(d.get("settle_s", 1.0)),
             name=str(d.get("name", "chaos")),
             join_secret=str(d.get("join_secret", "")),
+            autoscale=bool(d.get("autoscale", False)),
             events=tuple(
                 event(e["t"], e["kind"], e.get("target"),
                       **e.get("args", {}))
@@ -573,6 +632,14 @@ def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
       rejection counters without admitting a phantom, and a genesis
       worker leaves gracefully — retired from the table immediately,
       never read as an outage.
+    - ``autoscale``: chaos aimed at the closed-loop CONTROLLER
+      (plan.autoscale arms it with real actuators): a thrashing
+      square wave of job bursts attacks the scale-out hysteresis, a
+      lying-metrics straggler manufactures backlog the liar guard
+      must refuse to pay chips for, a quiet window baits a scale-in
+      proposal that a traffic spike then races, and the leader is
+      killed in the decision window — the promoted leader inherits
+      the relayed ledger and must not actuate any decision twice.
 
     Timings are seed-jittered: one seed reproduces one schedule,
     different seeds explore different interleavings.
@@ -591,6 +658,45 @@ def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
         event(j(0.1, 0.3), "put", name=seed_file, size=1024),
         event(j(0.4, 0.6), "job", n=16),
     ]
+    if family == "autoscale":
+        events += [
+            # phase 1 — thrash: square-wave bursts with gaps shorter
+            # than the idle streak, so a well-hysteresed controller
+            # rides them out with AT MOST the capacity the sustained
+            # envelope justifies (no scale-out/scale-in ping-pong)
+            event(j(0.9, 1.1), "job", n=256),
+            event(j(1.4, 1.6), "job", n=256),
+            event(j(2.8, 3.0), "job", n=256),
+            event(j(3.3, 3.5), "job", n=256),
+            # phase 2 — liar-fed policy: the straggler manufactures
+            # backlog while its self-reported walls stay clean; once
+            # the cross-check convicts it, scale-out pressure is
+            # MASKED (suppressed, reason="liar"), then the heal
+            # releases the guard
+            event(j(4.2, 4.4), "liar", "worker",
+                  extra_s=round(rng.uniform(0.6, 0.9), 2)),
+            event(j(4.7, 4.9), "job", n=64),
+            event(j(5.5, 5.7), "job", n=64),
+            event(j(6.5, 6.7), "liar", "liar", extra_s=0.0),
+            # phase 3 — scale-in racing a spike: the quiet window
+            # here baits an idle proposal; this burst lands around
+            # its confirm window, so (seed-dependent) the proposal is
+            # either CANCELLED (typed cancel, reason="spike") or the
+            # already-actuated LEAVE completes and the pool shrink
+            # re-arms the pressure path within one evaluation window
+            event(j(9.3, 9.6), "job", n=256),
+            # phase 4 — controller-aimed kill: the leader dies inside
+            # the decision window; the promoted leader inherits the
+            # relayed ledger (cooldowns + in-flight rows) and must
+            # settle each decision id exactly once, by observation
+            event(j(10.3, 10.6), "crash", "leader"),
+            event(j(12.2, 12.6), "job", n=24),
+        ]
+        return ChaosPlan(seed=seed, events=tuple(events),
+                         n_nodes=n_nodes, settle_s=2.5,
+                         name=f"autoscale-{seed}",
+                         join_secret=f"chaos-autoscale-{seed}",
+                         autoscale=True)
     if family == "elastic":
         events += [
             event(j(0.9, 1.1), "job", n=20),
@@ -886,6 +992,9 @@ class LocalCluster:
         services: str = "full",
         gossip_protocol: Optional[str] = None,
         join_secret: str = "",
+        autoscale: bool = False,
+        autoscale_policy: Optional[AutoscalePolicy] = None,
+        backend_per_file_s: float = 0.004,
     ):
         """`worker_groups` (config.WorkerGroupSpec list) pools nodes
         into tensor-parallel serving groups (jobs/groups.py); the
@@ -916,7 +1025,19 @@ class LocalCluster:
         `join_secret` (non-empty) turns the elastic join policy ON:
         every node joins through the authenticated JOIN_REQUEST path,
         `scale_out` can admit brand-new nodes mid-run, and `scale_in`
-        retires them (or genesis workers) through graceful LEAVE."""
+        retires them (or genesis workers) through graceful LEAVE.
+
+        `autoscale=True` arms every node's AutoscaleController with
+        REAL capacity: its decisions drive this cluster's `scale_out`
+        / `scale_in` (every node gets the wiring because leadership
+        moves — only the current leader's controller evaluates).
+        `autoscale_policy` overrides the product-default knobs
+        (chaos/bench envelopes install CHAOS_AUTOSCALE_POLICY).
+
+        `backend_per_file_s` sets the stub backend's per-file wall —
+        the default 4ms keeps chaos runs snappy; the diurnal probe
+        slows it so a realistic open-loop trace can genuinely
+        saturate a small pool."""
         if services not in ("full", "store", "core"):
             raise ValueError(f"unknown services mode {services!r}")
         self.root = root
@@ -948,6 +1069,9 @@ class LocalCluster:
         self.joined_ever: List[str] = []
         self.joined_live: List[str] = []
         self._join_port = base_port + n_nodes + 100
+        self.autoscale = autoscale
+        self.autoscale_policy = autoscale_policy
+        self.backend_per_file_s = backend_per_file_s
         self._make_jobs = make_jobs or self._default_jobs
         self.with_ingress = with_ingress
         self.ingress_formation = ingress_formation
@@ -1001,7 +1125,9 @@ class LocalCluster:
                     },
                 )
         js = JobService(
-            node, store, infer_backend=stub_backend(), group_backend=gb
+            node, store,
+            infer_backend=stub_backend(self.backend_per_file_s),
+            group_backend=gb,
         )
         js.scheduler.set_batch_size(STUB_MODEL, self.batch_size)
         if self.with_ingress:
@@ -1045,6 +1171,8 @@ class LocalCluster:
             )
         if self.services == "full":
             jobs = self._make_jobs(node, store)
+            if self.autoscale:
+                self._wire_autoscale(jobs)
             if self.with_ingress:
                 from ..ingress.router import RequestRouter
 
@@ -1110,6 +1238,37 @@ class LocalCluster:
         await self.dns.stop()
 
     # ---- elastic capacity (authenticated runtime join/leave) ----
+
+    def _wire_autoscale(self, jobs: Any) -> None:
+        """Arm one node's AutoscaleController with this cluster's real
+        capacity machinery. Applied to every started node — genesis,
+        restarts, and runtime joiners alike — so whichever node leads
+        after a failover actuates against the same environment."""
+        ctl = getattr(jobs, "autoscale", None)
+        if ctl is None:
+            return
+        if self.autoscale_policy is not None:
+            ctl.configure(self.autoscale_policy)
+
+        async def admit() -> None:
+            try:
+                await self.scale_out(group=None)
+            except Exception:
+                log.exception("autoscale scale_out actuation failed")
+
+        async def retire(uname: str) -> None:
+            try:
+                await self.scale_in(uname)
+            except ValueError:
+                # already gone: the duplicate-LEAVE race (actuate
+                # relayed, effect raced the failover) is benign — the
+                # ledger settles by observing the universe, not this
+                pass
+            except Exception:
+                log.exception("autoscale scale_in actuation failed")
+
+        ctl.scale_out_fn = admit
+        ctl.scale_in_fn = retire
 
     async def scale_out(
         self,
@@ -1755,6 +1914,72 @@ async def invariant_sweep(
                     "never moved"
                 )
 
+    # 8. closed-loop autoscaler integrity (plans that armed the
+    # controller): across the UNION of every live node's decision
+    # stream, no decision id was applied or actuated twice (the
+    # exactly-once-across-failover contract — a promoted leader must
+    # inherit the relayed ledger, not re-fire it); no scale-in was
+    # ever DECIDED at or below the pool floor (a crash shrinking the
+    # pool is not a decision); and no retired node still owns
+    # in-flight or staged batches on the live leader's scheduler (a
+    # LEAVE whose work was never requeued)
+    if getattr(cluster, "autoscale", False):
+        ev_counts: Dict[str, Dict[str, int]] = {}
+        all_rows: Dict[str, List[Dict[str, Any]]] = {}
+        floors: List[int] = []
+        floor = None
+        for uname, sn in sorted(cluster.nodes.items()):
+            if sn.jobs is None:
+                continue
+            ctl = sn.jobs.autoscale
+            floor = ctl.policy.floor if floor is None else floor
+            if ctl.min_pool_seen is not None:
+                floors.append(ctl.min_pool_seen)
+            for e in ctl.ledger.stream():
+                per = ev_counts.setdefault(e["id"], {})
+                per[e["event"]] = per.get(e["event"], 0) + 1
+            for r in ctl.ledger.rows():
+                all_rows.setdefault(r["id"], []).append(r)
+        kinds: Dict[str, int] = {}
+        for rows in all_rows.values():
+            k = rows[0]["kind"]
+            kinds[k] = kinds.get(k, 0) + 1
+        dup = sorted(
+            f"{did}:{ev}" for did, per in ev_counts.items()
+            for ev, c in per.items()
+            if ev in ("apply", "actuate") and c > 1
+        )
+        if dup:
+            failures.append(
+                f"autoscale decision settled/actuated twice: {dup}"
+            )
+        below = sorted({
+            r["id"] for rows in all_rows.values() for r in rows
+            if r["kind"] == "scale_in" and floor is not None
+            and int(r["detail"].get("pool_n", floor + 1)) <= floor
+        })
+        if below:
+            failures.append(
+                f"scale-in decided at/below the pool floor: {below}"
+            )
+        if leader_sn is not None and leader_sn.jobs is not None:
+            live = set(cluster.nodes)
+            orphaned = sorted(
+                (set(leader_sn.jobs.scheduler.in_progress)
+                 | set(leader_sn.jobs.scheduler.prefetch)) - live
+            )
+            if orphaned:
+                failures.append(
+                    "retired/dead nodes still hold in-flight batches "
+                    f"on the leader: {orphaned}"
+                )
+        checks["autoscale"] = {
+            "decision_rows": kinds,
+            "distinct_ids": len(all_rows),
+            "min_pool_seen": min(floors) if floors else None,
+            "floor": floor,
+        }
+
     return InvariantReport(ok=not failures, failures=failures, checks=checks)
 
 
@@ -2286,6 +2511,10 @@ async def run_plan(
     cluster = LocalCluster(
         plan.n_nodes, root, base_port, seed=plan.seed, timing=timing,
         services=services, join_secret=plan.join_secret,
+        autoscale=plan.autoscale,
+        autoscale_policy=(
+            CHAOS_AUTOSCALE_POLICY if plan.autoscale else None
+        ),
     )
     try:
         await cluster.start()
@@ -2304,6 +2533,186 @@ def run_plan_sync(plan: ChaosPlan, base_port: int,
         run_plan(plan, base_port, root=root, timing=timing,
                  services=services)
     )
+
+
+# ----------------------------------------------------------------------
+# diurnal provisioning probe (the autoscaler's headline measurement)
+# ----------------------------------------------------------------------
+
+
+async def diurnal_probe(
+    seed: int,
+    base_port: int,
+    root: Optional[str] = None,
+    mode: str = "autoscaled",
+    n_nodes: Optional[int] = None,
+    duration_s: float = 52.0,
+    base_qps: float = 3.0,
+    peak_qps: float = 90.0,
+    deadline_s: float = 3.0,
+    per_file_s: float = 0.04,
+    policy: Optional[AutoscalePolicy] = None,
+    timing: Timing = FAST_TIMING,
+) -> Dict[str, Any]:
+    """One arm of the diurnal provisioning comparison: drive a seeded
+    ramp–plateau–trough open-loop trace (``loadgen.diurnal_trace``)
+    through a stub ingress cluster and score it on the two integrals
+    an operator actually pays for — SLO-violation-minutes and
+    chip-idle-minutes.
+
+    ``mode="static"`` runs the mid-provisioned baseline: a fixed pool
+    of 3 schedulable slots (5 nodes minus leader + standby), sized
+    between the diurnal trough and peak the way a capacity plan
+    without elasticity has to be. ``mode="autoscaled"`` starts at the
+    controller's floor (2 slots from 4 nodes) with the closed loop
+    armed: the ramp's burn/backlog pressure admits standby capacity
+    through the authenticated join path (ceiling 4), and the trough
+    retires idle slots by graceful LEAVE back to the floor. The
+    autoscaled arm must beat static on BOTH integrals — more capacity
+    than the baseline exactly while the trace needs it, less while it
+    doesn't — with zero restarts and a green invariant sweep.
+
+    Both arms share the trace seed, the SLO class (a ``deadline_s``
+    interactive class), the slowed stub backend (``per_file_s`` —
+    sized so the plateau genuinely saturates a 3-slot pool: at 40ms a
+    file an 8-wide batch holds a slot 0.32s, ~25 q/s per slot), and
+    the timing envelope; only the provisioning policy differs."""
+    from ..ingress import loadgen
+    from ..ingress.slo import SLOClass
+
+    if mode not in ("static", "autoscaled"):
+        raise ValueError(f"unknown diurnal mode {mode!r}")
+    autoscaled = mode == "autoscaled"
+    pol = policy or DIURNAL_AUTOSCALE_POLICY
+    n = n_nodes if n_nodes is not None else (4 if autoscaled else 5)
+    own_root = root is None
+    root = root or os.path.join(
+        "/tmp", f"dml_tpu_diurnal_{os.getpid()}_{base_port}"
+    )
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root, exist_ok=True)
+    cluster = LocalCluster(
+        n, root, base_port, seed=seed, timing=timing,
+        with_ingress=True,
+        ingress_classes={
+            "interactive": SLOClass(
+                "interactive", deadline_s=deadline_s,
+                queue_limit=64, linger_s=0.0),
+        },
+        join_secret=f"diurnal-{seed}" if autoscaled else "",
+        autoscale=autoscaled,
+        autoscale_policy=pol if autoscaled else None,
+        backend_per_file_s=per_file_s,
+    )
+    trace = loadgen.diurnal_trace(
+        seed, duration_s=duration_s, base_qps=base_qps,
+        peak_qps=peak_qps, model=STUB_MODEL,
+        ramp_frac=0.2, plateau_frac=0.3,
+    )
+    out: Dict[str, Any] = {
+        "mode": mode, "seed": seed, "n_nodes": n,
+        "trace": {
+            "duration_s": duration_s, "base_qps": base_qps,
+            "peak_qps": peak_qps, "deadline_s": deadline_s,
+            "arrivals": len(trace.arrivals),
+        },
+    }
+    loop = asyncio.get_running_loop()
+    idle_slot_s = 0.0
+    pool_lo = pool_hi = None
+    stop_sampling = asyncio.Event()
+
+    async def sample_idle() -> None:
+        """Integrate idle capacity: every tick, schedulable slots the
+        CURRENT leader sees minus the slots holding in-flight/staged
+        batches. The same accounting runs in both arms, so the
+        comparison is apples-to-apples even though the stub's 'chip'
+        is a coroutine."""
+        nonlocal idle_slot_s, pool_lo, pool_hi
+        dt = 0.25
+        while not stop_sampling.is_set():
+            u = cluster.leader_uname()
+            sn = cluster.nodes.get(u) if u else None
+            if sn is not None and sn.jobs is not None:
+                slots = len(sn.jobs.worker_pool())
+                busy = len(
+                    set(sn.jobs.scheduler.in_progress)
+                    | set(sn.jobs.scheduler.prefetch)
+                )
+                idle_slot_s += max(0, slots - busy) * dt
+                pool_lo = slots if pool_lo is None else min(pool_lo, slots)
+                pool_hi = slots if pool_hi is None else max(pool_hi, slots)
+            try:
+                await asyncio.wait_for(stop_sampling.wait(), dt)
+            except asyncio.TimeoutError:
+                pass
+
+    try:
+        await cluster.start()
+        await cluster.wait_for(cluster.converged, 20.0,
+                               "diurnal probe convergence")
+        client = cluster.client()
+        # a pool of distinct pre-put inputs, round-robined across
+        # requests: the dispatch path dedups a batch to its UNIQUE
+        # files, so same-input arrivals would collapse to one decode
+        # and no open-loop rate could ever saturate the pool
+        n_inputs = 64
+        for k in range(n_inputs):
+            await client.store.put_bytes(
+                f"diurnal_{k:03d}.jpg", b"stub-bytes", timeout=20.0
+            )
+        seq = {"i": 0}
+
+        async def submit_one(a):
+            # drive through the CURRENT leader's front door: the
+            # leader is never a scale-in victim (not a pool slot), so
+            # the client seat can't be retired out from under the
+            # open loop mid-trace
+            u = cluster.leader_uname()
+            sn = cluster.nodes.get(u) if u else None
+            if sn is None:
+                sn = cluster.client()
+            seq["i"] += 1
+            return await loadgen.drive_one(
+                sn.ingress, a,
+                store_name=f"diurnal_{seq['i'] % n_inputs:03d}.jpg",
+                submit_timeout=8.0, wait_timeout=45.0,
+            )
+
+        sampler = asyncio.create_task(sample_idle(), name="diurnal-idle")
+        outcomes, wall = await loadgen.run_open_loop(submit_one, trace)
+        stop_sampling.set()
+        await sampler
+        summ = loadgen.summarize(outcomes, wall)
+        out["outcomes"] = {
+            "n": summ["n"], "completed": summ["completed"],
+            "shed": summ["shed"],
+            "shed_ratio": summ["shed_ratio"],
+            "wall_s": round(wall, 2),
+        }
+        out["slo_violation_min"] = slo_violation_minutes(trace, outcomes)
+        out["chip_idle_min"] = round(idle_slot_s / 60.0, 4)
+        out["pool"] = {"min": pool_lo, "max": pool_hi}
+        out["restarts"] = cluster._restart_counter
+        if autoscaled:
+            u = cluster.leader_uname()
+            ctl = cluster.nodes[u].jobs.autoscale if u else None
+            if ctl is not None:
+                kinds: Dict[str, int] = {}
+                for r in ctl.ledger.rows():
+                    if r["state"] == "applied":
+                        kinds[r["kind"]] = kinds.get(r["kind"], 0) + 1
+                out["decisions_applied"] = kinds
+                out["min_pool_seen"] = ctl.min_pool_seen
+        sweep = await invariant_sweep(cluster, {}, {}, timeout=30.0)
+        out["sweep_ok"] = sweep.ok
+        if not sweep.ok:
+            out["sweep_failures"] = sweep.failures[:4]
+    finally:
+        await cluster.stop()
+        if own_root:
+            shutil.rmtree(root, ignore_errors=True)
+    return out
 
 
 # ----------------------------------------------------------------------

@@ -145,6 +145,12 @@ class Node:
         )
         # services hook these (wired by store/job services at attach)
         self.on_node_failed_cbs: List[Callable[[str], None]] = []
+        # graceful-LEAVE observers: fired (on every node applying the
+        # universe removal) IN ADDITION to on_node_failed_cbs when a
+        # departure is a scale-in, not a crash — the router purges its
+        # session-affinity rows here and the autoscaler settles its
+        # in-flight scale-in decisions
+        self.on_node_left_cbs: List[Callable[[str], None]] = []
         self.on_coordinate_ack_cbs: List[Callable[[str, Dict], None]] = []
         self.on_replication_needed_cbs: List[Callable[[List[str]], None]] = []
         self.on_became_leader_cbs: List[Callable[[], None]] = []
@@ -647,6 +653,11 @@ class Node:
             self.membership.retire(gone)
             self._missed_acks.pop(gone, None)
             for cb in self.on_node_failed_cbs:
+                cb(gone)
+            # a universe removal is always a graceful departure (a
+            # crash only marks membership failed; the table entry
+            # stays) — tell the leave-specific observers too
+            for cb in self.on_node_left_cbs:
                 cb(gone)
         self._universe_changed()
         return True
@@ -1625,6 +1636,8 @@ class Node:
         self.membership.retire(msg.sender)
         self._missed_acks.pop(msg.sender, None)
         for cb in self.on_node_failed_cbs:
+            cb(msg.sender)
+        for cb in self.on_node_left_cbs:
             cb(msg.sender)
         for cb in self.on_replication_needed_cbs:
             cb([msg.sender])

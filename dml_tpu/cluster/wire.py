@@ -103,6 +103,7 @@ Refresh the key lists with ``python -m dml_tpu.tools.dmlflow``.
     LEAVE: epoch? mac? nonce?
     ALERT: event? row?
     ALERT_PULL: alerts? error? events? health? max_events? node? ok? truncated? * <- ALERT_PULL
+    AUTOSCALE: cooldowns? event? row?
 """
 
 from __future__ import annotations
@@ -303,6 +304,15 @@ class MsgType(enum.IntEnum):
     # error). The CLI `health` / `alerts` verbs ride it.
     ALERT = 120
     ALERT_PULL = 121
+    # closed-loop autoscaler (dml_tpu/autoscale.py): the leader's
+    # fire-and-forget decision-ledger relay to the hot standby (the
+    # ALERT discipline applied to autoscale decisions): every
+    # propose/apply/cancel transition ships its row plus the per-kind
+    # cooldown ledger, so a promoted leader inherits in-flight
+    # decisions and cooldowns and settles each decision id exactly
+    # once across the failover. No pull type: the CLI `autoscale`
+    # verb runs a local diurnal probe rather than querying a cluster.
+    AUTOSCALE = 130
 
 
 # ----------------------------------------------------------------------
@@ -422,6 +432,8 @@ HANDLER_OWNERS: Dict["MsgType", str] = {
     # through to request handling (the DOWNLOAD_FILE_SUCCESS shape)
     MsgType.ALERT: "SignalPlane",
     MsgType.ALERT_PULL: "SignalPlane",
+    # closed-loop autoscaler
+    MsgType.AUTOSCALE: "AutoscaleController",
 }
 
 

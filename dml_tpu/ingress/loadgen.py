@@ -151,6 +151,80 @@ def open_loop_trace(
     )
 
 
+def diurnal_trace(
+    seed: int,
+    duration_s: float,
+    base_qps: float,
+    peak_qps: float,
+    model: str = "stub",
+    slo_mix: Optional[Dict[str, float]] = None,
+    ramp_frac: float = 0.25,
+    plateau_frac: float = 0.35,
+    session_pct: float = 0.0,
+    n_sessions: int = 8,
+    stream_pct: float = 0.0,
+) -> ArrivalTrace:
+    """Seeded RAMP–PLATEAU–TROUGH arrivals — the diurnal curve the
+    autoscaler bench scores static provisioning against. The rate
+    envelope climbs from ``base_qps`` to ``peak_qps`` over the first
+    ``ramp_frac`` of the run, holds the peak for ``plateau_frac``,
+    ramps back down over another ``ramp_frac``, and idles at
+    ``base_qps`` for the remaining trough. Arrivals are a
+    non-homogeneous Poisson process drawn by THINNING against the peak
+    rate — candidate gaps at ``peak_qps``, each kept with probability
+    ``rate(t)/peak_qps`` — so every draw still comes from one
+    ``random.Random(seed)`` in arrival order and the whole trace
+    replays byte-identically (same JSON round-trip contract as
+    ``open_loop_trace``). Per-request SLO-class / session / stream
+    draws match ``open_loop_trace``'s."""
+    rng = random.Random(seed)
+    base = max(0.0, float(base_qps))
+    peak = max(base, float(peak_qps))
+    r = max(0.0, float(ramp_frac)) * duration_s
+    p = max(0.0, float(plateau_frac)) * duration_s
+
+    def rate(t: float) -> float:
+        if r > 0 and t < r:
+            return base + (peak - base) * (t / r)
+        if t < r + p:
+            return peak
+        if r > 0 and t < 2 * r + p:
+            return peak - (peak - base) * ((t - r - p) / r)
+        return base
+
+    mix = list((slo_mix or {"interactive": 1.0}).items())
+    total_w = sum(w for _, w in mix) or 1.0
+    arrivals: List[Arrival] = []
+    t = 0.0
+    while peak > 0:
+        t += rng.expovariate(peak)
+        if t >= duration_s:
+            break
+        if rng.random() * peak >= rate(t):
+            continue  # thinned: the envelope is below peak here
+        x = rng.random() * total_w
+        slo = mix[-1][0]
+        for name, w in mix:
+            if x < w:
+                slo = name
+                break
+            x -= w
+        session = (
+            f"s{rng.randrange(n_sessions)}"
+            if rng.random() * 100.0 < session_pct else None
+        )
+        stream = rng.random() * 100.0 < stream_pct
+        arrivals.append(Arrival(
+            t=round(t, 6), model=model, slo=slo,
+            session=session, stream=stream,
+        ))
+    mean = len(arrivals) / duration_s if duration_s > 0 else 0.0
+    return ArrivalTrace(
+        seed=seed, duration_s=float(duration_s),
+        rate_qps=round(mean, 6), arrivals=arrivals,
+    )
+
+
 def multi_turn_trace(
     seed: int,
     n_sessions: int,
@@ -406,6 +480,7 @@ async def drive_one(
     ingress,
     a: Arrival,
     *,
+    store_name: Optional[str] = None,
     submit_timeout: float = 8.0,
     wait_timeout: float = 45.0,
     deadline_by_class: Optional[Dict[str, float]] = None,
@@ -417,13 +492,19 @@ async def drive_one(
     use (one copy, so a LOST terminal is classified identically
     everywhere). e2e is measured CLIENT-side (includes the submit
     round trip); ``deadline_by_class`` overrides the router's
-    deadline_met with the client-side clock when provided."""
+    deadline_met with the client-side clock when provided.
+    ``store_name`` pins the request to a specific pre-put store input
+    instead of the router's sampled default — drivers that need
+    per-request work (the diurnal provisioning probe) spread requests
+    over distinct inputs so batch-level file dedup cannot collapse
+    their cost."""
     from .router import RequestRejected
 
     t0 = now()
     try:
         rid = await ingress.submit(
-            a.model, slo=a.slo, session=a.session, stream=a.stream,
+            a.model, slo=a.slo, store_name=store_name,
+            session=a.session, stream=a.stream,
             timeout=submit_timeout,
         )
     except RequestRejected as e:

@@ -110,7 +110,8 @@ _M_AFF_MISSES = METRICS.counter(
     "or demoted holder, or an evicted session row)")
 _M_AFF_EVICT = METRICS.counter(
     "request_session_affinity_evictions_total",
-    "session->worker rows evicted from the router's bounded map")
+    "session->worker rows evicted, per reason= (bound pressure, or a "
+    "purge when the holder leaves gracefully / fails)")
 
 
 def _terminal_kind(terminal: Any) -> str:
@@ -303,7 +304,7 @@ class RequestRouter:
         #: bound-forced evictions are counted (each one guarantees a
         #: prefix-cache miss on that session's next turn)
         self._session_node: BoundedDict = BoundedDict(
-            2000, on_evict=lambda _k: _M_AFF_EVICT.inc()
+            2000, on_evict=lambda _k: _M_AFF_EVICT.inc(reason="bound")
         )
         #: sessions whose binding changed since the last standby relay
         #: (failover-safe affinity: the rows piggyback on INGRESS_RELAY
@@ -353,6 +354,13 @@ class RequestRouter:
         self._register()
         jobs.on_job_done_cbs.append(self._on_job_done)
         self.node.on_became_leader_cbs.append(self._on_promoted)
+        # stale-affinity purge: a departed worker's session rows must
+        # go, or turn N+1 chases a ghost instead of cold-routing. The
+        # hook fires on EVERY node (router and standby relay copies
+        # alike), and the departure kind is read off the universe
+        # table: a graceful LEAVE removed the entry before callbacks
+        # fire, a crash leaves it in place.
+        self.node.on_node_failed_cbs.append(self._purge_sessions_for)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -823,6 +831,35 @@ class RequestRouter:
             if w:
                 rows.append([s, w])
         return rows
+
+    def _purge_sessions_for(self, uname: str) -> None:
+        """Drop every session->worker row pointing at a departed node
+        (on_node_failed hook; fires on router and standby copies
+        alike). Without this, a graceful scale-in of a worker holding
+        KV-prefix sessions leaves ghost rows: turn N+1 would "hit"
+        affinity for a node that no longer exists instead of cold-
+        routing to a live one. Purged rows leave `_session_dirty` too,
+        so a pending relay can't resurrect the binding on the standby."""
+        stale = [
+            s for s, w in list(self._session_node.items()) if w == uname
+        ]
+        if not stale:
+            return
+        # a LEAVE removed the node from the universe table before the
+        # callbacks fired; a crash leaves the table entry in place
+        reason = (
+            "leave"
+            if self.node.spec.node_by_unique_name(uname) is None
+            else "failure"
+        )
+        for s in stale:
+            self._session_node.pop(s, None)
+            self._session_dirty.discard(s)
+            _M_AFF_EVICT.inc(reason=reason)
+        log.info(
+            "%s: purged %d session-affinity rows for departed %s (%s)",
+            self._me, len(stale), uname, reason,
+        )
 
     def _flush_sessions(self) -> None:
         """Standalone INGRESS_RELAY carrying only session rows: a

@@ -606,6 +606,25 @@ class Scheduler:
         # weakly by the registry — dies with this scheduler.
         METRICS.add_collector(self._refresh_rate_gauges)
 
+    def reweight_classes(
+        self, weights: Dict[str, float]
+    ) -> Dict[str, float]:
+        """Replace the per-class fair-share split — the autoscaler's
+        capacity-reallocation actuation point. Weights must be positive
+        and finite (a zero or NaN weight would silently starve a class
+        forever, which is an outage, not a reallocation). The cross-
+        round deficit memory resets so the new split takes effect from
+        a clean slate instead of paying down debts accrued under the
+        old one. Returns the previous map."""
+        for k, v in weights.items():
+            w = float(v)
+            if not (w > 0.0) or w != w or w == float("inf"):
+                raise ValueError(f"bad class weight {k}={v!r}")
+        prev = dict(self.class_weights)
+        self.class_weights = {k: float(v) for k, v in weights.items()}
+        self._class_served.clear()
+        return prev
+
     # ------------------------------------------------------------------
     # model config
     # ------------------------------------------------------------------

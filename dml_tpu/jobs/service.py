@@ -45,6 +45,7 @@ from ..cluster.wire import Message, MsgType
 from ..models.registry import MODEL_REGISTRY, get_model
 from ..observability import METRICS
 from ..tracing import CURRENT_CTXS, TRACER, TraceContext
+from ..autoscale import AutoscaleController
 from ..signal import SignalPlane
 from .cost_model import ModelCost, overlap_headroom
 from .groups import GroupDirectory, note_group_requeue
@@ -339,6 +340,13 @@ class JobService:
         # SLO signal plane: windows sample on every node, burn/health
         # evaluation runs only while this node leads (signal.py)
         self.signal = SignalPlane(node, jobs=self)
+        # closed-loop autoscaler (autoscale.py): adopts relayed
+        # decisions everywhere, evaluates/actuates only while leading.
+        # The capacity actuators stay None until the environment (the
+        # chaos harness, the bench) wires real scale_out/scale_in
+        # verbs — a bare cluster still gets reallocation + a typed
+        # decision stream.
+        self.autoscale = AutoscaleController(node, jobs=self, plane=self.signal)
         # chaos seam (`liar` event): stall each batch for this many
         # seconds AFTER measuring exec_time, so the self-reported wall
         # stays clean while the leader's dispatch->ACK observation
@@ -370,6 +378,7 @@ class JobService:
             self._schedule_loop(), name=f"{self.node.me}-sched"
         )
         self.signal.start()
+        self.autoscale.start()
         interval = getattr(self.node.spec, "jobs_checkpoint_interval", 0.0)
         if interval and interval > 0:
             self._ckpt_task = asyncio.create_task(
@@ -407,6 +416,7 @@ class JobService:
                 log.exception("%s: auto checkpoint failed", self._me)
 
     async def stop(self) -> None:
+        await self.autoscale.stop()
         await self.signal.stop()
         ct = getattr(self, "_ckpt_task", None)
         if ct is not None:

@@ -140,6 +140,10 @@ line when you add the metric.
     alert_firing                     currently-firing alerts by name=
     alert_relays_total               ledger transitions relayed to standby
     alert_resolved_total             alert resolved transitions by name=
+    autoscale_decisions_total        decision-ledger transitions by kind= event=
+    autoscale_pool_size              worker-pool size the autoscaler last observed
+    autoscale_relays_total           decision events relayed to standby
+    autoscale_suppressed_total       decisions withheld by reason= (liar/floor/...)
     cluster_alive_nodes              SWIM live-member gauge
     cluster_failover_recovery_seconds  chaos: leader-kill -> converged wall
     cluster_false_positives_total    SWIM suspicions that proved alive

@@ -1376,6 +1376,60 @@ class SignalPlane:
             what="alert ledger",
         )
 
+    def autoscale_snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Controller-facing digest of the plane's current verdicts
+        (dml_tpu/autoscale.py consumes one per evaluation tick):
+        firing burn monitors, the SLO classes they convict, stalled
+        models, per-model queue backlog, per-class arrival rates, and
+        the liar/unhealthy node sets. Everything is sorted + rounded
+        so a recorded tick schedule is JSON-able and replays through
+        ``autoscale.replay_decision_stream`` byte-identically."""
+        ws = self.windows
+        t = ws.now() if now is None else float(now)
+        burn: List[str] = []
+        culprits: set = set()
+        stalled: List[str] = []
+        for (sig, scope), m in sorted(self.monitors.items()):
+            if not m.hyst.firing:
+                continue
+            burn.append(f"{sig}|{scope}")
+            if sig == "model_stall":
+                stalled.append(scope)
+            else:
+                culprits.add(scope)
+        backlog: Dict[str, float] = {}
+        arrivals: Dict[str, float] = {}
+        for key, w in sorted(ws._windows.items()):
+            if key.startswith("queued:"):
+                v = w.last()
+                if v:
+                    backlog[key.split(":", 1)[1]] = round(float(v), 2)
+            elif key.startswith("arrivals:"):
+                # lookback rides the window geometry (10 strides): the
+                # idleness verdict must clear within a few evaluation
+                # ticks of traffic actually stopping, at bench and
+                # product timescales alike
+                arrivals[key.split(":", 1)[1]] = round(
+                    w.rate(t, 10.0 * ws.stride_s), 4
+                )
+        liars = set(self.health.liars())
+        unhealthy: set = set()
+        for row in self.alerts.active():
+            if row["name"] == "metrics_liar":
+                liars.add(str(row["labels"].get("node")))
+            elif row["name"] == "node_unhealthy":
+                unhealthy.add(str(row["labels"].get("node")))
+        return {
+            "t": round(t, 3),
+            "burn_firing": burn,
+            "culprit_classes": sorted(culprits),
+            "stalled_models": sorted(stalled),
+            "backlog": backlog,
+            "arrivals_qps": arrivals,
+            "liars": sorted(liars),
+            "unhealthy": sorted(unhealthy),
+        }
+
     def health_summary(self) -> Dict[str, Any]:
         """The CLI ``health`` verb's payload: per-node scores plus the
         latest burn evaluation per monitor scope."""

@@ -353,7 +353,7 @@ CHAOS_SCENARIOS_REQUIRED_FROM_ROUND = 8
 #: cluster/chaos.py SCENARIO_FAMILIES — kept literal here so this
 #: tool stays importable without the cluster stack)
 CHAOS_SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz",
-                           "churn", "elastic", "liar")
+                           "churn", "elastic", "liar", "autoscale")
 
 #: "churn" (sustained seeded join/leave) landed with the round-12
 #: control-plane scale work; earlier artifacts predate the family
@@ -368,6 +368,12 @@ CHAOS_ELASTIC_REQUIRED_FROM_ROUND = 18
 #: real walls — the straggler cross-check's adversary) landed with
 #: the round-19 signal-plane work; earlier artifacts predate it
 CHAOS_LIAR_REQUIRED_FROM_ROUND = 19
+
+#: "autoscale" (controller-aimed chaos: thrashing load, liar-fed
+#: policy, scale-in racing a demand spike, leader kill mid-decision)
+#: landed with the round-20 autoscaler work; earlier artifacts
+#: predate the family
+CHAOS_AUTOSCALE_REQUIRED_FROM_ROUND = 20
 
 
 def check_chaos_block(path: str) -> List[str]:
@@ -441,6 +447,12 @@ def check_chaos_block(path: str) -> List[str]:
             fam == "liar"
             and rnd is not None
             and rnd < CHAOS_LIAR_REQUIRED_FROM_ROUND
+        ):
+            continue  # the family predates this artifact
+        if (
+            fam == "autoscale"
+            and rnd is not None
+            and rnd < CHAOS_AUTOSCALE_REQUIRED_FROM_ROUND
         ):
             continue  # the family predates this artifact
         entry = scenarios.get(fam)
@@ -1785,6 +1797,121 @@ def run_signal_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# round-20 closed-loop autoscaler: the diurnal provisioning duel and
+# the decision-stream determinism arm (bench _bench_autoscale;
+# ISSUE 17 tentpole)
+# ----------------------------------------------------------------------
+
+#: first round whose bench must carry the autoscale section; earlier
+#: artifacts predate the controller
+AUTOSCALE_REQUIRED_FROM_ROUND = 20
+
+
+def check_autoscale_block(path: str) -> List[str]:
+    """Validate the ``autoscale`` section WHEN IT RAN:
+
+    - the autoscaled arm beat static provisioning on BOTH integrals
+      of the shared diurnal trace — SLO-violation minutes AND
+      chip-idle minutes (winning only one is the provisioning
+      dilemma restated, not dissolved);
+    - neither arm restarted a node and both invariant sweeps came
+      back green (capacity moved through the authenticated join/
+      LEAVE path, never through crashes);
+    - the controller actually exercised the loop: at least one
+      applied scale-out AND one applied scale-in;
+    - the replay arm produced byte-identical decision streams from
+      the same snapshot schedule (the decision plane is a pure
+      function of its observations).
+
+    Artifacts before round ``AUTOSCALE_REQUIRED_FROM_ROUND`` are
+    exempt; summary-only driver captures gate on the compact line's
+    ``autoscale_ok`` / ``autoscale_slo_min_saved`` keys."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < AUTOSCALE_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        problems = []
+        if s.get("autoscale_ok") is False:
+            problems.append(
+                f"{name}: summary autoscale_ok is false — the "
+                "closed-loop arm lost the diurnal duel or the "
+                "decision stream went nondeterministic"
+            )
+        saved = s.get("autoscale_slo_min_saved")
+        if isinstance(saved, (int, float)) and saved <= 0:
+            problems.append(
+                f"{name}: summary autoscale_slo_min_saved = "
+                f"{saved!r} — the controller saved no SLO-violation "
+                "minutes over static provisioning"
+            )
+        return problems
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "autoscale" in not_run:
+        return []  # honestly recorded as skipped/errored
+    block = matrix.get("autoscale")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `autoscale` section and not recorded "
+                "as skipped (bench lost the diurnal duel?)"]
+    problems: List[str] = []
+    for key in ("autoscale_slo_min_saved", "autoscale_idle_min_saved"):
+        v = block.get(key)
+        if not isinstance(v, (int, float)) or v <= 0:
+            problems.append(
+                f"{name}: autoscale.{key} = {v!r} — the closed-loop "
+                "arm must beat static on BOTH diurnal integrals"
+            )
+    for arm in ("static", "autoscaled"):
+        sub = block.get(arm) or {}
+        if sub.get("restarts") != 0:
+            problems.append(
+                f"{name}: autoscale.{arm}.restarts = "
+                f"{sub.get('restarts')!r} — capacity must move "
+                "through join/LEAVE, never crashes"
+            )
+        if sub.get("sweep_ok") is not True:
+            problems.append(
+                f"{name}: autoscale.{arm}.sweep_ok = "
+                f"{sub.get('sweep_ok')!r} — the post-run invariant "
+                "sweep must be green"
+            )
+    applied = block.get("decisions_applied") or {}
+    for kind in ("scale_out", "scale_in"):
+        if not applied.get(kind):
+            problems.append(
+                f"{name}: autoscale.decisions_applied[{kind!r}] = "
+                f"{applied.get(kind)!r} — the diurnal trace must "
+                "exercise both directions of the loop"
+            )
+    if block.get("replay_deterministic_ok") is not True:
+        problems.append(
+            f"{name}: autoscale.replay_deterministic_ok = "
+            f"{block.get('replay_deterministic_ok')!r} — the same "
+            "snapshot schedule must produce a byte-identical "
+            "decision stream"
+        )
+    if block.get("autoscale_ok") is not True:
+        problems.append(
+            f"{name}: autoscale.autoscale_ok = "
+            f"{block.get('autoscale_ok')!r} — the section's own "
+            "verdict must be true"
+        )
+    return problems
+
+
+def run_autoscale_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_autoscale_block(
+        artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
 # exists and parses (ISSUE 4 satellite; VERDICT r5 item 1)
@@ -1871,6 +1998,9 @@ def main() -> None:
     for problem in run_signal_check(art_path):
         total += 1
         print(f"signal block: {problem}")
+    for problem in run_autoscale_check(art_path):
+        total += 1
+        print(f"autoscale block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")

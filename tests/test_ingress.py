@@ -759,6 +759,7 @@ def test_submit_cancellation_does_not_leak_futures(monkeypatch):
         node = SimpleNamespace(
             register=lambda *a, **k: None,
             on_became_leader_cbs=[],
+            on_node_failed_cbs=[],
             new_rid=lambda: "n#1",
             me=SimpleNamespace(unique_name="n:1"),
         )
