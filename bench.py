@@ -68,9 +68,12 @@ SECTION_EST_S = {
     "cluster_sharded_serving": 300.0,
     # CPU-subprocess: 5-node cluster, 4 sharded-LM serving forms
     # (param_gather / weight-resident / pipeline-parallel /
-    # disaggregated) + the whole-slab-vs-streamed handoff ladder with
-    # 1- and 2-peer fan-out + the member-kill-mid-stream chaos case
-    "cluster_lm_sharded": 560.0,
+    # disaggregated, with shipped-draft verification on the disagg
+    # form) + the whole-slab-vs-streamed handoff ladder with 1- and
+    # 2-peer fan-out + the member-kill-mid-stream chaos case + the
+    # round-21 raw-decode arms (speculative A/B at a declared
+    # acceptance w/ auto-disable, continuous-batching TTFT A/B)
+    "cluster_lm_sharded": 640.0,
     "lm": 450.0,
     "cluster_lm_serving": 210.0,  # + >=15 s steady-state refill phase
     "chaos": 230.0,  # 2 soak seeds + 7 adversarial scenario families
@@ -1451,6 +1454,7 @@ async def _kv_cache_phase(cluster, crashed_leader):
     )
     ttft_cold = mean_ttft_ms(cold_out)
     ttft_warm = mean_ttft_ms(warm_out)
+    warm_sum = loadgen.summarize(warm_out, 1.0)
     kv = {
         "model": spec["name"], "sessions": 3, "turns": 5,
         "trace_seed": 21,
@@ -1470,8 +1474,12 @@ async def _kv_cache_phase(cluster, crashed_leader):
         "warm_equals_cold": (
             cold_tx == warm_tx == expect and bool(cold_tx)
         ),
-        "by_turn_warm": loadgen.summarize(warm_out, 1.0).get("by_turn"),
+        "by_turn_warm": warm_sum.get("by_turn"),
         "by_turn_cold": loadgen.summarize(cold_out, 1.0).get("by_turn"),
+        # per-request TPOT percentiles over the warm sessions'
+        # client-observed stream chunks (loadgen Outcome.tpot_s):
+        # TTFT scores queue+prefill, this scores the decode loop
+        "tpot_ms_warm": warm_sum.get("tpot_ms"),
     }
     # ---- failover sub-case: leader killed MID-SESSION (warm) --------
     fail_trace = loadgen.multi_turn_trace(
@@ -3667,6 +3675,16 @@ def main() -> None:
             "cluster_lm_sharded", "stream_vs_slab_ttft"),
         "lm_fanout_speedup": g(
             "cluster_lm_sharded", "fanout_ctx_speedup"),
+        # round-21 raw-decode arms (inference/lm_sharded.py):
+        # speculative-vs-plain steady tok/s at the bench's declared
+        # acceptance, the MEASURED acceptance itself, and the
+        # continuous-batching overlap-adoption p99 TTFT under
+        # staggered sustained load
+        "lm_specdec_speedup": g(
+            "cluster_lm_sharded", "lm_specdec_speedup"),
+        "lm_specdec_accept": g(
+            "cluster_lm_sharded", "lm_specdec_accept"),
+        "lm_cb_ttft_ms": g("cluster_lm_sharded", "lm_cb_ttft_ms"),
         "parity_weights_found": g(
             "parity_store_probe", "any_weights_found"),
         "inception_concat_bound": g(
@@ -3696,6 +3714,11 @@ def main() -> None:
             "request_serving", "kv_cache", "warm_vs_cold_ttft"),
         "kv_tokens_saved": g(
             "request_serving", "kv_cache", "tokens_saved"),
+        # per-request TPOT (loadgen Outcome.tpot_s, round-21): decode
+        # cadence the client actually observed on the warm kv-cache
+        # arm — TTFT scores prefill+queue, this scores the token loop
+        "req_tpot_p95_ms": g(
+            "request_serving", "kv_cache", "tpot_ms_warm", "p95"),
         # distributed request tracing (dml_tpu/tracing.py, round-14
         # gate): the p99 cohort's stage attribution explains >= 90% of
         # its e2e, every deadline miss has an exemplar trace, and the
@@ -3877,7 +3900,9 @@ COMPACT_SUMMARY_BUDGET = 1500
 #: elastic_ok the round-18 elastic-capacity gate; alert_fired_ok +
 #: liar_flagged_ok (+ signal_ok) the round-19 signal-plane gate;
 #: autoscale_ok + autoscale_slo_min_saved the round-20 autoscaler
-#: gate.
+#: gate; lm_specdec_speedup + lm_specdec_accept + lm_cb_ttft_ms the
+#: round-21 raw-decode gate (speculative verify speedup at the
+#: measured acceptance, continuous-batching p99 TTFT).
 _COMPACT_KEEP_KEYS = (
     "headline_qps", "cluster_qps", "cluster_pipelining",
     "cluster_lm_tok_s", "cluster_lm_steady_tok_s",
@@ -3888,6 +3913,7 @@ _COMPACT_KEEP_KEYS = (
     "lm_stream_vs_slab",
     "req_p99_ms", "req_goodput_qps",
     "req_shed_ratio", "req_failover_ok",
+    "req_tpot_p95_ms",
     "kv_hit_ratio", "kv_warm_vs_cold_ttft",
     "trace_p99_attrib_ok",
     "lint_clean", "lint_race", "lint_payload",
@@ -3896,6 +3922,8 @@ _COMPACT_KEEP_KEYS = (
     "elastic_scaleout_gain", "elastic_ok",
     "alert_fired_ok", "liar_flagged_ok", "signal_ok",
     "autoscale_ok", "autoscale_slo_min_saved",
+    "lm_specdec_speedup", "lm_specdec_accept",
+    "lm_cb_ttft_ms",
     "section_errors", "sections_skipped",
 )
 

@@ -83,7 +83,7 @@ Refresh the key lists with ``python -m dml_tpu.tools.dmlflow``.
     JOBS_RESTORE_RELAY_ACK: ok? <- JOBS_RESTORE_RELAY
     JOB_FAILED_RELAY: job error? gen? *
     WORKER_STAGE_CANCEL: batch job inc? seq?
-    LM_PREFILL_REQUEST: budgets? model? prompts? stream? traces? *
+    LM_PREFILL_REQUEST: budgets? draft_k? model? prompts? stream? traces? *
     LM_PREFILL_ACK: error? n? ok? size? stream? token? * <- LM_PREFILL_REQUEST
     METRICS_PULL: -
     METRICS_PULL_ACK: metrics? * <- METRICS_PULL

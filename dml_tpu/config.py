@@ -176,6 +176,65 @@ class WorkerGroupSpec:
 
 
 # ----------------------------------------------------------------------
+# speculative-decoding knobs (inference/lm_server.py, lm_backend.py)
+# ----------------------------------------------------------------------
+
+#: default draft lookahead: tokens proposed per slot per verify round.
+#: The verify forward streams the target weights ONCE for k+1 tokens,
+#: so round cost grows sub-linearly in k while expected commit length
+#: is ~(1-p^(k+1))/(1-p) at per-token acceptance p — k=4 captures most
+#: of the win at p≈0.8 without paying long rejected tails.
+SPEC_K_DEFAULT = 4
+
+#: default break-even acceptance floor for automatic draft disable
+#: (`lm_spec["spec_min_accept"]`): below ~1/3 acceptance a verify
+#: round's expected commit (~rate*k + 1) no longer beats the chunk
+#: scan's per-token cost plus the draft's own forward, so the server
+#: reverts to plain decode (lm_specdec_disabled_total{reason=
+#: "acceptance"}) instead of taxing every dispatch.
+SPEC_MIN_ACCEPT_DEFAULT = 0.35
+
+#: proposals measured before the acceptance gate may fire (and the
+#: sliding-window grain thereafter) — one cold request's unlucky
+#: prefix must not kill speculation for the server's lifetime.
+SPEC_MIN_SAMPLES_DEFAULT = 64
+
+
+def draft_lm_spec(
+    lm_spec: Dict[str, Any], **overrides: Any
+) -> Dict[str, Any]:
+    """Derive a DRAFT-model spec from a target `lm_spec`: same family
+    (vocab/dtype/heads — the draft must emit the target's token space),
+    roughly quarter the compute (half the layers, half d_model/d_ff),
+    deterministic weights from ``seed + 1`` so draft and target never
+    silently share a tree. Serving-only keys (max_slots, chunk,
+    spec_*, kv_cache_mb, weights ...) are dropped — the draft is a
+    bare model spec for `lm_spec_parts`. ``overrides`` pin any field
+    (`lm_spec["spec_draft"]` passes operator overrides through here).
+
+    d_model halves but is re-aligned UP to a multiple of n_heads so
+    head_dim stays integral for any target geometry."""
+    heads = int(lm_spec.get("n_heads", 8))
+    d_model = int(lm_spec["d_model"])
+    d_half = max(heads, ((d_model // 2 + heads - 1) // heads) * heads)
+    d_ff = int(lm_spec.get("d_ff", 4 * d_model))
+    spec: Dict[str, Any] = {
+        "name": f"{lm_spec.get('name', 'LM')}-draft",
+        "vocab_size": int(lm_spec["vocab_size"]),
+        "d_model": d_half,
+        "n_heads": heads,
+        "n_layers": max(1, int(lm_spec.get("n_layers", 2)) // 2),
+        "d_ff": max(d_half, d_ff // 2),
+        "dtype": lm_spec.get("dtype", "bfloat16"),
+        "seed": int(lm_spec.get("seed", 0)) + 1,
+    }
+    if lm_spec.get("n_kv_heads") is not None:
+        spec["n_kv_heads"] = int(lm_spec["n_kv_heads"])
+    spec.update(overrides)
+    return spec
+
+
+# ----------------------------------------------------------------------
 # authenticated-membership MACs (cluster/node.py join/leave protocol)
 # ----------------------------------------------------------------------
 

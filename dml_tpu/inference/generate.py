@@ -380,6 +380,112 @@ def batched_decode_step(
     return _head(params, cfg, x), new_cache
 
 
+def batched_verify_step(
+    params: Dict[str, Any],
+    cfg: LMConfig,
+    cache: Dict[str, Any],
+    tokens: jax.Array,  # [B, T] int32 — T candidate tokens per slot
+    pos: jax.Array,  # [B] int32 — each slot's first write position
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Multi-token decode forward — the speculative-decoding VERIFY
+    primitive (inference/lm_server.py): slot b consumes `tokens[b]` at
+    positions pos[b] .. pos[b]+T-1 in ONE dispatch and returns logits
+    for EVERY consumed position ([B, T, V] f32), i.e. the target
+    model's next-token distribution after each candidate. One weight
+    stream covers T tokens per slot, where the decode scan re-streams
+    the weights per token — that bandwidth ratio is speculative
+    decoding's entire speedup.
+
+    Identical math to T successive `batched_decode_step` calls with
+    the same inputs (the spec-decode exactness contract pins this,
+    tests/test_specdec.py): same `_apply_block` layer body, same
+    cache-write discipline (per-slot UNROLLED dynamic_update_slice of
+    one contiguous [KV, T, D] block — the vmap/scatter trap decode hit
+    applies T-fold here), same f32 attention. Causality is per-slot:
+    query t attends cache rows j <= pos[b]+t, which includes the rows
+    this same dispatch wrote at t' <= t (written before any read, as
+    in batched_decode_step). Einsum attention only — the Pallas decode
+    kernel is single-query and flash is full-sequence; a dedicated
+    multi-query cache kernel is the remaining TPU item (ROADMAP 4).
+
+    The caller must ensure pos[b] + T <= max_len for every LIVE slot;
+    starts are clamped so a freed slot's garbage position stays
+    in-bounds (its rows are erased by the next insert's full-row
+    overwrite — LMServer._insert_impl's invariant)."""
+    hd = cfg.head_dim
+    b, t = tokens.shape
+    grp = cfg.n_heads // cfg.kv_heads
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)  # [B,T,d]
+    max_len = next(iter(next(iter(cache.values())).values())).shape[2]
+    pos = jnp.minimum(pos, max_len - t)
+    positions = pos[:, None] + jnp.arange(t)[None, :]  # [B, T] per-example
+    # per-(slot, query) validity: query t sees cache rows <= pos[b]+t
+    valid = (
+        jnp.arange(max_len)[None, None, :] <= positions[:, :, None]
+    )  # [B, T, max_len]
+
+    new_cache: Dict[str, Any] = {}
+    for i in range(cfg.n_layers):
+        name = f"block_{i}"
+
+        def attn_fn(q, k, v, name=name):
+            # k/v arrive [B, T, KV, D]; write each slot's contiguous
+            # [KV, T, D] block at its own start row (unrolled — see
+            # batched_decode_step on why not a vmap'd scatter)
+            def upd(c, u, axis):
+                for bi in range(b):
+                    start = [bi] + [0] * (c.ndim - 1)
+                    start[axis] = pos[bi]
+                    c = jax.lax.dynamic_update_slice(
+                        c, u[bi : bi + 1], start
+                    )
+                return c
+
+            kh = jnp.swapaxes(k, 1, 2)  # [B, KV, T, D]
+            vh = jnp.swapaxes(v, 1, 2)
+            if cfg.kv_quant:
+                kq, ks = _kv_quantize(kh)
+                vq, vs = _kv_quantize(vh)
+                lay = {
+                    "k_q": upd(cache[name]["k_q"], kq, axis=2),
+                    "k_s": upd(cache[name]["k_s"],
+                               jnp.swapaxes(ks, 2, 3), axis=3),
+                    "v_q": upd(cache[name]["v_q"], vq, axis=2),
+                    "v_s": upd(cache[name]["v_s"],
+                               jnp.swapaxes(vs, 2, 3), axis=3),
+                }
+                new_cache[name] = lay
+                ck = _kv_dequant(
+                    lay["k_q"], jnp.swapaxes(lay["k_s"], 2, 3)
+                )
+                cv = _kv_dequant(
+                    lay["v_q"], jnp.swapaxes(lay["v_s"], 2, 3)
+                )
+            else:
+                ck = upd(cache[name]["k"], kh.astype(cfg.dtype), axis=2)
+                cv = upd(cache[name]["v"], vh.astype(cfg.dtype), axis=2)
+                new_cache[name] = {"k": ck, "v": cv}
+            qg = q.astype(jnp.float32).reshape(b, t, cfg.kv_heads, grp, hd)
+            s = jnp.einsum(
+                "bqkgd,bktd->bkgqt", qg, ck.astype(jnp.float32)
+            ) * (hd**-0.5)
+            s = jnp.where(valid[:, None, None, :, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("bkgqt,bktd->bqkgd", p, cv.astype(jnp.float32))
+            return attn.reshape(b, t, cfg.n_heads, hd)
+
+        x, _, _ = _apply_block(params[name], cfg, x, positions, attn_fn)
+
+    # logits at EVERY position (not _head's single-row squeeze): the
+    # verifier needs the target's next-token argmax after each
+    # candidate to find the leading-match acceptance length
+    x = _rms_norm(x, params["ln_out"]["scale"], cfg.dtype)
+    logits = (
+        x.astype(jnp.float32) @ kernel_of(params["lm_head"], jnp.float32)
+    )  # [B, T, V]
+    return logits, new_cache
+
+
 def prefill(
     params: Dict[str, Any],
     cfg: LMConfig,

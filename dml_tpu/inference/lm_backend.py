@@ -174,6 +174,9 @@ class LMBackend:
         seed: int = 0,
         gather_shardings: Any = None,
         kv_cache_bytes: int = 0,
+        spec_k: int = 0,
+        spec_draft: Optional[Dict[str, Any]] = None,
+        spec_min_accept: Optional[float] = None,
     ):
         self.cfg = cfg
         self.max_new_tokens = max_new_tokens
@@ -182,6 +185,31 @@ class LMBackend:
             chunk=chunk, temperature=temperature, top_k=top_k, seed=seed,
             gather_shardings=gather_shardings,
         )
+        # speculative decoding (spec_k > 0): a deterministic DRAFT
+        # model from `spec_draft` (a model spec dict — normally
+        # config.draft_lm_spec(lm_spec)) proposes spec_k tokens per
+        # slot per round; the target verifies them in one batched
+        # forward. Greedy-exactness is the server's contract either
+        # way. spec_k > 0 WITHOUT a draft spec arms shipped-draft
+        # verification only (the disaggregated remote-draft form).
+        self.spec_k = int(spec_k)
+        if self.spec_k > 0:
+            from ..config import (
+                SPEC_MIN_ACCEPT_DEFAULT,
+                SPEC_MIN_SAMPLES_DEFAULT,
+            )
+
+            dp = dcfg = None
+            if spec_draft is not None:
+                dp, dcfg = lm_spec_parts(spec_draft)
+            self.server.enable_spec_decode(
+                self.spec_k, draft_params=dp, draft_cfg=dcfg,
+                min_accept=(
+                    SPEC_MIN_ACCEPT_DEFAULT if spec_min_accept is None
+                    else float(spec_min_accept)
+                ),
+                min_samples=SPEC_MIN_SAMPLES_DEFAULT,
+            )
         # worker-resident KV prefix cache (inference/kv_cache.py):
         # retired requests' KV rows are retained under this host-bytes
         # budget and prompts extending a cached prefix warm-start with
@@ -345,6 +373,13 @@ class LMBackend:
         """Prefix-cache counters (None when disabled) — the bench's
         multi-turn phase aggregates these per worker."""
         return None if self.kv_cache is None else self.kv_cache.stats()
+
+    def spec_stats(self) -> Optional[Dict[str, Any]]:
+        """Speculative-decoding acceptance accounting (None when spec
+        was never enabled) — LMServer.spec_stats passthrough; the
+        bench's declared-acceptance gate reads the MEASURED rate from
+        here."""
+        return self.server.spec_stats()
 
     def decode_tokens_total(self) -> int:
         """Delivered-token count of THIS backend's server — the
@@ -518,6 +553,13 @@ class LMBackend:
                                 prompts[idx], budgets[idx],
                                 entry["rows"], entry["first_token"],
                                 on_token=_cb(idx),
+                                # remote-draft shipment: a prefill
+                                # peer's speculative proposals rode
+                                # the slab; they seed this request's
+                                # first verify round (dropped when
+                                # spec decode is off — values never
+                                # depend on them)
+                                draft_tokens=entry.get("draft"),
                             )
                             stats["adopted"] += 1
                         except Exception as e:
@@ -548,6 +590,30 @@ class LMBackend:
         if n:
             self._per_query = infer_time / n
         return [done[rid] for rid in rids], infer_time, stats
+
+    @staticmethod
+    def _draft_spec_of(spec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """The draft-model spec a serving spec implies: absent/None +
+        spec_k>0 derives one via `config.draft_lm_spec`; a dict is
+        treated as OVERRIDES onto the derived spec (full replacement
+        when it carries its own vocab_size/d_model); False opts out
+        of a local draft (shipped-draft-only verification)."""
+        if int(spec.get("spec_k", 0) or 0) <= 0:
+            return None
+        sd = spec.get("spec_draft")
+        if sd is False:
+            return None
+        from ..config import draft_lm_spec
+
+        if sd is None:
+            return draft_lm_spec(spec)
+        if not isinstance(sd, dict):
+            raise ValueError(
+                f"spec_draft must be a dict or false, got {sd!r}"
+            )
+        if "vocab_size" in sd and "d_model" in sd:
+            return dict(sd)  # a complete draft spec of its own
+        return draft_lm_spec(spec, **sd)
 
     @classmethod
     def from_spec(cls, spec: Dict[str, Any]) -> "LMBackend":
@@ -592,6 +658,14 @@ class LMBackend:
             kv_cache_bytes=int(
                 float(spec.get("kv_cache_mb", 0) or 0) * (1 << 20)
             ),
+            # {"spec_k": 4} turns on speculative decoding with a
+            # config.draft_lm_spec-derived draft (or {"spec_draft":
+            # {...}} overrides / a full replacement draft spec);
+            # {"spec_draft": false} arms shipped-draft verification
+            # only. Greedy outputs stay identical either way.
+            spec_k=int(spec.get("spec_k", 0) or 0),
+            spec_draft=LMBackend._draft_spec_of(spec),
+            spec_min_accept=spec.get("spec_min_accept"),
         )
         # operators pick the serving concurrency mode per deployment
         # ({"overlap": false}): the driver's cross-batch batching wins

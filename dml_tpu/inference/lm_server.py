@@ -76,6 +76,7 @@ from .generate import (
     LMConfig,
     _sample,
     batched_decode_step,
+    batched_verify_step,
     init_cache,
     prefill,
 )
@@ -117,6 +118,20 @@ _M_SLOTS = METRICS.gauge(
     "lm_server_slots_active", "occupied decode slots")
 _M_SLOTS_TOTAL = METRICS.gauge(
     "lm_server_slots_total", "slot grid capacity")
+_M_OCCUPANCY = METRICS.histogram(
+    "lm_server_slot_occupancy",
+    "occupied slots per decode dispatch (grid utilization — the "
+    "continuous-batching win/loss ledger)")
+_M_SPEC_PROPOSED = METRICS.counter(
+    "lm_specdec_proposed_total",
+    "draft tokens proposed to the verify program")
+_M_SPEC_ACCEPTED = METRICS.counter(
+    "lm_specdec_accepted_total",
+    "proposed draft tokens accepted by target-greedy verification")
+_M_SPEC_DISABLED = METRICS.counter(
+    "lm_specdec_disabled_total",
+    "speculative-decode disable events by reason (acceptance = "
+    "measured rate fell below break-even)")
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -146,6 +161,17 @@ class _Request:
     # path: deliveries happen at the packed readback, so firing here
     # adds no dispatches and no extra link round-trips.
     on_token: Optional[Callable[[int], None]] = None
+    # draft tokens shipped WITH the request (a prefill-role peer's
+    # speculative proposals riding the KV slab — inference/
+    # lm_sharded.py): consumed by exactly ONE verify round, then the
+    # server's own proposer (if any) takes over. Correctness never
+    # depends on these — a bad/absent shipment only shortens the
+    # acceptance run (greedy verification commits target tokens only).
+    shipped_draft: Optional[np.ndarray] = None
+    # per-request acceptance-length accounting (spec_rounds verify
+    # rounds accepted spec_accepted draft tokens for this request)
+    spec_rounds: int = 0
+    spec_accepted: int = 0
 
     def deliver(self, toks) -> None:
         """Append read-back token values to `out`, firing `on_token`
@@ -168,6 +194,40 @@ class _Request:
     @property
     def done(self) -> bool:
         return self.emitted >= self.max_new_tokens
+
+
+@dataclasses.dataclass
+class _SpecState:
+    """Speculative-decoding state for one LMServer (enable_spec_decode).
+
+    Exactly one proposal source is primary: a device-resident DRAFT
+    model (draft_params/draft_cfg/draft_cache — proposals never leave
+    the device), a host PROPOSER callable (oracle/heuristic — the
+    bench's declared-acceptance harness), or neither (verify rounds
+    run only when an adopted request carries a shipped draft). The
+    windowed acceptance counters drive automatic disable when the
+    measured rate drops below `min_accept` (break-even): a verify
+    round costs ~one (k+1)-token forward to emit accepted+1 tokens,
+    so low acceptance pays multi-row attention for single-token
+    progress."""
+
+    k: int
+    draft_params: Any = None
+    draft_cfg: Optional[LMConfig] = None
+    draft_cache: Any = None
+    proposer: Optional[Callable[[Sequence["_Request"], int], Any]] = None
+    min_accept: float = 0.0
+    min_samples: int = 64
+    enabled: bool = True
+    disabled_reason: Optional[str] = None
+    # lifetime + sliding-window acceptance accounting (window halves
+    # once it doubles min_samples, so a long-lived server tracks the
+    # CURRENT workload's acceptance, not its launch-hour average)
+    proposed_total: int = 0
+    accepted_total: int = 0
+    win_proposed: int = 0
+    win_accepted: int = 0
+    rounds: int = 0
 
 
 class LMServer:
@@ -289,7 +349,143 @@ class LMServer:
         # serve path bit-identical to a cache-less build
         self.kv_cache = None
         self._warm = None
+        # speculative decoding (enable_spec_decode wires these; None =
+        # the plain chunked-scan path, bit-identical to pre-spec builds)
+        self._spec: Optional[_SpecState] = None
+        self._verify_fn = None
+        self._propose_fn = None
+        self._draft_prefill = None
         _M_SLOTS_TOTAL.set(max_slots)
+
+    def enable_spec_decode(
+        self,
+        k: int,
+        *,
+        draft_params: Any = None,
+        draft_cfg: Optional[LMConfig] = None,
+        proposer: Optional[Callable] = None,
+        min_accept: float = 0.0,
+        min_samples: int = 64,
+    ) -> None:
+        """Turn on speculative decoding: each decode dispatch becomes
+        one PROPOSE (k draft tokens per slot) + one VERIFY (the target
+        model consumes all k candidates in a single batched
+        `batched_verify_step` forward) committing 1..k target-greedy
+        tokens per slot per round. Outputs stay bitwise-identical to
+        the plain chunked path — the committed tokens are the TARGET's
+        greedy argmaxes, so proposals affect only how many commit per
+        round, never their values (tests/test_specdec.py pins both).
+
+        Proposal source (pick one):
+        - `draft_params` + `draft_cfg`: a device-resident draft model
+          (same vocab, fewer layers/d_model — config.draft_lm_spec).
+          Its own KV cache shadows the slot grid; placement runs a
+          second bucketed draft prefill; proposals never leave the
+          device.
+        - `proposer(requests, k) -> [len(requests), k] int32`: a host
+          callable (the bench's declared-acceptance oracle). Costs one
+          host round-trip of k ints per slot per round.
+        - neither: verify rounds run only for shipped drafts riding
+          adopted prefill slabs (the disaggregated remote-draft form).
+
+        `min_accept` > 0 arms AUTOMATIC DISABLE: once `min_samples`
+        proposals are measured, a windowed acceptance rate below
+        min_accept permanently reverts this server to the plain chunk
+        path (lm_specdec_disabled_total{reason="acceptance"}) — a
+        draft that stopped predicting the target must not keep taxing
+        every dispatch with rejected verify rows.
+
+        Greedy-only (temperature == 0): acceptance compares draft
+        tokens against target ARGMAXES; a sampled target has no single
+        correct token to compare against (lossless sampled
+        speculation needs rejection resampling — out of scope, typed
+        here). Enable before submitting work: a device draft's cache
+        cannot adopt slots that were prefilled before it existed."""
+        if self.temperature != 0.0:
+            raise ValueError(
+                "speculative decoding requires temperature == 0 "
+                "(greedy acceptance compares draft tokens against "
+                "target argmaxes)"
+            )
+        if k < 1:
+            raise ValueError("spec k must be >= 1")
+        if k + 1 >= self.max_len:
+            raise ValueError(
+                f"spec k {k} leaves no room in max_len {self.max_len}"
+            )
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError(
+                "draft_params and draft_cfg come together"
+            )
+        if draft_params is not None and proposer is not None:
+            raise ValueError("pick ONE of draft model / proposer")
+        if draft_cfg is not None and (
+            draft_cfg.vocab_size != self.cfg.vocab_size
+        ):
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab_size} != target "
+                f"vocab {self.cfg.vocab_size}"
+            )
+        if self.has_work():
+            raise RuntimeError(
+                "enable_spec_decode on a busy server: active slots "
+                "have no draft cache rows to verify against"
+            )
+        self._spec = _SpecState(
+            k=int(k), draft_params=draft_params, draft_cfg=draft_cfg,
+            proposer=proposer, min_accept=float(min_accept),
+            min_samples=int(min_samples),
+        )
+        if draft_params is not None:
+            self._spec.draft_cache = init_cache(
+                draft_cfg, self.max_slots, self.max_len
+            )
+            self._propose_fn = jax.jit(
+                self._propose_impl, donate_argnums=(1,)
+            )
+            self._draft_prefill = jax.jit(
+                lambda p, pr, li: prefill(
+                    p, draft_cfg, pr, self.max_len, logits_index=li
+                )
+            )
+        self._verify_fn = jax.jit(
+            self._verify_impl, donate_argnums=(1,)
+        )
+
+    def disable_spec_decode(self, reason: str = "manual") -> None:
+        """Revert to the plain chunked path (idempotent). The spec
+        state object stays for `spec_stats()` post-mortems."""
+        sp = self._spec
+        if sp is None or not sp.enabled:
+            return
+        sp.enabled = False
+        sp.disabled_reason = reason
+        _M_SPEC_DISABLED.inc(reason=reason)
+        log.warning(
+            "speculative decoding disabled (%s): accepted %d / "
+            "proposed %d over %d rounds",
+            reason, sp.accepted_total, sp.proposed_total, sp.rounds,
+        )
+
+    def spec_stats(self) -> Optional[Dict[str, Any]]:
+        """Acceptance accounting (None when spec was never enabled):
+        the observable half of the speculation story — bench and
+        claim_check score the measured rate, not the configured one."""
+        sp = self._spec
+        if sp is None:
+            return None
+        return {
+            "enabled": sp.enabled,
+            "k": sp.k,
+            "rounds": sp.rounds,
+            "proposed": sp.proposed_total,
+            "accepted": sp.accepted_total,
+            "accept_rate": (
+                sp.accepted_total / sp.proposed_total
+                if sp.proposed_total else None
+            ),
+            "disabled_reason": sp.disabled_reason,
+        }
 
     def enable_kv_cache(self, cache) -> None:
         """Attach a `KVPrefixCache`: retiring requests donate their KV
@@ -387,6 +583,78 @@ class LMServer:
         )
         return cache, cur, pos, toks  # toks: [chunk, slots]
 
+    def _propose_impl(self, draft_params, draft_cache, cur, pos):
+        """k greedy draft steps from every slot's (cur, pos): returns
+        (draft cache, proposals [slots, k]). The draft model shares
+        the TARGET's committed cur/pos — its cache rows < pos hold the
+        K/V of exactly the committed tokens (the verify-round cap in
+        `_verify_impl` maintains this invariant), so proposing is a
+        plain greedy continuation. Always argmax regardless of how
+        good the draft is: proposals only gate how many target tokens
+        commit per round, never which (the proposal-independence
+        contract)."""
+        last = self.max_len - 1
+        cfg = self._spec.draft_cfg
+
+        def body(carry, _):
+            cache, tok, p = carry
+            pc = jnp.minimum(p, last)
+            logits, cache = batched_decode_step(
+                draft_params, cfg, cache, tok, pc
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, nxt, pc + 1), nxt
+
+        (draft_cache, _, _), d = jax.lax.scan(
+            body, (draft_cache, cur, pos), None, length=self._spec.k
+        )
+        return draft_cache, jnp.swapaxes(d, 0, 1)  # [slots, k]
+
+    def _verify_impl(self, params, cache, cur, pos, d_toks):
+        """ONE fused verify + acceptance round: the target consumes
+        [cur, d_1..d_k] per slot in a single multi-token forward
+        (`batched_verify_step` — one weight stream for k+1 tokens),
+        takes its greedy tokens g_1..g_{k+1}, and commits
+        c = min(a+1, k) of them, where a = leading draft/target
+        matches. Returns (cache, cur', pos', committed-token matrix
+        [slots, k] (row b's first c_b entries are live), accept
+        lengths a [slots]).
+
+        Why cap at k (not the classic a+1 <= k+1): committing exactly
+        <= k keeps BOTH caches consistent by construction — target
+        rows pos..pos+c-1 hold the K/V of [cur, g_1..g_{c-1}] =
+        [cur, d_1..d_{c-1}] (c-1 <= a, so drafts and targets agree on
+        that prefix), and the DRAFT cache rows written at propose time
+        hold the same tokens, so neither cache needs a fix-up pass.
+        Rows >= pos' written past the commit point are stale but
+        UNREAD: the next dispatch (chunk, propose or verify alike)
+        writes its own row(s) at pos' before attending, and a freed
+        slot's rows die at the next insert's full-row overwrite
+        (_insert_impl's invariant — the verify-start clamp below keeps
+        a freed slot's garbage writes in-bounds the same way
+        _chunk_impl's pos clamp does).
+
+        Exactness: g_i is the argmax after consuming the SAME prefix a
+        plain greedy decode would have at that position (prefix
+        d_1..d_{i-1} = g_1..g_{i-1} holds for every committed i), so
+        delivering g_1..g_c is literally c plain greedy steps —
+        bitwise-identical outputs, for ANY d_toks whatsoever."""
+        k = self._spec.k
+        params = self._maybe_gather(params)
+        start = jnp.minimum(pos, self.max_len - (k + 1))
+        inputs = jnp.concatenate([cur[:, None], d_toks], axis=1)
+        logits, cache = batched_verify_step(
+            params, self.cfg, cache, inputs, start
+        )
+        # g[:, i] = target-greedy token for position start+i+1 (the
+        # argmax after consuming inputs[:, i])
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+        match = (d_toks == g[:, :k]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B] 0..k
+        c = jnp.minimum(a + 1, k)
+        cur2 = jnp.take_along_axis(g, (c - 1)[:, None], axis=1)[:, 0]
+        return cache, cur2, start + c, g[:, :k], a
+
     # -- public API ----------------------------------------------------
 
     def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
@@ -468,6 +736,7 @@ class LMServer:
         rows: Dict[str, Dict[str, np.ndarray]],
         first_token: int,
         on_token: Optional[Callable[[int], None]] = None,
+        draft_tokens: Optional[Sequence[int]] = None,
     ) -> int:
         """Adopt an EXTERNALLY-prefilled request: place a KV-cache
         slab computed elsewhere (a prefill-role worker, transported as
@@ -493,7 +762,16 @@ class LMServer:
         chunk sampler's argmax has no rid dependence. (Temperature
         sampling streams are keyed by THIS server's rid, which the
         prefill node cannot know; the disaggregated backend therefore
-        requires temperature == 0.)"""
+        requires temperature == 0.)
+
+        `draft_tokens` (optional, <= spec k of them) are a REMOTE
+        draft's speculative proposals that rode the slab (a
+        prefill-role peer that idles during decode-heavy phases ran
+        the draft model on prompt+first_token): they seed this
+        request's FIRST verify round when speculative decoding is
+        enabled without a local device draft, and are silently
+        dropped otherwise — a shipped draft can accelerate but never
+        affect output values (proposal-independence)."""
         prompt = self._validate(prompt, max_new_tokens)
         slot = next(
             (s for s in range(self.max_slots)
@@ -506,6 +784,16 @@ class LMServer:
             self._rid, prompt, int(max_new_tokens),
             t_submit=time.monotonic(), on_token=on_token,
         )
+        if (
+            draft_tokens is not None and self._spec is not None
+            and self._spec.enabled
+            and self._spec.draft_params is None
+        ):
+            # a local device draft re-proposes every round on device;
+            # shipped tokens only matter when there is no local draft
+            req.shipped_draft = np.asarray(
+                draft_tokens, np.int32
+            ).reshape(-1)[: self._spec.k]
         _M_REQS.inc()
         self._place_prefilled(slot, req, rows, int(first_token))
         _M_SLOTS.set(sum(1 for r in self._slot_req if r is not None))
@@ -560,8 +848,40 @@ class LMServer:
         self.rid_vec[slot] = req.rid
         self.tokens_delivered += 1
         _M_TOKENS.inc()
+        if (
+            self._spec is not None and self._spec.enabled
+            and self._spec.draft_params is not None and not req.done
+        ):
+            # the slab carried TARGET rows only; the local draft cache
+            # needs its own rows for positions < Tp before it can
+            # propose for this slot. The prompt is host-known, so this
+            # is one single-row bucketed draft prefill — cheap (the
+            # draft is the small model) and fully async.
+            self._spec_draft_prefill_one(slot, req.prompt)
         if req.done:  # max_new_tokens == 1: the slab's token was all
             self._retire(slot)
+
+    def _spec_draft_prefill_one(self, slot: int, prompt: np.ndarray) -> None:
+        """Fill the DRAFT cache's rows for one slot from a host-known
+        prompt (adopted-slab / warm-start placements, whose target
+        rows arrived as bytes). Same bucket/pad discipline as
+        _place_waiting's group prefill."""
+        tp = prompt.size
+        bucket = min(_bucket(tp), self.max_len)
+        padded = np.full((1, bucket), prompt[-1], np.int32)
+        padded[0, :tp] = prompt
+        _, pcache = self._draft_prefill(
+            self._spec.draft_params, jnp.asarray(padded),
+            jnp.asarray([tp - 1], np.int32),
+        )
+        self._spec.draft_cache = self._insert(
+            self._spec.draft_cache, pcache, jnp.int32(slot),
+            jnp.int32(0),
+        )
+        shape = ("draft_prefill", bucket, 1)
+        if shape not in self._seen_shapes:
+            self._seen_shapes.add(shape)
+            _M_COMPILES.inc()
 
     def _place_waiting(self) -> None:
         # Placement is FULLY ASYNC and GROUP-BATCHED: free slots take
@@ -654,6 +974,28 @@ class LMServer:
                 self.cache = self._insert(
                     self.cache, pcache, jnp.int32(slot), jnp.int32(row)
                 )
+            if (
+                self._spec is not None and self._spec.enabled
+                and self._spec.draft_params is not None
+            ):
+                # second bucketed prefill, DRAFT params: the draft
+                # cache shadows the slot grid and needs its own rows
+                # for positions < tp before it can propose. Same
+                # padded batch, same row->slot inserts; the draft's
+                # logits are unused (the first token is the TARGET's).
+                _, dpcache = self._draft_prefill(
+                    self._spec.draft_params, jnp.asarray(padded),
+                    jnp.asarray(tps - 1),
+                )
+                for row, (slot, req) in enumerate(grp):
+                    self._spec.draft_cache = self._insert(
+                        self._spec.draft_cache, dpcache,
+                        jnp.int32(slot), jnp.int32(row),
+                    )
+                dshape = ("draft_prefill", bucket, kp)
+                if dshape not in self._seen_shapes:
+                    self._seen_shapes.add(dshape)
+                    _M_COMPILES.inc()
             # first generated tokens occupy position tp — the same
             # (rid, position) streams the chunk sampler continues
             firsts = self._sample_first(
@@ -759,13 +1101,186 @@ class LMServer:
         _M_TOKENS.inc(flushed)
 
     def step(self) -> None:
-        """One chunked dispatch: every active slot advances up to
-        `chunk` tokens; finished slots free and waiting requests take
-        their place."""
+        """One decode dispatch: every active slot advances — a
+        chunked scan, or a speculative propose+verify round when
+        enabled and this dispatch is eligible (`_use_spec`). Finished
+        slots free and waiting requests take their place at this step
+        boundary mid-flight (`_place_waiting` at the tail) — the
+        continuous-batching join point: a request never waits for the
+        batch it joins to drain."""
         if not any(r is not None for r in self._slot_req):
             self._place_waiting()
             if not any(r is not None for r in self._slot_req):
                 return
+        _M_OCCUPANCY.observe(
+            sum(1 for r in self._slot_req if r is not None)
+        )
+        if self._use_spec():
+            self._spec_step()
+        else:
+            self._chunk_step()
+
+    def _use_spec(self) -> bool:
+        """Per-DISPATCH host gate for the speculative round. False
+        falls back to the plain chunk scan for this dispatch only:
+
+        - no proposal source this round (no draft model, no proposer,
+          and no adopted request carrying a shipped draft) — verifying
+          garbage rows to commit ~1 token per round would be SLOWER
+          than the chunk scan;
+        - any active slot within k+1 positions of max_len: the verify
+          forward writes rows pos..pos+k, and a clamped
+          dynamic_update_slice start would silently relocate live
+          tail rows (the host knows every active slot's pos as
+          prompt + emitted — the device never reports back).
+        """
+        sp = self._spec
+        if sp is None or not sp.enabled:
+            return False
+        if sp.draft_params is None and sp.proposer is None and not any(
+            r is not None and r.shipped_draft is not None
+            for r in self._slot_req
+        ):
+            return False
+        lim = self.max_len - (sp.k + 1)
+        for r in self._slot_req:
+            if r is not None and r.prompt.size + r.emitted > lim:
+                return False
+        return True
+
+    def _spec_step(self) -> None:
+        """One speculative round: propose k tokens per slot, verify
+        all of them in ONE multi-token target forward, commit 1..k
+        target-greedy tokens per slot. Same packed-readback
+        discipline as `_chunk_step` — committed tokens + accept
+        lengths + any deferred placement firsts ride ONE blocking
+        readback."""
+        t_step0 = time.monotonic()
+        sp = self._spec
+        k = sp.k
+        b = self.max_slots
+        firsts = self._pending_first
+        self._pending_first = []
+        real = [False] * b  # slots whose proposals count toward rate
+        if sp.draft_params is not None:
+            # device draft: proposals never leave the chip. A shipped
+            # draft is redundant here (the local draft re-proposes) —
+            # consume it so it can't leak into a later round.
+            for r in self._slot_req:
+                if r is not None:
+                    r.shipped_draft = None
+                    real[r.slot] = True
+            if "spec_propose" not in self._seen_shapes:
+                self._seen_shapes.add("spec_propose")
+                _M_COMPILES.inc()
+            sp.draft_cache, d_toks = self._propose_fn(
+                sp.draft_params, sp.draft_cache,
+                self._cur_dev, self._pos_dev,
+            )
+        else:
+            # host-side proposals: shipped drafts first (consumed
+            # once), then the proposer callable for the rest. Slots
+            # with neither get zero rows — verification still commits
+            # >= 1 correct token for them (proposal-independence), and
+            # they are excluded from acceptance accounting.
+            d = np.zeros((b, k), np.int32)
+            need: List[_Request] = []
+            for slot, r in enumerate(self._slot_req):
+                if r is None:
+                    continue
+                if r.shipped_draft is not None:
+                    sd = r.shipped_draft[:k]
+                    r.shipped_draft = None
+                    d[slot, : sd.size] = sd
+                    real[slot] = True
+                elif sp.proposer is not None:
+                    need.append(r)
+            if need:
+                rows = np.asarray(
+                    sp.proposer(need, k), np.int32
+                ).reshape(len(need), k)
+                for r, row in zip(need, rows):
+                    d[r.slot] = row
+                    real[r.slot] = True
+            d_toks = jnp.asarray(d)
+        if "spec_verify" not in self._seen_shapes:
+            self._seen_shapes.add("spec_verify")
+            _M_COMPILES.inc()
+        (
+            self.cache, self._cur_dev, self._pos_dev, toks, acc
+        ) = self._verify_fn(
+            self.params, self.cache, self._cur_dev, self._pos_dev,
+            d_toks,
+        )
+        t_rb0 = time.monotonic()
+        packed = np.asarray(jnp.concatenate(
+            [jnp.ravel(toks), acc] + [v for _, v in firsts]
+        ))
+        _M_READBACK.observe(time.monotonic() - t_rb0)
+        n = b * k
+        tokm = packed[:n].reshape(b, k)
+        accs = packed[n : n + b]
+        # same pre-callback occupancy snapshot as _chunk_step: an
+        # on_token adoption mid-delivery must wait for the next
+        # dispatch, not consume this round's stale verify column
+        live = list(enumerate(self._slot_req))
+        self._distribute_firsts(firsts, packed, n + b)
+        delivered = sum(len(reqs) for reqs, _ in firsts)
+        prop_n = acc_n = 0
+        for slot, req in live:
+            if req is None:
+                continue
+            a = int(accs[slot])
+            c = min(a + 1, k)
+            take = min(c, req.max_new_tokens - req.emitted)
+            req.deliver(tokm[slot, :take])
+            req.emitted += take
+            delivered += take
+            if real[slot]:
+                prop_n += k
+                acc_n += a
+                req.spec_rounds += 1
+                req.spec_accepted += a
+            # take < c ⇒ retire; device cur/pos overran the budget,
+            # erased by the next insert (the _insert_impl invariant —
+            # same discipline as the chunk path)
+            if req.done:
+                self._retire(slot)
+        sp.rounds += 1
+        if prop_n:
+            _M_SPEC_PROPOSED.inc(prop_n)
+            _M_SPEC_ACCEPTED.inc(acc_n)
+            sp.proposed_total += prop_n
+            sp.accepted_total += acc_n
+            sp.win_proposed += prop_n
+            sp.win_accepted += acc_n
+            if (
+                sp.min_accept > 0.0
+                and (sp.draft_params is not None
+                     or sp.proposer is not None)
+                and sp.win_proposed >= sp.min_samples
+            ):
+                rate = sp.win_accepted / sp.win_proposed
+                if rate < sp.min_accept:
+                    # below break-even: each round's verify forward
+                    # costs ~k+1 cache rows of attention + one weight
+                    # stream to commit ~rate*k+1 tokens; the chunk
+                    # scan beats that once acceptance collapses
+                    self.disable_spec_decode(reason="acceptance")
+                elif sp.win_proposed >= 2 * sp.min_samples:
+                    # slide the window so the gate tracks the CURRENT
+                    # workload, not the lifetime average
+                    sp.win_proposed //= 2
+                    sp.win_accepted //= 2
+        self._place_waiting()
+        self.tokens_delivered += delivered
+        _M_TOKENS.inc(delivered)
+        _M_STEPS.inc()
+        _M_SLOTS.set(sum(1 for r in self._slot_req if r is not None))
+        _M_STEP.observe(time.monotonic() - t_step0)
+
+    def _chunk_step(self) -> None:
+        """The plain chunked-scan dispatch (step()'s pre-spec body)."""
         t_step0 = time.monotonic()
         firsts = self._pending_first
         self._pending_first = []
@@ -788,12 +1303,20 @@ class LMServer:
         _M_READBACK.observe(time.monotonic() - t_rb0)
         n = self.chunk * self.max_slots
         toks = packed[:n].reshape(self.chunk, self.max_slots)
+        # snapshot occupancy BEFORE any deliver() fires user callbacks:
+        # a callback may adopt a prefilled request (submit_prefilled)
+        # into a slot this step freed — or never occupied — and a live
+        # iteration would then hand the adoptee THIS dispatch's stale
+        # column. The adoptee decodes from the NEXT dispatch; its
+        # placement already delivered the slab's first token exactly
+        # once (tests/test_specdec.py pins the race).
+        live = list(enumerate(self._slot_req))
         self._distribute_firsts(firsts, packed, n)
         # deferred first tokens ride this readback: they are delivered
         # tokens of this step (the chunk takes below cover budget - 1
         # of each request, the placement-time first covers the rest)
         delivered = sum(len(reqs) for reqs, _ in firsts)
-        for slot, req in enumerate(self._slot_req):
+        for slot, req in live:
             if req is None:
                 continue
             take = min(self.chunk, req.max_new_tokens - req.emitted)

@@ -313,6 +313,13 @@ class Outcome:
     #: the warm-vs-cold number the prefix-cache bench phase scores
     turn: int = 0
     ttft_s: Optional[float] = None
+    #: time-per-output-token: mean inter-chunk gap over the request's
+    #: streamed tokens, (last stamp - first stamp) / (chunks - 1),
+    #: from client-side `on_chunk` stamps (None when the request
+    #: streamed < 2 chunks or didn't stream). TTFT scores the prefill
+    #: + queue story; TPOT scores the DECODE loop — speculative
+    #: decoding moves this one.
+    tpot_s: Optional[float] = None
 
 
 def percentile(sorted_vals: Sequence[float], p: float) -> float:
@@ -363,6 +370,10 @@ def summarize(
             o.e2e_s for o in rows
             if o.terminal == TERMINAL_COMPLETED and o.e2e_s is not None
         )
+        tpot = sorted(
+            o.tpot_s for o in rows
+            if o.terminal == TERMINAL_COMPLETED and o.tpot_s is not None
+        )
         completed = sum(1 for o in rows if o.terminal == TERMINAL_COMPLETED)
         shed = sum(1 for o in rows if o.terminal == TERMINAL_SHED)
         rejected = sum(
@@ -386,6 +397,16 @@ def summarize(
                 "p50": round(percentile(lat, 50) * 1e3, 1) if lat else None,
                 "p95": round(percentile(lat, 95) * 1e3, 1) if lat else None,
                 "p99": round(percentile(lat, 99) * 1e3, 1) if lat else None,
+            },
+            # decode-loop tail, from client-observed inter-chunk
+            # stamps (Outcome.tpot_s); None keys when the run didn't
+            # stream — e2e latency folds queue+prefill+decode
+            # together, TPOT isolates the decode loop that
+            # speculative decoding accelerates
+            "tpot_ms": {
+                "p50": round(percentile(tpot, 50) * 1e3, 2) if tpot else None,
+                "p95": round(percentile(tpot, 95) * 1e3, 2) if tpot else None,
+                "p99": round(percentile(tpot, 99) * 1e3, 2) if tpot else None,
             },
         }
 
@@ -609,9 +630,11 @@ async def run_sessions(
                 model=a.model, session=a.session, turn=a.turn,
             ), None
         ttft_box: List[float] = []
+        chunk_ts: List[float] = []
         stream_task = asyncio.ensure_future(ingress.stream_text(
             rid, timeout=wait_timeout,
             on_first=lambda: ttft_box.append(now() - t_sub),
+            on_chunk=lambda _c: chunk_ts.append(now()),
         ))
         try:
             term = await ingress.wait(rid, timeout=wait_timeout)
@@ -639,6 +662,10 @@ async def run_sessions(
                 worker=term.get("worker"), has_result=True,
                 trace_id=term.get("trace_id"), turn=a.turn,
                 ttft_s=ttft_box[0] if ttft_box else None,
+                tpot_s=(
+                    (chunk_ts[-1] - chunk_ts[0]) / (len(chunk_ts) - 1)
+                    if len(chunk_ts) >= 2 else None
+                ),
             ), [int(t) for t in toks]
         return Outcome(
             slo=a.slo,

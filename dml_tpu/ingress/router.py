@@ -180,7 +180,8 @@ class BatchFormer:
       - it is FULL (``batch_size_of(model)`` requests),
       - the pipeline is HUNGRY for its model (caller-observed: a free
         slot and no queued batches) and the batch has lingered at
-        least ``slo.linger_s`` (the light-load coalescing window),
+        least ``slo.linger_s * linger_scale`` (the light-load
+        coalescing window; scale < 1 when backends adopt mid-flight),
       - its SLACK expired: the oldest request's deadline minus the
         batch's estimated exec (with 50% headroom + 50 ms dispatch
         margin) is now — waiting any longer manufactures SLO misses.
@@ -196,13 +197,29 @@ class BatchFormer:
         est_exec_s: Callable[[str, int], float],
         mode: str = "continuous",
         now: Callable[[], float] = time.monotonic,
+        linger_scale: float = 1.0,
     ):
         if mode not in ("continuous", "fixed"):
             raise ValueError(f"unknown formation mode {mode!r}")
+        if not (0.0 <= float(linger_scale) <= 1.0):
+            raise ValueError(
+                f"linger_scale must be in [0, 1], got {linger_scale!r}"
+            )
         self.batch_size_of = batch_size_of
         self.est_exec_s = est_exec_s
         self.mode = mode
         self.now = now
+        #: scales every class's linger window at the hungry-dispatch
+        #: check. The linger exists to amortize batch formation over
+        #: co-batchable arrivals — worth real TTFT when the backend
+        #: drains each batch before starting the next. When the
+        #: serving backends adopt requests into RUNNING batches at
+        #: step granularity (LMServer continuous batching), a late
+        #: arrival merges into the in-flight grid anyway, so holding
+        #: the door open buys nothing: routers fronting adopting
+        #: backends shrink it (0 = dispatch the moment the pipeline
+        #: is hungry).
+        self.linger_scale = float(linger_scale)
         self.forming: Dict[Tuple[str, str, str], FormingBatch] = {}
 
     def add(self, req: PendingRequest, affinity: Optional[str]) -> None:
@@ -252,7 +269,7 @@ class BatchFormer:
             feed = (
                 self.mode == "continuous"
                 and fb.model in hungry
-                and t - fb.opened_at >= fb.slo.linger_s
+                and t - fb.opened_at >= fb.slo.linger_s * self.linger_scale
             )
             if slack_out or feed:
                 del self.forming[key]
@@ -283,16 +300,23 @@ class RequestRouter:
         classes: Optional[Dict[str, SLOClass]] = None,
         formation: str = "continuous",
         tick_s: float = 0.02,
+        linger_scale: float = 1.0,
     ):
         self.jobs = jobs
         self.node = jobs.node
         self.store = jobs.store
         self.classes = dict(classes or DEFAULT_CLASSES)
         self.tick_s = tick_s
+        # linger_scale < 1 is the knob for deployments whose serving
+        # backends adopt requests mid-flight (LM continuous batching,
+        # {"overlap": true} specs): the coalescing window shrinks
+        # because late arrivals merge into running batches at the
+        # next step boundary instead of waiting out a drain
         self.former = BatchFormer(
             batch_size_of=self._batch_size_of,
             est_exec_s=self._est_exec_s,
             mode=formation,
+            linger_scale=linger_scale,
         )
         # --- router (leader) state ---
         self._active: Dict[str, _RequestState] = {}
@@ -1504,10 +1528,24 @@ class RequestRouter:
     async def stream_text(
         self, req_id: str, timeout: float = 30.0,
         on_first: Optional[Callable[[], None]] = None,
+        on_chunk: Optional[Callable[[str], None]] = None,
     ) -> List[str]:
         """Collect a streaming request's token chunks until EOF.
         ``on_first`` fires at the first chunk — the client-side TTFT
-        probe the multi-turn loadgen phase reads."""
+        probe the multi-turn loadgen phase reads. ``on_chunk`` fires
+        per collected chunk (first included) — the loadgen stamps
+        these to build per-request TPOT; residue chunks drained at
+        EOF fire too, so the stamps reflect when the CLIENT observed
+        each token, which is the only TPOT a client can honestly
+        claim."""
+
+        def _note(c: str) -> None:
+            if on_chunk is not None:
+                try:
+                    on_chunk(c)
+                except Exception as e:
+                    log.warning("stream on_chunk hook failed: %r", e)
+
         q = self._streams.get(req_id)
         if q is None:
             raise KeyError(f"{req_id} is not a streaming request")
@@ -1530,8 +1568,10 @@ class RequestRouter:
                         extra = q.get_nowait()
                         if extra is not None:
                             chunks.append(extra)
+                            _note(extra)
                     return chunks
                 chunks.append(item)
+                _note(item)
         finally:
             # the stream is consumed (or abandoned on timeout): drop
             # the queue so drained requests don't occupy the bound

@@ -1326,6 +1326,10 @@ class JobService:
             return
         prompts = d.get("prompts") or []
         budgets = d.get("budgets") or []
+        # remote-draft speculation: the decode primary asks for this
+        # many draft tokens per slab; a backend without a draft model
+        # (or an old one without the parameter) just omits them
+        draft_k = int(d.get("draft_k") or 0)
         # per-request trace contexts shipped by the decode primary:
         # the prefill member records its own `prefill` span per
         # sampled request so the stitched trace shows where the
@@ -1353,7 +1357,14 @@ class JobService:
             async def serve_stream() -> None:
                 t0_wall = time.time()
                 try:
-                    await pf.stream_slabs(prompts, budgets, feed)
+                    if draft_k > 0:
+                        await pf.stream_slabs(
+                            prompts, budgets, feed, draft_k=draft_k
+                        )
+                    else:
+                        # positional form: older/stub prefill backends
+                        # predate the draft_k parameter
+                        await pf.stream_slabs(prompts, budgets, feed)
                     _prefill_spans(t0_wall)
                 finally:
                     # unexpose the moment the puller drains to EOF;
@@ -1377,22 +1388,28 @@ class JobService:
             return
         self._spawn_bg(
             self._serve_prefill(
-                pf, prompts, budgets, msg.sender, rid, _prefill_spans
+                pf, prompts, budgets, msg.sender, rid, _prefill_spans,
+                draft_k=draft_k,
             ),
             f"lm prefill {model} x{len(prompts)}",
         )
 
     async def _serve_prefill(
         self, pf, prompts, budgets, reply_to: str, rid,
-        prefill_spans=None,
+        prefill_spans=None, draft_k: int = 0,
     ) -> None:
         import tempfile
 
         try:
             t0_wall = time.time()
-            data = await asyncio.to_thread(
-                pf.slabs_bytes, prompts, budgets
-            )
+            if draft_k > 0:
+                data = await asyncio.to_thread(
+                    pf.slabs_bytes, prompts, budgets, draft_k
+                )
+            else:
+                data = await asyncio.to_thread(
+                    pf.slabs_bytes, prompts, budgets
+                )
             if prefill_spans is not None:
                 prefill_spans(t0_wall)
             tmpdir = self.store.cfg.download_path()

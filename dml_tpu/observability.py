@@ -188,6 +188,7 @@ line when you add the metric.
     lm_server_readback_seconds       device->host readback stalls
     lm_server_requests_completed_total  LM requests finished
     lm_server_requests_total         LM requests admitted
+    lm_server_slot_occupancy         busy decode slots per dispatched step
     lm_server_slots_active           busy decode slots
     lm_server_slots_total            configured decode slots
     lm_server_step_seconds           decode step wall
@@ -195,6 +196,9 @@ line when you add the metric.
     lm_sharded_batches_total         LM batches on a group engine by mode
     lm_sharded_prefill_slabs_total   KV slabs built by prefill workers
     lm_sharded_tokens_total          tokens from group-sharded serving
+    lm_specdec_accepted_total        draft tokens accepted by verify
+    lm_specdec_disabled_total        spec-decode disable events by reason
+    lm_specdec_proposed_total        draft tokens proposed to verify
     membership_gossip_entries_total  gossip entries carried by mode
     membership_gossip_exchanges_total  gossip payloads built by mode
     membership_join_admitted_total   runtime joins admitted (new|rejoin)

@@ -1912,6 +1912,177 @@ def run_autoscale_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# raw decode speed: speculative decoding + step-granular continuous
+# batching (ISSUE 19). The bench's cluster_lm_sharded section grows
+# `specdec` and `cb` sub-blocks (inference/lm_sharded.py
+# bench_specdec_arm / bench_cb_arm); a round-21+ artifact must show
+# the speculative arm beating plain chunked decode token-identically
+# at its declared acceptance, the miscalibrated draft auto-disabling
+# instead of dragging, and overlap adoption beating the batch-drain
+# baseline on p99 TTFT.
+# ----------------------------------------------------------------------
+
+SPECDEC_REQUIRED_FROM_ROUND = 21
+
+
+def check_specdec_block(path: str) -> List[str]:
+    """Validate the raw-decode arms inside ``cluster_lm_sharded``
+    WHEN THE SECTION RAN:
+
+    - the speculative arm's outputs are token-identical to the plain
+      chunked path (greedy verify is exactness-preserving — any drift
+      means the verify/commit seam is wrong, not "close enough");
+    - measured acceptance lands near the bench's declared rate (the
+      oracle proposer's corruption schedule pins it — drift means the
+      acceptance accounting lies);
+    - steady tok/s speedup > 1 at that acceptance (below break-even
+      the feature must auto-disable, not ship);
+    - the miscalibrated-draft arm DID auto-disable (reason recorded)
+      and still produced exact outputs;
+    - the continuous-batching overlap arm strictly beat the
+      batch-drain baseline on p99 TTFT with equal outputs.
+
+    Artifacts before round ``SPECDEC_REQUIRED_FROM_ROUND`` are
+    exempt; summary-only driver captures gate on the compact line's
+    ``lm_specdec_speedup`` / ``lm_specdec_accept`` /
+    ``lm_cb_ttft_ms`` keys."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < SPECDEC_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        problems = []
+        speedup = s.get("lm_specdec_speedup")
+        if isinstance(speedup, (int, float)) and speedup <= 1.0:
+            problems.append(
+                f"{name}: summary lm_specdec_speedup = {speedup!r} — "
+                "the speculative arm must beat plain chunked decode "
+                "on steady tok/s (below break-even it must disable, "
+                "not ship a loss)"
+            )
+        accept = s.get("lm_specdec_accept")
+        if isinstance(accept, (int, float)) and not (
+                0.0 < accept <= 1.0):
+            problems.append(
+                f"{name}: summary lm_specdec_accept = {accept!r} — "
+                "measured acceptance must be a fraction in (0, 1]"
+            )
+        ttft = s.get("lm_cb_ttft_ms")
+        if isinstance(ttft, (int, float)) and ttft <= 0:
+            problems.append(
+                f"{name}: summary lm_cb_ttft_ms = {ttft!r} — the "
+                "overlap-adoption arm's p99 TTFT must be a positive "
+                "wall-clock measurement"
+            )
+        return problems
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "cluster_lm_sharded" in not_run:
+        return []  # honestly recorded as skipped/errored
+    block = matrix.get("cluster_lm_sharded")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `cluster_lm_sharded` section and not "
+                "recorded as skipped (raw-decode arms unproven)"]
+    if block.get("skipped") or block.get("error"):
+        return []  # section self-reported a skip/error payload
+    problems: List[str] = []
+    spec = block.get("specdec")
+    if not isinstance(spec, dict):
+        problems.append(
+            f"{name}: cluster_lm_sharded.specdec = {spec!r} — "
+            "round-21+ artifacts must carry the speculative-decode "
+            "arm"
+        )
+    else:
+        if spec.get("outputs_equal") is not True:
+            problems.append(
+                f"{name}: specdec.outputs_equal = "
+                f"{spec.get('outputs_equal')!r} — greedy speculative "
+                "decode must be token-identical to the plain path"
+            )
+        accept = spec.get("accept_rate")
+        declared = spec.get("declared_accept")
+        if not isinstance(accept, (int, float)) or not (
+                0.0 < accept <= 1.0):
+            problems.append(
+                f"{name}: specdec.accept_rate = {accept!r} — "
+                "measured acceptance must be a fraction in (0, 1]"
+            )
+        elif isinstance(declared, (int, float)) and abs(
+                accept - declared) > 0.15:
+            problems.append(
+                f"{name}: specdec.accept_rate = {accept!r} vs "
+                f"declared_accept = {declared!r} — the oracle arm's "
+                "measured acceptance must land near the declared "
+                "rate (acceptance accounting drifted)"
+            )
+        speedup = spec.get("speedup")
+        if not isinstance(speedup, (int, float)) or speedup <= 1.0:
+            problems.append(
+                f"{name}: specdec.speedup = {speedup!r} — the "
+                "speculative arm must beat plain chunked decode on "
+                "steady tok/s"
+            )
+        auto = spec.get("auto_disable") or {}
+        if auto.get("disabled") is not True:
+            problems.append(
+                f"{name}: specdec.auto_disable.disabled = "
+                f"{auto.get('disabled')!r} — the miscalibrated draft "
+                "must trip the break-even guard"
+            )
+        if auto.get("outputs_equal") is not True:
+            problems.append(
+                f"{name}: specdec.auto_disable.outputs_equal = "
+                f"{auto.get('outputs_equal')!r} — outputs must stay "
+                "exact even while a bad draft is being rejected"
+            )
+        if spec.get("verdict_green") is not True:
+            problems.append(
+                f"{name}: specdec.verdict_green = "
+                f"{spec.get('verdict_green')!r} — the arm's own "
+                "verdict must be true"
+            )
+    cb = block.get("cb")
+    if not isinstance(cb, dict):
+        problems.append(
+            f"{name}: cluster_lm_sharded.cb = {cb!r} — round-21+ "
+            "artifacts must carry the continuous-batching arm"
+        )
+    else:
+        if cb.get("outputs_equal") is not True:
+            problems.append(
+                f"{name}: cb.outputs_equal = "
+                f"{cb.get('outputs_equal')!r} — step-boundary "
+                "adoption must not perturb decoded tokens"
+            )
+        ratio = cb.get("drain_vs_overlap_p99")
+        if not isinstance(ratio, (int, float)) or ratio <= 1.0:
+            problems.append(
+                f"{name}: cb.drain_vs_overlap_p99 = {ratio!r} — "
+                "overlap adoption must strictly beat the batch-drain "
+                "baseline on p99 TTFT under staggered load"
+            )
+        if cb.get("verdict_green") is not True:
+            problems.append(
+                f"{name}: cb.verdict_green = "
+                f"{cb.get('verdict_green')!r} — the arm's own "
+                "verdict must be true"
+            )
+    return problems
+
+
+def run_specdec_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_specdec_block(
+        artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
 # exists and parses (ISSUE 4 satellite; VERDICT r5 item 1)
@@ -2001,6 +2172,9 @@ def main() -> None:
     for problem in run_autoscale_check(art_path):
         total += 1
         print(f"autoscale block: {problem}")
+    for problem in run_specdec_check(art_path):
+        total += 1
+        print(f"specdec block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")

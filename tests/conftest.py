@@ -77,4 +77,5 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "elastic: elastic-membership coverage (authenticated runtime join/leave, versioned universe, adaptive group re-formation, capacity-change chaos)")
     config.addinivalue_line("markers", "signal: SLO signal-plane coverage (windowed time-series, burn-rate monitors, straggler cross-checks, typed alert lifecycle)")
     config.addinivalue_line("markers", "autoscale: closed-loop autoscaler coverage (SLO-burn-driven scale-out/in, capacity reallocation, decision-ledger replay, controller-aimed chaos)")
+    config.addinivalue_line("markers", "specdec: speculative-decoding coverage (draft propose + batched verify exactness, acceptance accounting and auto-disable, shipped-draft handoff, step-granular adoption races)")
 

@@ -500,10 +500,14 @@ class _FakeIngress:
             }
         return rid
 
-    async def stream_text(self, rid, timeout=30.0, on_first=None):
+    async def stream_text(self, rid, timeout=30.0, on_first=None,
+                          on_chunk=None):
         await asyncio.sleep(0.01)
-        if self._terms[rid].get("ok") and on_first is not None:
-            on_first()
+        if self._terms[rid].get("ok"):
+            if on_first is not None:
+                on_first()
+            if on_chunk is not None:
+                on_chunk("7 ")
         return ["7 "]
 
     async def wait(self, rid, timeout=None):
