@@ -89,6 +89,13 @@ SECTION_EST_S = {
     # pool vs closed-loop controller) + invariant sweeps + the
     # pure-replay decision-stream determinism arm
     "autoscale": 150.0,
+    # elastic cluster training: one live cluster — a TrainJob's
+    # examples/s window-measured at world 1 -> 2 -> 3 as capacity
+    # joins mid-run (checkpoint-restore re-shard at step boundaries,
+    # zero restarts), then a mixed arm scoring interactive-stream
+    # p99 with and without a trainer sharing the pool + the step-
+    # exact invariant sweep
+    "cluster_training": 160.0,
     # control-plane scale matrix: 16/64/128-node membership-only
     # clusters x full-vs-delta gossip (bring-up, traffic window,
     # metrics aggregation, kill + election each) + the 64-node
@@ -762,6 +769,236 @@ def _bench_elastic(out, *, base_port=29940, n_nodes=4, window_s=5.0,
             shutil.rmtree(root, ignore_errors=True)
 
     out["elastic_capacity"] = asyncio.run(run())
+
+
+def _bench_cluster_training(out, *, base_port=30040, n_nodes=3,
+                            window_s=3.0):
+    """Elastic cluster training (ROADMAP item 3's done-condition):
+    a TrainJob's step throughput SCALES as capacity joins mid-run,
+    and interactive latency survives a trainer sharing the pool.
+
+    Arm 1 — scaling curve on ONE live cluster: a data-parallel
+    TrainJob runs on a 3-node cluster (world 1: a single dp shard per
+    step); examples/s is window-measured, then a brand-new node joins
+    through the authenticated path (no restarts) and the run
+    checkpoint-restore re-shards onto the grown pool at the next step
+    boundary (LR rescaled to the new effective global batch);
+    re-measure at world 2 and world 3. PR 4's b64/b128/ga4 sweep
+    (the `train` section) is the single-node baseline this curve
+    grows out of. Per-shard work is real wall (20 ms/file stub), so
+    the examples/s slope measures genuine data-parallel spread — a
+    scheduler that serialized the shards onto one worker would show
+    a flat curve.
+
+    Arm 2 — mixed workload: a fresh TrainJob shares the pool with a
+    closed-loop interactive job stream; the stream's p99 is compared
+    against a trainer-free window on the same cluster and must stay
+    inside the interactive SLO class deadline (the scheduler's
+    `train` class weight 0.5 keeps the trainer in the idle slots).
+
+    The step-exact invariant sweep (chaos section 9) must end green:
+    contiguous exactly-once ledger, replay-equal final state.
+    claim_check gates the block from round 22."""
+    import asyncio
+    import shutil
+
+    from dml_tpu.cluster.chaos import (
+        FAST_TIMING, LocalCluster, invariant_sweep, STUB_MODEL,
+    )
+    from dml_tpu.ingress.slo import DEFAULT_CLASSES
+    from dml_tpu.jobs.train import TrainJobSpec
+
+    root = f"/tmp/dml_tpu_bench_train_{os.getpid()}"
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root, exist_ok=True)
+    shard_batch = 4
+    interactive_deadline = DEFAULT_CLASSES["interactive"].deadline_s
+
+    async def run():
+        cluster = LocalCluster(
+            n_nodes, root, base_port, timing=FAST_TIMING,
+            join_secret="bench-train", train=True,
+        )
+        try:
+            await cluster.start()
+            await cluster.wait_for(cluster.converged, 20.0,
+                                   "training bench convergence")
+            client = cluster.client()
+            dataset = []
+            for i in range(8):
+                name = f"train_shard_{i:02d}.bin"
+                p = os.path.join(root, name)
+                with open(p, "wb") as f:
+                    f.write(bytes([i]) * 256)
+                await client.store.put(p, name)
+                cluster.expect_files.add(name)
+                dataset.append(name)
+            for i in range(4):
+                p = os.path.join(root, f"img_{i}.jpeg")
+                with open(p, "wb") as f:
+                    f.write(b"\xff\xd8fakejpeg" + bytes([i]))
+                await client.store.put(p, f"img_{i}.jpeg")
+                cluster.expect_files.add(f"img_{i}.jpeg")
+            leader = next(sn for sn in cluster.nodes.values()
+                          if sn.node.is_leader)
+
+            # ---- arm 1: the scaling curve, one run, live joins ----
+            spec = TrainJobSpec(
+                name="scale", dataset=dataset, steps=240,
+                shard_batch=shard_batch, base_lr=0.05,
+                checkpoint_every=25, seed=11,
+            )
+            run1 = await leader.jobs.train.start_run(spec)
+            cluster.train_runs.append(spec.name)
+
+            async def measure():
+                """(examples/s, world at window end). Examples/s is
+                the scaling claim: per-shard batch is fixed, so the
+                global batch per step grows with world and the
+                curve measures real parallel spread."""
+                a0 = run1.ledger.applied
+                t0 = asyncio.get_running_loop().time()
+                await asyncio.sleep(window_s)
+                wall = asyncio.get_running_loop().time() - t0
+                sps = (run1.ledger.applied - a0) / wall
+                return sps * shard_batch * run1.world, run1.world
+
+            await asyncio.sleep(1.0)  # ramp
+            curve = []
+            eps, world = await measure()
+            curve.append({"world": world,
+                          "examples_per_s": round(eps, 1)})
+            for _ in range(2):
+                pool0 = len(leader.jobs.worker_pool())
+                w_before = run1.world
+                await cluster.scale_out()
+                await cluster.wait_for(
+                    lambda: len(leader.jobs.worker_pool()) > pool0,
+                    15.0, "joined capacity taking pool slots",
+                )
+                await cluster.wait_for(
+                    lambda: run1.world > w_before or run1.done,
+                    15.0, "run re-sharding onto the joined capacity",
+                )
+                eps, world = await measure()
+                curve.append({"world": world,
+                              "examples_per_s": round(eps, 1)})
+            scale_status = await leader.jobs.train.wait(
+                "scale", timeout=120.0
+            )
+            gain = (
+                curve[-1]["examples_per_s"] / curve[0]["examples_per_s"]
+                if curve[0]["examples_per_s"] > 0 else None
+            )
+
+            # ---- arm 2: mixed workload, p99 with/without trainer --
+            async def stream(stop_when, max_s=25.0):
+                lat: list = []
+
+                async def one():
+                    t_end = (asyncio.get_running_loop().time()
+                             + max_s)
+                    while (not stop_when()
+                           and asyncio.get_running_loop().time()
+                           < t_end):
+                        c = cluster.client()
+                        t0 = asyncio.get_running_loop().time()
+                        try:
+                            jid = await c.jobs.submit_job(
+                                STUB_MODEL, 8, timeout=10.0,
+                                retries=3)
+                            await c.jobs.wait_job(jid, timeout=30.0)
+                            lat.append(
+                                asyncio.get_running_loop().time()
+                                - t0)
+                        except Exception:
+                            await asyncio.sleep(0.1)
+                await asyncio.gather(one(), one())
+                return lat
+
+            spec2 = TrainJobSpec(
+                name="mixed", dataset=dataset, steps=120,
+                shard_batch=shard_batch, base_lr=0.05,
+                checkpoint_every=40, seed=12,
+            )
+            run2 = await leader.jobs.train.start_run(spec2)
+            cluster.train_runs.append(spec2.name)
+            t_mix0 = asyncio.get_running_loop().time()
+            lat_with = await stream(lambda: run2.done)
+            mixed_status = await leader.jobs.train.wait(
+                "mixed", timeout=120.0
+            )
+            mixed_wall = asyncio.get_running_loop().time() - t_mix0
+            mixed_eps = (
+                sum(e["world"] for e in run2.ledger.history)
+                * shard_batch / mixed_wall
+            )
+            done_flag = {"v": False}
+            lat_without = await stream(
+                lambda: done_flag["v"], max_s=2 * window_s
+            )
+
+            def p99(xs):
+                if not xs:
+                    return None
+                xs = sorted(xs)
+                return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+            p99_with, p99_without = p99(lat_with), p99(lat_without)
+            report = await invariant_sweep(cluster, {}, {})
+            join_reshards = int(
+                scale_status["resharding"].get("join", 0)
+            )
+            train_elastic_ok = bool(
+                gain is not None and gain > 1.0
+                and curve[-1]["world"] > curve[0]["world"]
+                and join_reshards >= 1
+                and cluster._restart_counter == 0
+                and scale_status["done"] and mixed_status["done"]
+                and p99_with is not None
+                and p99_with <= interactive_deadline
+                and report.ok
+            )
+            return {
+                "nodes": n_nodes,
+                "window_s": window_s,
+                "shard_batch": shard_batch,
+                "scaling_curve": curve,
+                "scaleout_gain": (
+                    round(gain, 2) if gain is not None else None),
+                "join_reshards": join_reshards,
+                "restarts": cluster._restart_counter,
+                "scale_run": scale_status,
+                "mixed": {
+                    "run": mixed_status,
+                    "examples_per_s": round(mixed_eps, 1),
+                    "interactive_p99_with_trainer_s": (
+                        round(p99_with, 3)
+                        if p99_with is not None else None),
+                    "interactive_p99_without_trainer_s": (
+                        round(p99_without, 3)
+                        if p99_without is not None else None),
+                    "interactive_deadline_s": interactive_deadline,
+                    "jobs_with": len(lat_with),
+                    "jobs_without": len(lat_without),
+                },
+                "sweep_ok": report.ok,
+                "sweep_failures": report.failures,
+                "train_elastic_ok": train_elastic_ok,
+                "note": "examples/s windows measured on the SAME "
+                        "live run as capacity joins mid-flight; "
+                        "re-shard happens at a step boundary via "
+                        "checkpoint-restore, zero process restarts. "
+                        "CPU stub shard executor (20 ms/file), so "
+                        "the scaling RATIO is the claim; the p99 "
+                        "bound is against the interactive SLO class "
+                        "deadline",
+            }
+        finally:
+            await cluster.stop()
+            shutil.rmtree(root, ignore_errors=True)
+
+    out["cluster_training"] = asyncio.run(run())
 
 
 def _bench_signal_plane(out, *, base_port=29960, n_nodes=4):
@@ -3564,6 +3801,13 @@ def main() -> None:
             # BOTH SLO-violation-minutes and chip-idle-minutes
             # (round 20)
             ("autoscale", lambda: _bench_autoscale(out)),
+            # elastic cluster training: CPU-only like chaos — a
+            # TrainJob's examples/s must SCALE as capacity joins
+            # mid-run (re-shard at step boundaries, zero restarts)
+            # and interactive p99 must survive the trainer sharing
+            # the pool (ROADMAP item 3 done-condition, round 22)
+            ("cluster_training",
+             lambda: _bench_cluster_training(out)),
             # control-plane scale matrix: CPU-only, membership-level —
             # the O(100)-node gossip/metrics/churn story (round 12)
             ("control_plane_scale",
@@ -3772,6 +4016,18 @@ def main() -> None:
         "autoscale_idle_min_saved": g(
             "autoscale", "autoscale_idle_min_saved"),
         "autoscale_ok": g("autoscale", "autoscale_ok"),
+        # elastic cluster training (dml_tpu/jobs/train.py, round-22
+        # gate): the mixed arm's trainer examples/s, and the
+        # section's own verdict (positive examples/s slope across
+        # the join-grown worlds, >=1 join re-shard at a step
+        # boundary, zero restarts, both runs step-exact complete,
+        # interactive p99 inside its SLO deadline, green sweep)
+        "train_step_qps": g("cluster_training", "mixed",
+                            "examples_per_s"),
+        "train_elastic_ok": g("cluster_training",
+                              "train_elastic_ok"),
+        "train_scaleout_gain": g("cluster_training",
+                                 "scaleout_gain"),
         # static-analysis verdict (tools/dmllint.py, round-11 gate);
         # the flow-aware pass counts (tools/dmlflow.py: race-yield-
         # hazard / drift-wire-payloads, baselined findings included)
@@ -3902,7 +4158,9 @@ COMPACT_SUMMARY_BUDGET = 1500
 #: autoscale_ok + autoscale_slo_min_saved the round-20 autoscaler
 #: gate; lm_specdec_speedup + lm_specdec_accept + lm_cb_ttft_ms the
 #: round-21 raw-decode gate (speculative verify speedup at the
-#: measured acceptance, continuous-batching p99 TTFT).
+#: measured acceptance, continuous-batching p99 TTFT); train_step_qps
+#: + train_elastic_ok the round-22 elastic-training gate (trainer
+#: examples/s under mixed load, step-exact elasticity verdict).
 _COMPACT_KEEP_KEYS = (
     "headline_qps", "cluster_qps", "cluster_pipelining",
     "cluster_lm_tok_s", "cluster_lm_steady_tok_s",
@@ -3924,6 +4182,7 @@ _COMPACT_KEEP_KEYS = (
     "autoscale_ok", "autoscale_slo_min_saved",
     "lm_specdec_speedup", "lm_specdec_accept",
     "lm_cb_ttft_ms",
+    "train_step_qps", "train_elastic_ok",
     "section_errors", "sections_skipped",
 )
 

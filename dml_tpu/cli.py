@@ -768,7 +768,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "2%% loss + duplicate delivery)")
     pc.add_argument("--scenario", default=None,
                     choices=["asym", "disk", "dns", "skew", "fuzz",
-                             "churn", "elastic", "liar", "autoscale"],
+                             "churn", "elastic", "liar", "autoscale",
+                             "train"],
                     help="run one adversarial scenario family: "
                          "asym(metric partition), disk(-full + "
                          "corruption), dns (introducer outage during "
@@ -782,7 +783,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "cross-check must catch it), autoscale "
                          "(controller-aimed chaos: thrashing load, "
                          "liar-fed policy, scale-in racing a spike, "
-                         "leader kill mid-decision)")
+                         "leader kill mid-decision), train "
+                         "(trainer-aimed chaos: trainer kill "
+                         "mid-epoch, leader kill mid-checkpoint, "
+                         "capacity join racing a step boundary — the "
+                         "sweep replays the step ledger against the "
+                         "exactly-once oracle)")
     pc.add_argument("--plan", default=None, metavar="FILE",
                     help="replay a saved plan JSON instead of generating")
     pc.add_argument("--dump", default=None, metavar="FILE",

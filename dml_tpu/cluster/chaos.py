@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import json
 import logging
 import os
 import random
@@ -251,9 +252,14 @@ EVENT_KINDS = (
 #: thrashing square-wave load against the scale-out hysteresis, a
 #: lying straggler feeding the policy, a scale-in racing a traffic
 #: spike, and a leader kill between a decision firing and its
-#: actuation ACK — is claim_check-gated from round 20)
+#: actuation ACK — is claim_check-gated from round 20;
+#: "train" — chaos aimed at a TrainJob's exactly-once step contract:
+#: a trainer killed mid-epoch, a leader killed inside the
+#: checkpoint-every-step window, and a join racing a step boundary —
+#: the sweep proves no global step lost or double-applied — is
+#: claim_check-gated from round 22)
 SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz", "churn",
-                     "elastic", "liar", "autoscale")
+                     "elastic", "liar", "autoscale", "train")
 
 
 @dataclass(frozen=True)
@@ -314,6 +320,12 @@ class ChaosPlan:
     #: adds the decision-plane checks — exactly-once actuation, pool
     #: never decided below floor, no in-flight batch on a retiree
     autoscale: bool = False
+    #: arm an elastic training run: the runner seeds sharded dataset
+    #: files, starts a TrainJob on the coordinator before the event
+    #: schedule, waits for it to finish before the sweep, and the
+    #: sweep adds the step-exact checks — contiguous exactly-once
+    #: ledger, replay-equal final state, zero gradient drift
+    train: bool = False
 
     def __post_init__(self):
         object.__setattr__(
@@ -336,6 +348,8 @@ class ChaosPlan:
             out["join_secret"] = self.join_secret
         if self.autoscale:
             out["autoscale"] = True
+        if self.train:
+            out["train"] = True
         return out
 
     @classmethod
@@ -347,6 +361,7 @@ class ChaosPlan:
             name=str(d.get("name", "chaos")),
             join_secret=str(d.get("join_secret", "")),
             autoscale=bool(d.get("autoscale", False)),
+            train=bool(d.get("train", False)),
             events=tuple(
                 event(e["t"], e["kind"], e.get("target"),
                       **e.get("args", {}))
@@ -640,6 +655,17 @@ def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
       proposal that a traffic spike then races, and the leader is
       killed in the decision window — the promoted leader inherits
       the relayed ledger and must not actuate any decision twice.
+    - ``train``: chaos aimed at a TrainJob's exactly-once step
+      contract (plan.train arms a paced elastic run before the
+      schedule): a trainer holding an in-flight shard is killed
+      mid-epoch (the step must complete on a survivor, the next
+      boundary re-shards), a join races a step boundary (the run
+      soaks the new capacity with the LR rescaled), and the leader
+      is killed inside the checkpoint-every-step window — the
+      promoted coordinator adopts the run from the store blob and
+      the monotone ledger refuses whatever the shadow job
+      double-completes. The sweep replays the ledger against the
+      final state: no step lost, none applied twice.
 
     Timings are seed-jittered: one seed reproduces one schedule,
     different seeds explore different interleavings.
@@ -658,6 +684,36 @@ def scenario_plan(family: str, seed: int, n_nodes: int = 5) -> ChaosPlan:
         event(j(0.1, 0.3), "put", name=seed_file, size=1024),
         event(j(0.4, 0.6), "job", n=16),
     ]
+    if family == "train":
+        events += [
+            # the run itself is armed by the runner BEFORE the event
+            # schedule (paced via min_step_s so it spans it); the job
+            # bursts keep SLO-classed inference sharing the pool the
+            # whole way through
+            event(j(0.9, 1.1), "job", n=16),
+            # a trainer dies mid-epoch holding an in-flight shard:
+            # the batch requeues onto a survivor, the step completes
+            # exactly once, and the next boundary re-shards the run
+            # down (reason="failure")
+            event(j(1.4, 1.7), "crash", "trainer"),
+            event(j(2.4, 2.7), "restart"),
+            # a join races a step boundary: the pool grows mid-step
+            # and the run soaks the capacity at the NEXT boundary
+            # (reason="join"), LR rescaled to the new global batch
+            event(j(3.1, 3.4), "scale_out", n=1),
+            event(j(3.8, 4.1), "job", n=12),
+            # the leader dies inside the checkpoint-every-step
+            # window: the promoted coordinator adopts the run from
+            # the store's checkpoint blob and the monotone ledger
+            # refuses whatever the shadow step job double-completes
+            event(j(4.6, 4.9), "crash", "leader"),
+            event(j(6.0, 6.4), "job", n=12),
+        ]
+        return ChaosPlan(seed=seed, events=tuple(events),
+                         n_nodes=n_nodes, settle_s=2.0,
+                         name=f"train-{seed}",
+                         join_secret=f"chaos-train-{seed}",
+                         train=True)
     if family == "autoscale":
         events += [
             # phase 1 — thrash: square-wave bursts with gaps shorter
@@ -995,6 +1051,7 @@ class LocalCluster:
         autoscale: bool = False,
         autoscale_policy: Optional[AutoscalePolicy] = None,
         backend_per_file_s: float = 0.004,
+        train: bool = False,
     ):
         """`worker_groups` (config.WorkerGroupSpec list) pools nodes
         into tensor-parallel serving groups (jobs/groups.py); the
@@ -1034,6 +1091,14 @@ class LocalCluster:
         `autoscale_policy` overrides the product-default knobs
         (chaos/bench envelopes install CHAOS_AUTOSCALE_POLICY).
 
+        `train=True` marks the run as a training scenario: the chaos
+        runner arms an elastic TrainJob (dataset PUTs + a paced run
+        on the coordinator) and records its name in `train_runs`,
+        which gates the invariant sweep's step-exact checks. The
+        trainer backend itself is registered unconditionally (every
+        JobService attaches a TrainCoordinator), so restarts and
+        joiners can execute shards in any mode.
+
         `backend_per_file_s` sets the stub backend's per-file wall —
         the default 4ms keeps chaos runs snappy; the diurnal probe
         slows it so a realistic open-loop trace can genuinely
@@ -1072,6 +1137,11 @@ class LocalCluster:
         self.autoscale = autoscale
         self.autoscale_policy = autoscale_policy
         self.backend_per_file_s = backend_per_file_s
+        #: names of TrainJob runs armed by the chaos runner (or a
+        #: test); non-empty gates the invariant sweep's step-exact
+        #: training checks (section 9)
+        self.train = train
+        self.train_runs: List[str] = []
         self._make_jobs = make_jobs or self._default_jobs
         self.with_ingress = with_ingress
         self.ingress_formation = ingress_formation
@@ -1593,6 +1663,23 @@ class LocalCluster:
             # join-flap target)
             live = [u for u in self.joined_live if u in self.nodes]
             return live[-1] if live else None
+        if target == "trainer":
+            # the live worker currently executing a TrainJob shard
+            # (an in-flight cluster-trainer batch on the coordinator's
+            # board); falls back to a plain worker so the kill still
+            # fires if the dispatch raced the schedule
+            from ..jobs.train import TRAIN_MODEL
+
+            leader = self.resolve_target("leader")
+            sn = self.nodes.get(leader) if leader else None
+            if sn is not None and getattr(sn, "jobs", None) is not None:
+                for uname, b in sorted(
+                    sn.jobs.scheduler.in_progress.items()
+                ):
+                    if getattr(b, "model", "") == TRAIN_MODEL \
+                            and uname in self.nodes:
+                        return uname
+            return self.resolve_target("worker")
         if target == "skewed":
             # the live node whose SWIM clock runs furthest AHEAD (the
             # mask-a-real-failure victim of the skew scenario)
@@ -1980,6 +2067,88 @@ async def invariant_sweep(
             "floor": floor,
         }
 
+    # 9. TrainJob step-exact accounting (plans that armed a training
+    # run): on the (possibly promoted) coordinator, every armed run
+    # completed with a CONTIGUOUS exactly-once ledger — history is
+    # exactly steps 0..N-1, each applied once — and the final
+    # parameter state equals a from-scratch replay of that ledger.
+    # Deterministic per-file gradients make the replay the oracle: a
+    # lost step, a double-apply, or a wrong (world, lr) at any step
+    # cannot reproduce the same floats. Worker-reported gradients
+    # never drifted from the reference, and the final checkpoint blob
+    # in the store agrees with the live state (the adoptable truth a
+    # NEXT failover would restore).
+    if getattr(cluster, "train_runs", None):
+        from ..jobs.train import TRAIN_CKPT_PREFIX, replay_reference
+
+        trains: Dict[str, Any] = {}
+        for name in cluster.train_runs:
+            run = None
+            if leader_sn is not None and leader_sn.jobs is not None:
+                run = leader_sn.jobs.train.runs.get(name)
+            if run is None or not run.done:
+                failures.append(
+                    f"train run {name} missing or unfinished on the "
+                    "coordinator"
+                )
+                continue
+            led = run.ledger
+            got = [e["step"] for e in led.history]
+            if got != list(range(run.spec.steps)):
+                failures.append(
+                    f"train run {name} ledger is not contiguous "
+                    f"exactly-once (applied={led.applied}, "
+                    f"steps={run.spec.steps})"
+                )
+            if run.state != replay_reference(run.spec, led.history):
+                failures.append(
+                    f"train run {name} final state != ledger replay "
+                    "(a step was lost or double-applied)"
+                )
+            if run.grad_mismatches:
+                failures.append(
+                    f"train run {name}: {run.grad_mismatches} worker "
+                    "gradient(s) drifted from the deterministic "
+                    "reference"
+                )
+            try:
+                blob = await cluster.client().store.get_bytes(
+                    TRAIN_CKPT_PREFIX + name
+                )
+                d = json.loads(blob.decode())
+                if not d.get("done"):
+                    failures.append(
+                        f"train run {name} final checkpoint not "
+                        "marked done"
+                    )
+                if [float(x) for x in d.get("state", [])] != run.state:
+                    failures.append(
+                        f"train run {name} checkpoint state != live "
+                        "state"
+                    )
+            except Exception as e:
+                failures.append(
+                    f"train run {name} final checkpoint unreadable: "
+                    f"{e!r}"
+                )
+            trains[name] = {
+                "applied": led.applied,
+                "steps": run.spec.steps,
+                # every world size the run stepped at (from the ledger
+                # itself, so re-shards on a PRE-failover coordinator
+                # are visible too): >1 entry proves the run actually
+                # re-sharded mid-flight
+                "worlds": sorted({int(e["world"]) for e in led.history}),
+                "final_world": run.world,
+                "final_lr": run.lr,
+                "resharding": dict(run.resharding),
+                "duplicates_refused": led.duplicates_refused,
+                "out_of_order_refused": led.out_of_order_refused,
+                "redispatches": run.redispatches,
+                "ckpt_puts": run.ckpt_puts,
+            }
+        checks["train"] = trains
+
     return InvariantReport(ok=not failures, failures=failures, checks=checks)
 
 
@@ -2112,6 +2281,69 @@ class ChaosRunner:
                     continue
                 raise
         raise RuntimeError(f"get {name} failed on 3 clients") from last
+
+    # ---- training workload (plan.train) ----
+
+    def _train_leader_run(self, name: str):
+        """The current coordinator's view of a run (or None) — re-
+        resolved per call because the leader moves under chaos."""
+        leader = self.cluster.resolve_target("leader")
+        sn = self.cluster.nodes.get(leader) if leader else None
+        if sn is None or getattr(sn, "jobs", None) is None:
+            return None
+        return sn.jobs.train.runs.get(name)
+
+    async def _arm_train(self) -> None:
+        """Seed the sharded dataset into the store and start a paced
+        elastic TrainJob on the coordinator BEFORE the event schedule
+        — the scenario's kills and joins then land mid-run. Paced via
+        ``min_step_s`` so the run spans the schedule instead of
+        finishing before the first fault."""
+        from ..jobs.train import TrainJobSpec
+
+        dataset = []
+        for i in range(8):
+            fname = f"train_shard_{i:02d}.bin"
+            await self._do_put(fname, 256)
+            dataset.append(fname)
+        spec = TrainJobSpec(
+            name=f"chaos{self.plan.seed}",
+            dataset=dataset,
+            steps=60,
+            shard_batch=2,
+            base_lr=0.1,
+            # checkpoint EVERY step: any leader kill lands inside the
+            # checkpoint window, and the adopted blob is never more
+            # than one step stale
+            checkpoint_every=1,
+            min_step_s=0.12,
+            seed=self.plan.seed,
+        )
+        leader = self.cluster.resolve_target("leader")
+        sn = self.cluster.nodes.get(leader) if leader else None
+        if sn is None or getattr(sn, "jobs", None) is None:
+            raise RuntimeError("no coordinator to start the train run")
+        await sn.jobs.train.start_run(spec)
+        self.cluster.train_runs.append(spec.name)
+
+    async def _drain_train(self) -> List[str]:
+        """Wait for every armed run to complete on the (possibly
+        promoted) coordinator. A run that can't finish despite the
+        re-dispatch + adoption machinery is a recovery failure."""
+        errors: List[str] = []
+        for name in self.cluster.train_runs:
+
+            def _done(name: str = name) -> bool:
+                run = self._train_leader_run(name)
+                return run is not None and run.done
+
+            try:
+                await self.cluster.wait_for(
+                    _done, 90.0, f"train run {name} completion"
+                )
+            except Exception as e:
+                errors.append(f"train run {name} did not finish: {e!r}")
+        return errors
 
     def _do_fuzz(self, n: int) -> Dict[str, int]:
         """Inject one seeded byzantine burst at every live transport
@@ -2427,6 +2659,13 @@ class ChaosRunner:
         # content-integrity probes of the final sweep
         for i in range(4):
             await self._do_put(f"chaos_img_{i}.jpeg", 512)
+        train_errors: List[str] = []
+        if self.plan.train:
+            try:
+                await self._arm_train()
+            except Exception as e:
+                log.exception("chaos: train arming failed")
+                train_errors.append(f"train arming failed: {e!r}")
         loop = asyncio.get_running_loop()
         t0 = loop.time()
         for ev in self.plan.events:
@@ -2454,6 +2693,11 @@ class ChaosRunner:
                     workload_errors.append(
                         f"workload {t.get_name()}: {t.exception()!r}"
                     )
+        # an armed training run must finish before the sweep: the
+        # step-exact checks compare a COMPLETE ledger against the
+        # final state, and a run still limping here means recovery
+        # (re-dispatch, adoption) failed — a failure in its own right
+        train_errors += await self._drain_train()
         # recovery monitors get a bounded drain too
         if self._bg:
             await asyncio.wait(self._bg, timeout=30.0)
@@ -2477,7 +2721,10 @@ class ChaosRunner:
             f"event t={r['t']} {r['kind']} failed: {r['error']}"
             for r in self.executed if "error" in r
         ]
-        report.failures = workload_errors + event_errors + report.failures
+        report.failures = (
+            workload_errors + train_errors + event_errors
+            + report.failures
+        )
         report.ok = not report.failures
         return ChaosReport(
             plan=self.plan,
@@ -2515,6 +2762,7 @@ async def run_plan(
         autoscale_policy=(
             CHAOS_AUTOSCALE_POLICY if plan.autoscale else None
         ),
+        train=plan.train,
     )
     try:
         await cluster.start()

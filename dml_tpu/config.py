@@ -359,6 +359,11 @@ class ClusterSpec:
     join_secret: str = ""
     #: version of the node table; bumps on every admitted join/leave
     universe_epoch: int = 0
+    # ---- elastic cluster training (jobs/train.py) ----
+    # fair-share weight of the `train` SLO class: below `batch` (1.0)
+    # and far below `interactive` (3.0), so a TrainJob soaks idle
+    # slots without queueing interactive work behind it
+    train_class_weight: float = 0.5
 
     # ---- lookups (reference Config.get_node*, config.py:116-144) ----
     # Lookup tables and the ring order are recomputed by `_reindex`

@@ -52,6 +52,12 @@ DEFAULT_CLASSES: Dict[str, SLOClass] = {
                             queue_limit=256, linger_s=0.02),
     "batch": SLOClass("batch", deadline_s=30.0,
                       queue_limit=4096, linger_s=0.10),
+    # TrainJob step jobs (jobs/train.py): deadline-tolerant throughput
+    # work — the scheduler's class weight (train: 0.5, below batch)
+    # is what actually protects interactive p99; the loose deadline
+    # here just keeps a step job from ever being shed at the door
+    "train": SLOClass("train", deadline_s=120.0,
+                      queue_limit=64, linger_s=0.10),
 }
 
 

@@ -586,7 +586,7 @@ class Scheduler:
         # longer queue interactive requests behind its whole backlog.
         # Unknown/None classes weigh 1.0; set to {} to restore FIFO.
         self.class_weights: Dict[str, float] = {
-            "interactive": 3.0, "batch": 1.0,
+            "interactive": 3.0, "batch": 1.0, "train": 0.5,
         }
         # model -> class -> batches granted (the cross-round deficit
         # memory that keeps single-slot rounds from starving the

@@ -47,6 +47,7 @@ from ..observability import METRICS
 from ..tracing import CURRENT_CTXS, TRACER, TraceContext
 from ..autoscale import AutoscaleController
 from ..signal import SignalPlane
+from .train import TrainCoordinator
 from .cost_model import ModelCost, overlap_headroom
 from .groups import GroupDirectory, note_group_requeue
 from .scheduler import Assignment, Batch, DepthController, Scheduler
@@ -347,6 +348,10 @@ class JobService:
         # verbs — a bare cluster still gets reallocation + a typed
         # decision stream.
         self.autoscale = AutoscaleController(node, jobs=self, plane=self.signal)
+        # elastic data-parallel training (train.py): registers the
+        # trainer backend + SLO class on every node, drives runs and
+        # adopts checkpointed ones only while this node leads
+        self.train = TrainCoordinator(node, jobs=self)
         # chaos seam (`liar` event): stall each batch for this many
         # seconds AFTER measuring exec_time, so the self-reported wall
         # stays clean while the leader's dispatch->ACK observation
@@ -379,6 +384,7 @@ class JobService:
         )
         self.signal.start()
         self.autoscale.start()
+        self.train.start()
         interval = getattr(self.node.spec, "jobs_checkpoint_interval", 0.0)
         if interval and interval > 0:
             self._ckpt_task = asyncio.create_task(
@@ -416,6 +422,7 @@ class JobService:
                 log.exception("%s: auto checkpoint failed", self._me)
 
     async def stop(self) -> None:
+        await self.train.stop()
         await self.autoscale.stop()
         await self.signal.stop()
         ct = getattr(self, "_ckpt_task", None)

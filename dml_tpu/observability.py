@@ -244,6 +244,10 @@ line when you add the metric.
     tracing_exemplars_total          tail-exemplar span captures by kind
     tracing_spans_dropped_total      flight-recorder ring evictions
     tracing_spans_total              finished spans observed by sampled=
+    train_effective_batch            shard_batch x world by run=
+    train_resharding_total           ckpt-restore re-shards by reason=
+    train_step_wall_seconds          dispatch->applied step wall
+    train_steps_total                global steps applied exactly once
     transport_bytes_received_total   datagram bytes in by msg type
     transport_bytes_sent_total       datagram bytes out by msg type
     transport_malformed_dropped_total  frames dying in Message.unpack

@@ -56,9 +56,8 @@ def make_lm(mesh: Mesh, seq_parallel: str = "ring", **config) -> TransformerLM:
         def attention(q, k, v, causal=True):
             return attn(q, k, v, causal=causal)
     else:
-        from jax import shard_map
-
         from ..ops import flash_attention
+        from .pipeline import shard_map_nocheck
 
         # GSPMD can't partition an opaque pallas_call, so place the
         # kernel per-device explicitly: batch over dp, heads over tp
@@ -85,11 +84,11 @@ def make_lm(mesh: Mesh, seq_parallel: str = "ring", **config) -> TransformerLM:
                         mesh.shape.get("dp", 1), mesh.shape.get("tp", 1),
                     )
                 return flash_attention(q, k, v, causal=causal)
-            # check_vma=False: pallas_call out_shapes carry no vma
+            # checking stays off: pallas_call out_shapes carry no vma
             # info, and the kernel is per-device pure anyway
-            return shard_map(
+            return shard_map_nocheck(
                 local, mesh=mesh, in_specs=(spec, spec, spec),
-                out_specs=spec, check_vma=False,
+                out_specs=spec,
             )(q, k, v)
 
     return TransformerLM(attention=attention, mesh=mesh, **config)

@@ -46,22 +46,37 @@ except ImportError:  # pragma: no cover
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
-def shard_map_nocheck(f, *, mesh, in_specs, out_specs):
-    """shard_map with replication checking off, across the jax API
-    rename (>= 0.7 calls the kwarg ``check_vma``; 0.4.x calls it
-    ``check_rep``). The checker rejects the masked psum-collect
+def shard_map_nocheck(f, *, mesh, in_specs, out_specs, check=False):
+    """shard_map across the jax replication-checker API rename
+    (>= 0.7 calls the kwarg ``check_vma``; 0.4.x calls it
+    ``check_rep``) — the single seam every sharded kernel in this
+    package goes through instead of spelling the try/except locally.
+    Checking defaults off: the checker rejects the masked psum-collect
     pattern both this module and the pipelined LM serving form
-    (inference/lm_sharded.py) use, so it is off in both."""
+    (inference/lm_sharded.py) use. Callers whose bodies are checkable
+    (ring/ulysses reference paths) pass ``check=True`` to keep it."""
     try:
         return shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
+            check_vma=check,
         )
     except TypeError:
         return shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False,
+            check_rep=check,
         )
+
+
+def pcast_varying(x, axes):
+    """``pcast(..., to="varying")`` across the same API generations as
+    `shard_map_nocheck`: >= 0.9 spells it ``pcast``, 0.7/0.8
+    ``pvary``, and 0.4.x has no vma type system at all (``check_rep``
+    instead of ``check_vma``) — there the cast is an identity."""
+    if hasattr(jax.lax, "pcast"):  # pragma: no cover - jax >= 0.9
+        return jax.lax.pcast(x, axes, to="varying")
+    if hasattr(jax.lax, "pvary"):  # pragma: no cover - jax 0.7/0.8
+        return jax.lax.pvary(x, axes)
+    return x
 
 
 def stack_stage_params(per_stage: Sequence[Any]) -> Any:

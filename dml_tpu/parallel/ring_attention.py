@@ -27,11 +27,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-try:  # jax >= 0.8 promotes shard_map out of experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .pipeline import pcast_varying, shard_map_nocheck
 
 NEG_INF = -1e30
 
@@ -64,11 +62,11 @@ def _ring_attention_local(
     b, t_local, h, d = q.shape
     q_pos = my_idx * t_local + jnp.arange(t_local)  # global query positions
 
-    # pcast-to-varying: the scan carry must be device-varying like
-    # q/k/v are, or shard_map's type checker rejects the loop
-    # (jax >= 0.9; pvary spelling deprecated)
+    # cast-to-varying: the scan carry must be device-varying like
+    # q/k/v are, or shard_map's vma type checker rejects the loop
+    # (identity on jax generations without the vma system)
     def varying(x):
-        return jax.lax.pcast(x, (batch_axis, axis_name), to="varying")
+        return pcast_varying(x, (batch_axis, axis_name))
 
     o = varying(jnp.zeros((b, h, t_local, d), jnp.float32))
     m = varying(jnp.full((b, h, t_local), NEG_INF, jnp.float32))
@@ -130,7 +128,7 @@ def _ring_attention_local_flash(
     b, t_local, h, d = q.shape
 
     def varying(x):
-        return jax.lax.pcast(x, (batch_axis, axis_name), to="varying")
+        return pcast_varying(x, (batch_axis, axis_name))
 
     out0 = varying(jnp.zeros((b, t_local, h, d), jnp.float32))
     lse0 = varying(jnp.full((b, h, t_local), NEG_INF, jnp.float32))
@@ -215,14 +213,14 @@ def ring_attention(
             _ring_attention_local, axis_name=axis_name, batch_axis="dp",
             causal=causal, scale=scale,
         )
-    fn = shard_map(
+    fn = shard_map_nocheck(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         # pallas_call outputs carry no vma info; the body is
         # per-device pure either way
-        check_vma=not use_flash,
+        check=not use_flash,
     )
     return fn(q, k, v)
 

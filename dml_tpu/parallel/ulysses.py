@@ -46,12 +46,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-try:  # jax >= 0.8 promotes shard_map out of experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .pipeline import shard_map_nocheck
 from .ring_attention import reference_attention
 
 
@@ -152,10 +149,10 @@ def ulysses_attention(
         _ulysses_local, axis_name=axis_name, causal=causal,
         scale=scale, use_flash=use_flash,
     )
-    return shard_map(
+    return shard_map_nocheck(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=not use_flash,
+        check=not use_flash,
     )(q, k, v)

@@ -353,7 +353,8 @@ CHAOS_SCENARIOS_REQUIRED_FROM_ROUND = 8
 #: cluster/chaos.py SCENARIO_FAMILIES — kept literal here so this
 #: tool stays importable without the cluster stack)
 CHAOS_SCENARIO_FAMILIES = ("asym", "disk", "dns", "skew", "fuzz",
-                           "churn", "elastic", "liar", "autoscale")
+                           "churn", "elastic", "liar", "autoscale",
+                           "train")
 
 #: "churn" (sustained seeded join/leave) landed with the round-12
 #: control-plane scale work; earlier artifacts predate the family
@@ -374,6 +375,11 @@ CHAOS_LIAR_REQUIRED_FROM_ROUND = 19
 #: landed with the round-20 autoscaler work; earlier artifacts
 #: predate the family
 CHAOS_AUTOSCALE_REQUIRED_FROM_ROUND = 20
+
+#: "train" (trainer-aimed chaos: trainer kill mid-epoch, leader kill
+#: mid-checkpoint, capacity join racing a step boundary) landed with
+#: the round-22 elastic-training work; earlier artifacts predate it
+CHAOS_TRAIN_REQUIRED_FROM_ROUND = 22
 
 
 def check_chaos_block(path: str) -> List[str]:
@@ -453,6 +459,12 @@ def check_chaos_block(path: str) -> List[str]:
             fam == "autoscale"
             and rnd is not None
             and rnd < CHAOS_AUTOSCALE_REQUIRED_FROM_ROUND
+        ):
+            continue  # the family predates this artifact
+        if (
+            fam == "train"
+            and rnd is not None
+            and rnd < CHAOS_TRAIN_REQUIRED_FROM_ROUND
         ):
             continue  # the family predates this artifact
         entry = scenarios.get(fam)
@@ -2083,6 +2095,139 @@ def run_specdec_check(artifact_path: Optional[str] = None) -> List[str]:
 
 
 # ----------------------------------------------------------------------
+# round-22 elastic cluster training: TrainJob as a first-class
+# workload (jobs/train.py; bench _bench_cluster_training; ISSUE 20
+# tentpole). The claim is step-exact elasticity: examples/s must RISE
+# when capacity joins mid-run via re-shard at a step boundary (zero
+# restarts), no global step lost or double-applied, and the trainer
+# must not evict interactive work past its SLO deadline.
+# ----------------------------------------------------------------------
+
+#: first round whose bench must carry the cluster_training section;
+#: earlier artifacts predate the TrainJob subsystem
+TRAIN_REQUIRED_FROM_ROUND = 22
+
+
+def check_train_block(path: str) -> List[str]:
+    """Validate the ``cluster_training`` section WHEN IT RAN:
+
+    - the scaling arm's examples/s strictly rose after capacity
+      joined mid-run (``scaleout_gain`` > 1 with a world-growing
+      curve) — an elastic trainer that cannot convert joins into
+      throughput is elastic in name only;
+    - at least one ``join`` re-shard happened at a step boundary and
+      zero nodes were restarted to get it (capacity moves through
+      the authenticated join path, never through crashes);
+    - the post-run invariant sweep came back green — it replays the
+      step ledger against the exactly-once oracle, so a green sweep
+      IS the no-step-lost/no-step-double-applied proof;
+    - the mixed arm kept interactive p99 within its SLO deadline
+      while the trainer shared the pool.
+
+    Artifacts before round ``TRAIN_REQUIRED_FROM_ROUND`` are exempt;
+    summary-only driver captures gate on the compact line's
+    ``train_step_qps`` / ``train_elastic_ok`` keys."""
+    from .parity_table import load_bench
+
+    name = os.path.basename(path)
+    rnd = artifact_round(path)
+    if rnd is not None and rnd < TRAIN_REQUIRED_FROM_ROUND:
+        return []
+    data = load_bench(path)
+    if data.get("_summary_only"):
+        s = data.get("summary") or {}
+        problems = []
+        if s.get("train_elastic_ok") is False:
+            problems.append(
+                f"{name}: summary train_elastic_ok is false — the "
+                "trainer lost a step, failed to scale on join, or "
+                "evicted interactive work past its deadline"
+            )
+        qps = s.get("train_step_qps")
+        if isinstance(qps, (int, float)) and qps <= 0:
+            problems.append(
+                f"{name}: summary train_step_qps = {qps!r} — the "
+                "mixed arm's trainer examples/s must be positive"
+            )
+        return problems
+    matrix = data.get("matrix", {})
+    not_run = set(matrix.get("_skipped", {})) | set(matrix.get("_errors", {}))
+    if "cluster_training" in not_run:
+        return []  # honestly recorded as skipped/errored
+    block = matrix.get("cluster_training")
+    if block is None:
+        if rnd is None and "cluster_serving" not in matrix:
+            return []  # partial/preview artifact without cluster runs
+        return [f"{name}: no `cluster_training` section and not "
+                "recorded as skipped (elastic-training claim unproven)"]
+    problems: List[str] = []
+    gain = block.get("scaleout_gain")
+    if not isinstance(gain, (int, float)) or gain <= 1.0:
+        problems.append(
+            f"{name}: cluster_training.scaleout_gain = {gain!r} — "
+            "examples/s must strictly rise after capacity joins "
+            "mid-run"
+        )
+    curve = block.get("scaling_curve")
+    if (not isinstance(curve, list) or len(curve) < 2
+            or not all(isinstance(p, dict) for p in curve)):
+        problems.append(
+            f"{name}: cluster_training.scaling_curve = {curve!r} — "
+            "the section must record the step-throughput curve "
+            "across at least two pool sizes"
+        )
+    else:
+        worlds = [p.get("world") for p in curve]
+        if worlds != sorted(worlds) or worlds[-1] <= worlds[0]:
+            problems.append(
+                f"{name}: cluster_training.scaling_curve worlds = "
+                f"{worlds!r} — the data-parallel world must grow "
+                "across the curve (joins never re-sharded the run?)"
+            )
+    if not block.get("join_reshards"):
+        problems.append(
+            f"{name}: cluster_training.join_reshards = "
+            f"{block.get('join_reshards')!r} — at least one join "
+            "must land as a step-boundary re-shard"
+        )
+    if block.get("restarts") != 0:
+        problems.append(
+            f"{name}: cluster_training.restarts = "
+            f"{block.get('restarts')!r} — elasticity must come from "
+            "re-sharding, never from restarting nodes"
+        )
+    if block.get("sweep_ok") is not True:
+        problems.append(
+            f"{name}: cluster_training.sweep_ok = "
+            f"{block.get('sweep_ok')!r} — the invariant sweep replays "
+            "the step ledger against the exactly-once oracle; it "
+            "must be green"
+        )
+    mixed = block.get("mixed") or {}
+    p99 = mixed.get("interactive_p99_with_trainer_s")
+    deadline = mixed.get("interactive_deadline_s")
+    if (isinstance(p99, (int, float)) and isinstance(
+            deadline, (int, float)) and p99 > deadline):
+        problems.append(
+            f"{name}: cluster_training.mixed interactive p99 = "
+            f"{p99!r}s > deadline {deadline!r}s — the trainer must "
+            "not push interactive work past its SLO class"
+        )
+    if block.get("train_elastic_ok") is not True:
+        problems.append(
+            f"{name}: cluster_training.train_elastic_ok = "
+            f"{block.get('train_elastic_ok')!r} — the section's own "
+            "verdict must be true"
+        )
+    return problems
+
+
+def run_train_check(artifact_path: Optional[str] = None) -> List[str]:
+    return check_train_block(
+        artifact_path or canonical_artifact_path())
+
+
+# ----------------------------------------------------------------------
 # artifact-of-record provenance: the PARITY table must not stay
 # stamped from a builder preview once the same round's DRIVER capture
 # exists and parses (ISSUE 4 satellite; VERDICT r5 item 1)
@@ -2175,6 +2320,9 @@ def main() -> None:
     for problem in run_specdec_check(art_path):
         total += 1
         print(f"specdec block: {problem}")
+    for problem in run_train_check(art_path):
+        total += 1
+        print(f"train block: {problem}")
     for problem in check_parity_source():
         total += 1
         print(f"parity source: {problem}")

@@ -78,4 +78,5 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "signal: SLO signal-plane coverage (windowed time-series, burn-rate monitors, straggler cross-checks, typed alert lifecycle)")
     config.addinivalue_line("markers", "autoscale: closed-loop autoscaler coverage (SLO-burn-driven scale-out/in, capacity reallocation, decision-ledger replay, controller-aimed chaos)")
     config.addinivalue_line("markers", "specdec: speculative-decoding coverage (draft propose + batched verify exactness, acceptance accounting and auto-disable, shipped-draft handoff, step-granular adoption races)")
+    config.addinivalue_line("markers", "train: elastic data-parallel training coverage (TrainJob step ledger exactly-once accounting, elastic re-shard at step boundaries, checkpoint adoption after leader failover)")
 

@@ -399,6 +399,11 @@ def test_group_survives_leader_failover(tmp_path):
             await c.crash_node(leader_u)
             done = await client.jobs.wait_job(job_id, timeout=60.0)
             assert done["total_queries"] == n
+            # wait_job only needs the promoted leader; the other nodes
+            # may still be mid-gossip about who that is
+            await c.wait_for(
+                lambda: c.leader_uname() is not None, 15.0, "leader agreement"
+            )
             new_leader = c.nodes[c.leader_uname()]
             sched = new_leader.jobs.scheduler
             assert sched.query_counts.get(chaos.STUB_MODEL, 0) >= n
